@@ -1,0 +1,24 @@
+"""Test harness config: run the JAX runtime on an emulated 8-device CPU mesh.
+
+Mirrors the reference's ring structure (SURVEY.md §4): the real runtime executes
+in-process (as flytekit-local does there), and multi-chip behavior is exercised without
+hardware via XLA's host-platform device emulation — the analog of the reference's
+docker Flyte sandbox. An opt-in real-TPU lane is keyed on UNIONML_TPU_CI.
+"""
+
+import os
+import sys
+
+if not os.environ.get("UNIONML_TPU_CI"):
+    # hard-set: the ambient environment pins JAX_PLATFORMS to the real TPU tunnel (axon),
+    # and that plugin wins over the env var — the config update below is what sticks.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

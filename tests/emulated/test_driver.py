@@ -1,0 +1,173 @@
+"""Train-driver + parallelism tests on the emulated 8-device CPU mesh.
+
+The analog of the reference's cluster ring (SURVEY.md §4): multi-chip behavior without
+hardware, via ``--xla_force_host_platform_device_count=8``.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+from flax.training import train_state
+
+from unionml_tpu import MeshSpec, TrainerConfig, make_train_step
+from unionml_tpu.parallel.sharding import batch_sharding, infer_fsdp_sharding
+from unionml_tpu.train import evaluate, fit
+
+
+class TinyMLP(nn.Module):
+    width: int = 32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(self.width)(x)
+        x = nn.relu(x)
+        return nn.Dense(2)(x)
+
+
+def _make_state(lr=1e-2, width=32):
+    module = TinyMLP(width)
+    params = module.init(jax.random.PRNGKey(0), jnp.zeros((1, 8)))["params"]
+    return module, train_state.TrainState.create(apply_fn=module.apply, params=params, tx=optax.adam(lr))
+
+
+def _make_data(n=1024, one_d_targets=False):
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(8,))
+    X = rng.normal(size=(n, 8)).astype("float32")
+    y = (X @ w > 0).astype("int32")
+    return [X, y if one_d_targets else y[:, None]]
+
+
+def _loss(module):
+    def loss_fn(params, batch):
+        X, y = batch
+        logits = module.apply({"params": params}, X)
+        labels = y.reshape(-1).astype(jnp.int32)
+        return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+
+    return loss_fn
+
+
+def test_devices_emulated():
+    assert len(jax.devices()) == 8
+
+
+def test_fit_dp_mesh():
+    module, state = _make_state()
+    step = make_train_step(_loss(module))
+    result = fit(state, step, _make_data(), TrainerConfig(epochs=2, batch_size=128, mesh=MeshSpec(data=-1)))
+    assert result.steps == 16
+    assert result.history[-1]["loss"] < 0.4
+    assert result.samples_per_sec > 0
+    assert result.compile_time_s > 0
+
+
+def test_fit_one_dimensional_targets():
+    """1-D label vectors must not crash batch placement (regression)."""
+    module, state = _make_state()
+    step = make_train_step(_loss(module))
+    result = fit(state, step, _make_data(one_d_targets=True), TrainerConfig(epochs=1, batch_size=64))
+    assert result.steps == 16
+
+
+def test_fit_partial_final_batch():
+    """drop_remainder=False with an indivisible final batch must not crash."""
+    module, state = _make_state()
+    step = make_train_step(_loss(module))
+    data = _make_data(n=1000)
+    result = fit(
+        state, step, data, TrainerConfig(epochs=1, batch_size=128, drop_remainder=False, mesh=MeshSpec(data=-1))
+    )
+    assert result.steps == 8  # 7 full + 1 partial
+
+
+def test_fit_grad_accumulation():
+    module, state = _make_state()
+    step = make_train_step(_loss(module), grad_accum_steps=4)
+    result = fit(state, step, _make_data(), TrainerConfig(epochs=2, batch_size=128, mesh=MeshSpec(data=-1)))
+    assert result.history[-1]["loss"] < 0.5
+
+
+def test_fit_fsdp_shards_params():
+    module, state = _make_state(width=1024)  # big enough to trip the fsdp threshold
+    step = make_train_step(_loss(module))
+    config = TrainerConfig(epochs=1, batch_size=128, mesh=MeshSpec(data=2, fsdp=4), fsdp_min_weight_size=1024)
+    result = fit(state, step, _make_data(), config)
+    kernel = result.state.params["Dense_0"]["kernel"]
+    # the fsdp axis (size 4) should shard the largest divisible dim of the kernel
+    assert "fsdp" in str(kernel.sharding.spec)
+
+
+def test_evaluate_partial_batches():
+    module, state = _make_state()
+    step = make_train_step(_loss(module))
+    data = _make_data(n=1001)
+    state = fit(state, step, data, TrainerConfig(epochs=2, batch_size=128, mesh=MeshSpec(data=-1))).state
+
+    def eval_step(state, batch):
+        X, y = batch
+        logits = module.apply({"params": state.params}, X)
+        acc = (jnp.argmax(logits, -1) == y.reshape(-1)).mean()
+        return {"accuracy": acc}
+
+    metrics = evaluate(state, eval_step, data, batch_size=128, mesh=MeshSpec(data=-1))
+    assert metrics["accuracy"] > 0.9
+
+
+def test_checkpoint_and_resume(tmp_path):
+    module, state = _make_state()
+    step = make_train_step(_loss(module))
+    data = _make_data()
+    ckpt_dir = str(tmp_path / "ckpt")
+
+    full = fit(state, step, data, TrainerConfig(epochs=2, batch_size=128, shuffle=False, donate=False))
+
+    _, state2 = _make_state()
+    partial = fit(
+        state2,
+        step,
+        data,
+        TrainerConfig(
+            epochs=1, batch_size=128, shuffle=False, donate=False,
+            checkpoint_dir=ckpt_dir, checkpoint_every_steps=4,
+        ),
+    )
+    assert partial.steps == 8
+    _, state3 = _make_state()
+    resumed = fit(
+        state3,
+        step,
+        data,
+        TrainerConfig(
+            epochs=2, batch_size=128, shuffle=False, donate=False,
+            checkpoint_dir=ckpt_dir, checkpoint_every_steps=4, resume=True,
+        ),
+    )
+    # resumed from completed step 8, so only 8 more steps run
+    assert resumed.steps == 8
+    np.testing.assert_allclose(
+        float(full.history[-1]["loss"]), float(resumed.history[-1]["loss"]), rtol=0.2
+    )
+
+
+def test_batch_sharding_handles_any_rank():
+    mesh = MeshSpec(data=-1).build()
+    sharding = batch_sharding(mesh)
+    for shape in [(16,), (16, 4), (16, 4, 2)]:
+        arr = jax.device_put(np.zeros(shape, dtype="float32"), sharding)
+        assert arr.sharding.is_equivalent_to(sharding, len(shape))
+
+
+def test_infer_fsdp_sharding_rules():
+    mesh = MeshSpec(data=2, fsdp=4).build()
+    params = {
+        "big": np.zeros((1024, 64), dtype="float32"),
+        "bias": np.zeros((64,), dtype="float32"),
+    }
+    shardings = infer_fsdp_sharding(params, mesh, min_weight_size=1024)
+    assert "fsdp" in str(shardings["big"].spec)
+    assert str(shardings["bias"].spec) == "PartitionSpec()"

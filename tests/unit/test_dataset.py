@@ -1,0 +1,176 @@
+"""Dataset pipeline tests — mirrors reference tests/unit/test_dataset.py coverage."""
+
+import json
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from unionml_tpu import Dataset, ExecutionGraph
+from unionml_tpu.dataset import ReaderReturnTypeSource
+
+
+def test_reader_registration_and_stage(simple_dataset: Dataset):
+    stage = simple_dataset.dataset_task()
+    assert stage.name == "test_dataset.dataset_task"
+    assert "sample_frac" in stage.interface.inputs
+    assert list(stage.interface.outputs) == ["data"]
+    data = stage(sample_frac=1.0, random_state=0)
+    assert isinstance(data, pd.DataFrame)
+    assert len(data) == 100
+
+
+def test_reader_requires_return_annotation():
+    dataset = Dataset(name="d")
+    with pytest.raises(TypeError, match="return annotation cannot be empty"):
+
+        @dataset.reader
+        def reader():
+            return pd.DataFrame()
+
+
+def test_get_data_default_pipeline(simple_dataset: Dataset):
+    raw = simple_dataset.dataset_task()(sample_frac=1.0, random_state=0)
+    data = simple_dataset.get_data(raw)
+    assert set(data) == {"train", "test"}
+    X_train, y_train = data["train"]
+    X_test, y_test = data["test"]
+    assert list(X_train.columns) == ["x1", "x2"]
+    assert list(y_train.columns) == ["y"]
+    assert len(X_train) == 80 and len(X_test) == 20
+    # splits are disjoint
+    assert not set(X_train.index) & set(X_test.index)
+
+
+def test_get_data_splitter_kwargs_override(simple_dataset: Dataset):
+    raw = simple_dataset.dataset_task()(sample_frac=1.0, random_state=0)
+    data = simple_dataset.get_data(raw, splitter_kwargs={"test_size": 0.5})
+    assert len(data["train"][0]) == 50
+
+
+def test_get_features_from_records(simple_dataset: Dataset):
+    features = simple_dataset.get_features([{"x1": 0.1, "x2": -0.2}, {"x1": 1.0, "x2": 2.0}])
+    assert isinstance(features, pd.DataFrame)
+    assert list(features.columns) == ["x1", "x2"]
+    assert len(features) == 2
+
+
+def test_get_features_from_json_file(simple_dataset: Dataset, tmp_path):
+    path = tmp_path / "features.json"
+    path.write_text(json.dumps([{"x1": 0.5, "x2": 0.5}]))
+    features = simple_dataset.get_features(path)
+    assert len(features) == 1
+
+
+def test_custom_loader_overrides_datatype():
+    dataset = Dataset(name="d", targets=["y"])
+
+    @dataset.reader
+    def reader() -> str:
+        return json.dumps([{"x": 1, "y": 0}, {"x": 2, "y": 1}])
+
+    assert dataset.dataset_datatype_source is ReaderReturnTypeSource.READER
+
+    @dataset.loader
+    def loader(data: str) -> pd.DataFrame:
+        return pd.DataFrame(json.loads(data))
+
+    assert dataset.dataset_datatype_source is ReaderReturnTypeSource.LOADER
+    assert dataset.dataset_datatype["data"] is pd.DataFrame
+    data = dataset.get_data(reader())
+    assert isinstance(data["train"][0], pd.DataFrame)
+
+
+def test_custom_splitter_and_parser_on_list_data():
+    dataset = Dataset(name="d")
+
+    @dataset.reader
+    def reader() -> List[Dict]:
+        return [{"x": i, "y": i % 2} for i in range(10)]
+
+    @dataset.splitter
+    def splitter(data: List[Dict], test_size: float, shuffle: bool, random_state: int) -> Tuple[List[Dict], List[Dict]]:
+        n_test = int(len(data) * test_size)
+        return data[:-n_test], data[-n_test:]
+
+    @dataset.parser
+    def parser(data: List[Dict], features: Optional[List[str]], targets: List[str]) -> Tuple[List[Dict], List[Dict]]:
+        return (
+            [{k: v for k, v in row.items() if k != "y"} for row in data],
+            [{"y": row["y"]} for row in data],
+        )
+
+    data = dataset.get_data(reader())
+    assert len(data["train"][0]) == 8
+    assert len(data["test"][0]) == 2
+    assert "y" not in data["train"][0][0]
+
+
+def test_kwargs_dataclass_synthesis(simple_dataset: Dataset):
+    splitter_kwargs = simple_dataset.splitter_kwargs_type()
+    assert splitter_kwargs.test_size == 0.2
+    assert splitter_kwargs.shuffle is True
+    assert splitter_kwargs.random_state == 12345
+    # round-trips through json
+    assert type(splitter_kwargs).from_json(splitter_kwargs.to_json()) == splitter_kwargs
+
+    parser_kwargs = simple_dataset.parser_kwargs_type()
+    assert parser_kwargs.targets == ["y"]
+
+
+def test_dataset_stage_in_custom_graph(simple_dataset: Dataset):
+    """Stages compose into hand-written graphs (reference test_dataset.py:129-145)."""
+    graph = ExecutionGraph("custom")
+    graph.add_input("sample_frac", float)
+    graph.add_input("random_state", int)
+    node = graph.add_node(
+        simple_dataset.dataset_task(),
+        sample_frac=graph.inputs["sample_frac"],
+        random_state=graph.inputs["random_state"],
+    )
+    graph.add_output("data", node.outputs["data"])
+    out = graph(sample_frac=1.0, random_state=0)
+    assert isinstance(out, pd.DataFrame)
+
+
+def test_from_sqlite_query(tmp_path):
+    import sqlite3
+
+    db = tmp_path / "test.db"
+    with sqlite3.connect(db) as conn:
+        conn.execute("CREATE TABLE points (x1 REAL, x2 REAL, y INTEGER)")
+        rng = np.random.default_rng(3)
+        rows = [(float(a), float(b), int(a + b > 0)) for a, b in rng.normal(size=(50, 2))]
+        conn.executemany("INSERT INTO points VALUES (?, ?, ?)", rows)
+
+    dataset = Dataset.from_sqlite_query(str(db), "SELECT * FROM points", name="sql_dataset", targets=["y"])
+    raw = dataset.dataset_task()()
+    assert isinstance(raw, pd.DataFrame)
+    data = dataset.get_data(raw)
+    assert len(data["train"][0]) == 40
+
+
+def test_iterator_prefetch(simple_dataset: Dataset):
+    raw = simple_dataset.dataset_task()(sample_frac=1.0, random_state=0)
+    data = simple_dataset.get_data(raw)
+    batches = list(simple_dataset.iterator(data["train"], batch_size=16))
+    assert len(batches) == 5  # 80 // 16
+    X, y = batches[0]
+    assert X.shape == (16, 2)
+    assert y.shape == (16, 1)
+
+
+def test_feature_transformer():
+    dataset = Dataset(name="d", targets=["y"])
+
+    @dataset.reader
+    def reader() -> pd.DataFrame:
+        return pd.DataFrame({"x": [1.0, 2.0], "y": [0, 1]})
+
+    @dataset.feature_transformer
+    def feature_transformer(features: pd.DataFrame) -> pd.DataFrame:
+        return features * 2
+
+    features = dataset.get_features([{"x": 1.0}])
+    assert features["x"].iloc[0] == 2.0

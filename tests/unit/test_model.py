@@ -1,0 +1,177 @@
+"""Model lifecycle tests — mirrors reference tests/unit/test_model.py coverage."""
+
+import io
+from typing import List
+
+import pandas as pd
+import pytest
+
+from unionml_tpu import Dataset, ExecutionGraph, Model, stage
+from unionml_tpu.model import BaseHyperparameters
+
+
+def test_train_task_interface(sklearn_model: Model):
+    train_stage = sklearn_model.train_task()
+    inputs = train_stage.interface.inputs
+    assert list(inputs)[:2] == ["hyperparameters", "data"]
+    assert set(("loader_kwargs", "splitter_kwargs", "parser_kwargs")) <= set(inputs)
+    assert list(train_stage.interface.outputs) == ["model_object", "hyperparameters", "metrics"]
+
+
+def test_hyperparameter_type_synthesis(simple_dataset):
+    def init(C: float = 1.0, max_iter: int = 100) -> object:
+        ...
+
+    model = Model(name="m", init=init, dataset=simple_dataset)
+    hp_type = model.hyperparameter_type
+    assert issubclass(hp_type, BaseHyperparameters)
+    hp = hp_type()
+    assert hp.C == 1.0 and hp.max_iter == 100
+    assert hp_type.from_json(hp.to_json()) == hp
+
+
+def test_hyperparameter_type_untyped_init_falls_back_to_dict(simple_dataset):
+    def init(C=1.0):
+        ...
+
+    model = Model(name="m", init=init, dataset=simple_dataset)
+    assert model.hyperparameter_type is dict
+
+
+def test_hyperparameter_config_override(simple_dataset):
+    model = Model(name="m", dataset=simple_dataset, hyperparameter_config={"alpha": float})
+    hp = model.hyperparameter_type(alpha=0.5)
+    assert hp.alpha == 0.5
+
+
+def test_local_train(sklearn_model: Model):
+    model_obj, metrics = sklearn_model.train(hyperparameters={"max_iter": 500})
+    assert model_obj is sklearn_model.artifact.model_object
+    assert set(metrics) == {"train", "test"}
+    assert metrics["train"] > 0.8
+
+
+def test_local_train_with_stage_kwargs(sklearn_model: Model):
+    _, metrics = sklearn_model.train(
+        hyperparameters={"max_iter": 500},
+        splitter_kwargs={"test_size": 0.5},
+        sample_frac=1.0,
+    )
+    assert set(metrics) == {"train", "test"}
+
+
+def test_predict_from_reader_vs_features_equivalence(sklearn_model: Model):
+    sklearn_model.train(hyperparameters={"max_iter": 500})
+    preds_reader = sklearn_model.predict(sample_frac=1.0, random_state=0)
+    raw = sklearn_model.dataset.dataset_task()(sample_frac=1.0, random_state=0)
+    features = raw[["x1", "x2"]].to_dict(orient="records")
+    preds_features = sklearn_model.predict(features=features)
+    assert preds_reader == preds_features
+
+
+def test_predict_without_training_raises(sklearn_model: Model):
+    with pytest.raises(RuntimeError, match="ModelArtifact not found"):
+        sklearn_model.predict(sample_frac=1.0)
+
+
+def test_predict_requires_features_or_reader_kwargs(sklearn_model: Model):
+    with pytest.raises(ValueError, match="At least one of features"):
+        sklearn_model.predict()
+
+
+def test_save_load_path(sklearn_model: Model, tmp_path):
+    sklearn_model.train(hyperparameters={"max_iter": 500})
+    path = tmp_path / "model.joblib"
+    sklearn_model.save(str(path))
+
+    preds_before = sklearn_model.predict(sample_frac=1.0, random_state=0)
+    sklearn_model.artifact = None
+    sklearn_model.load(str(path))
+    preds_after = sklearn_model.predict(sample_frac=1.0, random_state=0)
+    assert preds_before == preds_after
+
+
+def test_save_load_fileobj(sklearn_model: Model):
+    sklearn_model.train(hyperparameters={"max_iter": 500})
+    buf = io.BytesIO()
+    sklearn_model.save(buf)
+    buf.seek(0)
+    loaded = sklearn_model._loader(buf)
+    assert loaded.coef_.shape == sklearn_model.artifact.model_object.coef_.shape
+
+
+def test_load_from_env(sklearn_model: Model, tmp_path, monkeypatch):
+    sklearn_model.train(hyperparameters={"max_iter": 500})
+    path = tmp_path / "model.joblib"
+    sklearn_model.save(str(path))
+    monkeypatch.setenv("UNIONML_MODEL_PATH", str(path))
+    obj = sklearn_model.load_from_env()
+    assert obj is sklearn_model.artifact.model_object
+
+
+def test_custom_saver_loader(sklearn_model: Model, tmp_path):
+    import joblib
+
+    @sklearn_model.saver
+    def saver(model_obj, hyperparameters, file):
+        joblib.dump(model_obj, file)
+        return file
+
+    @sklearn_model.loader
+    def loader(file):
+        return joblib.load(file)
+
+    sklearn_model.train(hyperparameters={"max_iter": 500})
+    path = tmp_path / "custom.joblib"
+    sklearn_model.save(str(path))
+    sklearn_model.load(str(path))
+    assert sklearn_model.artifact is not None
+
+
+def test_model_stages_in_custom_graph(sklearn_model: Model):
+    """unionml stages embed in hand-written graphs (reference test_model.py:145-196)."""
+    sklearn_model.train(hyperparameters={"max_iter": 500})
+
+    @stage
+    def select_columns(data: pd.DataFrame) -> pd.DataFrame:
+        return data[["x1", "x2"]]
+
+    graph = ExecutionGraph("custom_predict")
+    graph.add_input("model_object", object)
+    graph.add_input("sample_frac", float)
+    graph.add_input("random_state", int)
+    reader_node = graph.add_node(
+        sklearn_model.dataset.dataset_task(),
+        sample_frac=graph.inputs["sample_frac"],
+        random_state=graph.inputs["random_state"],
+    )
+    select_node = graph.add_node(select_columns, data=reader_node.outputs["data"])
+    predict_node = graph.add_node(
+        sklearn_model.predict_from_features_task(),
+        model_object=graph.inputs["model_object"],
+        features=select_node.outputs["o0"],
+    )
+    out_key = list(predict_node.outputs)[0]
+    graph.add_output("predictions", predict_node.outputs[out_key])
+
+    preds = graph(
+        model_object=sklearn_model.artifact.model_object, sample_frac=1.0, random_state=0
+    )
+    assert isinstance(preds, list) and len(preds) == 100
+
+
+def test_trainer_type_guard_rejects_bad_signature(simple_dataset):
+    from sklearn.linear_model import LogisticRegression
+
+    model = Model(name="m", init=LogisticRegression, dataset=simple_dataset)
+    with pytest.raises(TypeError):
+
+        @model.trainer
+        def trainer(estimator: LogisticRegression, features: int, target: int) -> LogisticRegression:
+            return estimator
+
+
+def test_workflow_names(sklearn_model: Model):
+    assert sklearn_model.train_workflow_name == "test_model.train"
+    assert sklearn_model.predict_workflow_name == "test_model.predict"
+    assert sklearn_model.predict_from_features_workflow_name == "test_model.predict_from_features"

@@ -1,0 +1,122 @@
+"""Serving app tests: in-process dispatch + micro-batcher (reference tests hit a live
+uvicorn subprocess; the HTTP socket loop is exercised in tests/integration)."""
+
+import asyncio
+import json
+
+import pytest
+
+from unionml_tpu.serving import MicroBatcher, ServingConfig, serving_app
+
+
+def _dispatch(app, method, path, body=b""):
+    return asyncio.run(app.dispatch(method, path, body))
+
+
+@pytest.fixture
+def trained_app(sklearn_model):
+    sklearn_model.train(hyperparameters={"max_iter": 500})
+    return serving_app(sklearn_model)
+
+
+def test_root_banner(trained_app):
+    status, payload, content_type = _dispatch(trained_app, "GET", "/")
+    assert status == 200
+    assert content_type == "text/html"
+    assert "unionml-tpu" in payload
+
+
+def test_health(trained_app):
+    status, payload, _ = _dispatch(trained_app, "GET", "/health")
+    assert status == 200
+    assert payload["status"] == 200
+
+
+def test_health_without_artifact(sklearn_model):
+    app = serving_app(sklearn_model)
+    app._started = True  # skip startup loading
+    status, payload, _ = _dispatch(app, "GET", "/health")
+    assert status == 500
+    assert "not found" in payload["detail"].lower()
+
+
+def test_predict_with_features(trained_app):
+    body = json.dumps({"features": [{"x1": 1.0, "x2": 1.0}, {"x1": -1.0, "x2": -1.0}]}).encode()
+    status, payload, _ = _dispatch(trained_app, "POST", "/predict", body)
+    assert status == 200
+    assert payload == [1.0, 0.0]
+
+
+def test_predict_with_inputs(trained_app):
+    body = json.dumps({"inputs": {"sample_frac": 1.0, "random_state": 0}}).encode()
+    status, payload, _ = _dispatch(trained_app, "POST", "/predict", body)
+    assert status == 200
+    assert len(payload) == 100
+
+
+def test_predict_requires_inputs_or_features(trained_app):
+    status, payload, _ = _dispatch(trained_app, "POST", "/predict", b"{}")
+    assert status == 500
+    assert "inputs or features" in payload["detail"]
+
+
+def test_predict_invalid_json(trained_app):
+    status, payload, _ = _dispatch(trained_app, "POST", "/predict", b"{not json")
+    assert status == 400
+
+
+def test_unknown_route_and_method(trained_app):
+    status, *_ = _dispatch(trained_app, "GET", "/nope")
+    assert status == 404
+    status, *_ = _dispatch(trained_app, "DELETE", "/predict")
+    assert status == 405
+
+
+def test_startup_requires_model_path(sklearn_model, monkeypatch):
+    monkeypatch.delenv("UNIONML_MODEL_PATH", raising=False)
+    app = serving_app(sklearn_model)
+    with pytest.raises(ValueError, match="artifact path not specified"):
+        asyncio.run(app.dispatch("GET", "/health"))
+
+
+def test_startup_loads_from_env(sklearn_model, tmp_path, monkeypatch):
+    sklearn_model.train(hyperparameters={"max_iter": 500})
+    path = tmp_path / "m.joblib"
+    sklearn_model.save(str(path))
+    sklearn_model.artifact = None
+    monkeypatch.setenv("UNIONML_MODEL_PATH", str(path))
+    app = serving_app(sklearn_model)
+    status, *_ = _dispatch(app, "GET", "/health")
+    assert status == 200
+
+
+def test_micro_batcher_coalesces_requests():
+    calls = []
+
+    def predict(batch):
+        calls.append(len(batch))
+        return [x * 2 for x in batch]
+
+    async def scenario():
+        batcher = MicroBatcher(predict, ServingConfig(max_batch_size=8, max_wait_ms=50))
+        results = await asyncio.gather(*(batcher.submit([i]) for i in range(6)))
+        await batcher.stop()
+        return results
+
+    results = asyncio.run(scenario())
+    assert sorted(r[0] for r in results) == [0, 2, 4, 6, 8, 10]
+    assert sum(calls) == 6
+    assert len(calls) < 6  # at least some requests shared a dispatch
+
+
+def test_micro_batcher_propagates_errors():
+    def predict(batch):
+        raise RuntimeError("boom")
+
+    async def scenario():
+        batcher = MicroBatcher(predict, ServingConfig(max_batch_size=4, max_wait_ms=5))
+        with pytest.raises(RuntimeError, match="boom"):
+            await batcher.submit([1])
+        await batcher.stop()
+
+    asyncio.run(scenario())

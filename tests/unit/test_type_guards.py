@@ -1,0 +1,232 @@
+"""Table-driven signature-contract tests — mirrors reference tests/unit/test_type_guards.py."""
+
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple, Union
+
+import pandas as pd
+import pytest
+
+from unionml_tpu import type_guards
+
+
+class Estimator:
+    ...
+
+
+# ---------------------------------------------------------------- guard_reader
+
+
+def test_guard_reader_ok():
+    def reader() -> pd.DataFrame:
+        ...
+
+    type_guards.guard_reader(reader)
+
+
+def test_guard_reader_missing_annotation():
+    def reader():
+        ...
+
+    with pytest.raises(TypeError, match="return annotation cannot be empty"):
+        type_guards.guard_reader(reader)
+
+
+# ---------------------------------------------------------------- guard_loader
+
+
+@pytest.mark.parametrize(
+    "annotation,expected,ok",
+    [
+        (pd.DataFrame, pd.DataFrame, True),
+        (Any, pd.DataFrame, True),
+        (pd.DataFrame, Any, True),
+        (Union[pd.DataFrame, str], pd.DataFrame, True),
+        (str, pd.DataFrame, False),
+    ],
+)
+def test_guard_loader(annotation, expected, ok):
+    def loader(data):
+        ...
+
+    loader.__annotations__["data"] = annotation
+    if ok:
+        type_guards.guard_loader(loader, expected)
+    else:
+        with pytest.raises(TypeError):
+            type_guards.guard_loader(loader, expected)
+
+
+# ---------------------------------------------------------------- guard_splitter
+
+
+def _valid_splitter(data: pd.DataFrame, test_size: float, shuffle: bool, random_state: int) -> Tuple[pd.DataFrame, pd.DataFrame]:
+    ...
+
+
+def test_guard_splitter_ok():
+    type_guards.guard_splitter(_valid_splitter, pd.DataFrame, "reader")
+
+
+def test_guard_splitter_namedtuple_output_ok():
+    class Splits(NamedTuple):
+        train: pd.DataFrame
+        test: pd.DataFrame
+
+    def splitter(data: pd.DataFrame, test_size: float, shuffle: bool, random_state: int) -> Splits:
+        ...
+
+    type_guards.guard_splitter(splitter, pd.DataFrame, "reader")
+
+
+@pytest.mark.parametrize(
+    "fn_src",
+    [
+        # wrong input type
+        "def s(data: str, test_size: float, shuffle: bool, random_state: int) -> Tuple[str, str]: ...",
+        # non-generic output
+        "def s(data: pd.DataFrame, test_size: float, shuffle: bool, random_state: int) -> pd.DataFrame: ...",
+        # output element type mismatch
+        "def s(data: pd.DataFrame, test_size: float, shuffle: bool, random_state: int) -> Tuple[str, str]: ...",
+        # missing canonical kwarg
+        "def s(data: pd.DataFrame, test_size: float, shuffle: bool) -> Tuple[pd.DataFrame, pd.DataFrame]: ...",
+        # wrongly typed canonical kwarg
+        "def s(data: pd.DataFrame, test_size: str, shuffle: bool, random_state: int) -> Tuple[pd.DataFrame, pd.DataFrame]: ...",
+    ],
+)
+def test_guard_splitter_invalid(fn_src):
+    namespace = {"pd": pd, "Tuple": Tuple}
+    exec(fn_src, namespace)
+    with pytest.raises(TypeError):
+        type_guards.guard_splitter(namespace["s"], pd.DataFrame, "reader")
+
+
+# ---------------------------------------------------------------- guard_parser
+
+
+def test_guard_parser_ok():
+    def parser(data: pd.DataFrame, features: Optional[List[str]], targets: List[str]) -> Tuple[pd.DataFrame, pd.DataFrame]:
+        ...
+
+    type_guards.guard_parser(parser, pd.DataFrame, "reader")
+
+
+def test_guard_parser_missing_kwarg():
+    def parser(data: pd.DataFrame, features: Optional[List[str]]) -> Tuple[pd.DataFrame, pd.DataFrame]:
+        ...
+
+    with pytest.raises(TypeError):
+        type_guards.guard_parser(parser, pd.DataFrame, "reader")
+
+
+# ---------------------------------------------------------------- guard_trainer
+
+
+def test_guard_trainer_ok():
+    def trainer(model: Estimator, features: pd.DataFrame, target: pd.DataFrame) -> Estimator:
+        ...
+
+    type_guards.guard_trainer(trainer, Estimator, (pd.DataFrame, pd.DataFrame))
+
+
+def test_guard_trainer_keyword_only_hyperparams_ok():
+    def trainer(model: Estimator, features: pd.DataFrame, target: pd.DataFrame, *, lr: float = 0.1) -> Estimator:
+        ...
+
+    type_guards.guard_trainer(trainer, Estimator, (pd.DataFrame, pd.DataFrame))
+
+
+@pytest.mark.parametrize(
+    "model_t,data_ts,ok",
+    [
+        (Estimator, (pd.DataFrame, pd.DataFrame), True),
+        (str, (pd.DataFrame, pd.DataFrame), False),  # wrong model type
+        (Estimator, (pd.DataFrame,), False),  # arity mismatch
+        (Estimator, (str, str), False),  # wrong data types
+    ],
+)
+def test_guard_trainer_table(model_t, data_ts, ok):
+    def trainer(model: Estimator, features: pd.DataFrame, target: pd.DataFrame) -> Estimator:
+        ...
+
+    if ok:
+        type_guards.guard_trainer(trainer, model_t, data_ts)
+    else:
+        with pytest.raises(TypeError):
+            type_guards.guard_trainer(trainer, model_t, data_ts)
+
+
+def test_guard_trainer_return_type_mismatch():
+    def trainer(model: Estimator, features: pd.DataFrame, target: pd.DataFrame) -> str:
+        ...
+
+    with pytest.raises(TypeError):
+        type_guards.guard_trainer(trainer, Estimator, (pd.DataFrame, pd.DataFrame))
+
+
+# ---------------------------------------------------------------- guard_evaluator
+
+
+def test_guard_evaluator_ok():
+    def evaluator(model: Estimator, features: pd.DataFrame, target: pd.DataFrame) -> float:
+        ...
+
+    type_guards.guard_evaluator(evaluator, Estimator, (pd.DataFrame, pd.DataFrame))
+
+
+def test_guard_evaluator_bad_data_types():
+    def evaluator(model: Estimator, features: int, target: int) -> float:
+        ...
+
+    with pytest.raises(TypeError):
+        type_guards.guard_evaluator(evaluator, Estimator, (pd.DataFrame, pd.DataFrame))
+
+
+# ---------------------------------------------------------------- guard_predictor
+
+
+def test_guard_predictor_ok():
+    def predictor(model: Estimator, features: pd.DataFrame) -> List[float]:
+        ...
+
+    type_guards.guard_predictor(predictor, Estimator, pd.DataFrame)
+
+
+def test_guard_predictor_multiple_features_args():
+    def predictor(model: Estimator, a: pd.DataFrame, b: pd.DataFrame) -> List[float]:
+        ...
+
+    with pytest.raises(TypeError, match="single 'features' argument"):
+        type_guards.guard_predictor(predictor, Estimator, pd.DataFrame)
+
+
+def test_guard_predictor_missing_return():
+    def predictor(model: Estimator, features: pd.DataFrame):
+        ...
+
+    with pytest.raises(TypeError, match="needs a return type annotation"):
+        type_guards.guard_predictor(predictor, Estimator, pd.DataFrame)
+
+
+# ---------------------------------------------------------------- feature guards
+
+
+def test_guard_feature_loader_arity():
+    def feature_loader(a: Any, b: Any) -> pd.DataFrame:
+        ...
+
+    with pytest.raises(TypeError, match="single argument"):
+        type_guards.guard_feature_loader(feature_loader, Any)
+
+
+def test_guard_feature_transformer_arity():
+    def feature_transformer(a: Any, b: Any) -> pd.DataFrame:
+        ...
+
+    with pytest.raises(TypeError, match="single argument"):
+        type_guards.guard_feature_transformer(feature_transformer, Any)
+
+
+def test_guard_feature_transformer_ok():
+    def feature_transformer(features: pd.DataFrame) -> pd.DataFrame:
+        ...
+
+    type_guards.guard_feature_transformer(feature_transformer, pd.DataFrame)

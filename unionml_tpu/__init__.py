@@ -1,0 +1,33 @@
+"""unionml_tpu: a TPU-native ML microservice framework.
+
+Same user contract as UnionML (Dataset/Model decorator protocol compiling user
+functions into train / batch-predict / predict-from-features services; reference
+README.md:26-34), re-built on a JAX/XLA substrate: stages compile under ``jax.jit`` /
+sharding over named TPU meshes, the input pipeline prefetches host->HBM, serving runs
+a dynamic micro-batching queue in front of an AOT-compiled predictor, and the remote
+layer schedules app bundles onto TPU VM slices.
+"""
+
+from unionml_tpu.dataset import Dataset  # noqa: F401
+from unionml_tpu.model import BaseHyperparameters, Model, ModelArtifact  # noqa: F401
+from unionml_tpu.parallel.mesh import MeshSpec  # noqa: F401
+from unionml_tpu.parallel.sharding import PartitionRules  # noqa: F401
+from unionml_tpu.stage import ExecutionGraph, Stage, stage  # noqa: F401
+from unionml_tpu.train.driver import TrainerConfig, make_train_step  # noqa: F401
+
+__title__ = "unionml-tpu"
+__version__ = "0.1.0"
+
+__all__ = [
+    "BaseHyperparameters",
+    "Dataset",
+    "ExecutionGraph",
+    "MeshSpec",
+    "Model",
+    "ModelArtifact",
+    "PartitionRules",
+    "Stage",
+    "TrainerConfig",
+    "make_train_step",
+    "stage",
+]

@@ -1,0 +1,14 @@
+"""Package logger.
+
+Parity: reference unionml/_logging.py:3-7 (stream logger with a ``[unionml]`` prefix).
+"""
+
+import logging
+import os
+
+logger = logging.getLogger("unionml_tpu")
+logger.setLevel(os.environ.get("UNIONML_TPU_LOGLEVEL", "INFO"))
+_handler = logging.StreamHandler()
+_handler.setFormatter(logging.Formatter("[unionml-tpu] %(asctime)s %(levelname)s %(message)s"))
+logger.addHandler(_handler)
+logger.propagate = False

@@ -1,0 +1,3 @@
+"""TPU input pipeline: host-side batching + host->HBM prefetch."""
+
+from unionml_tpu.data.pipeline import PrefetchIterator, to_host_arrays  # noqa: F401

@@ -1,0 +1,185 @@
+"""Host->HBM prefetch input pipeline.
+
+The reference has no input pipeline at all: its ``dataset.get_data`` hands the whole
+in-memory dataset to the user trainer in one call (unionml/model.py:431-436), because
+training itself is delegated to sklearn/torch/keras. On TPU the input pipeline is a
+first-class subsystem: the MXU must never wait on the host, so batches are
+
+1. sliced on the host as numpy views (zero-copy where possible),
+2. transferred to device HBM with an explicit :class:`jax.sharding.NamedSharding`
+   (the batch dim laid out over the ``data`` mesh axis), and
+3. *prefetched* — transfers for step N+1..N+k are issued while step N runs, using
+   JAX's async dispatch; ``device_put`` returns immediately and the copy overlaps
+   compute.
+
+In a multi-host program each process owns a distinct slice of the global batch
+(``shard_by_process=True``); ``jax.make_array_from_process_local_data`` assembles the
+global sharded array from per-host shards.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+
+
+def to_host_arrays(data: Any) -> Any:
+    """Convert a parsed-data leaf (DataFrame/Series/list/array) to a host numpy array."""
+    import pandas as pd
+
+    if isinstance(data, pd.DataFrame) or isinstance(data, pd.Series):
+        return np.asarray(data)
+    if isinstance(data, np.ndarray):
+        return data
+    if isinstance(data, (list, tuple)):
+        return np.asarray(data)
+    if isinstance(data, jax.Array):
+        return np.asarray(data)
+    if isinstance(data, dict):
+        return {k: to_host_arrays(v) for k, v in data.items()}
+    return np.asarray(data)
+
+
+class PrefetchIterator:
+    """Double-buffered iterator yielding device-resident, sharded batch pytrees.
+
+    :param data: a list/tuple of per-column data (e.g. ``[features, targets]`` from
+        :meth:`unionml_tpu.dataset.Dataset.get_data`), a single array, or a dict of
+        arrays. All leaves must share a leading (sample) dimension.
+    :param batch_size: the *global* batch size (across all hosts and devices).
+    :param sharding: an optional :class:`jax.sharding.Sharding` for the batch. When
+        given, batches are placed with that sharding (batch dim over the ``data`` axis);
+        otherwise batches land on the default device.
+    :param shard_by_process: in multi-host programs, let each process slice out its own
+        ``1/process_count`` of the global batch and assemble the global array.
+    """
+
+    def __init__(
+        self,
+        data: Any,
+        batch_size: int,
+        *,
+        sharding: Any = None,
+        drop_remainder: bool = True,
+        shuffle: bool = False,
+        seed: int = 0,
+        prefetch: int = 2,
+        shard_by_process: bool = False,
+        epochs: int = 1,
+        skip_batches: int = 0,
+    ):
+        if isinstance(data, (list, tuple)):
+            data = tuple(leaf for leaf in data if leaf is not None and _nonempty(leaf))
+        host_tree = jax.tree_util.tree_map(to_host_arrays, data)
+        self._leaves, self._treedef = jax.tree_util.tree_flatten(host_tree)
+        lengths = {leaf.shape[0] for leaf in self._leaves}
+        if len(lengths) != 1:
+            raise ValueError(f"all data leaves must share a leading sample dimension, got lengths {lengths}")
+        self._num_samples = lengths.pop()
+        if batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+        self.batch_size = batch_size
+        self.sharding = sharding
+        self.drop_remainder = drop_remainder
+        self.shuffle = shuffle
+        self.seed = seed
+        self.prefetch = max(0, prefetch)
+        self.shard_by_process = shard_by_process
+        self.epochs = epochs
+        # number of leading batches to skip (checkpoint resume: the epoch order is
+        # seeded per-epoch, so skipping reproduces the original schedule exactly)
+        self.skip_batches = skip_batches
+
+    @property
+    def num_samples(self) -> int:
+        return self._num_samples
+
+    def steps_per_epoch(self) -> int:
+        if self.drop_remainder:
+            return self._num_samples // self.batch_size
+        return -(-self._num_samples // self.batch_size)
+
+    def _epoch_order(self, epoch: int) -> np.ndarray:
+        if not self.shuffle:
+            return np.arange(self._num_samples)
+        return np.random.default_rng(self.seed + epoch).permutation(self._num_samples)
+
+    def _host_batches(self) -> Iterator[Any]:
+        per_process = self.batch_size
+        proc_count = jax.process_count()
+        proc_index = jax.process_index()
+        if self.shard_by_process and proc_count > 1:
+            if self.batch_size % proc_count:
+                raise ValueError(f"global batch {self.batch_size} not divisible by process count {proc_count}")
+            per_process = self.batch_size // proc_count
+
+        emitted = 0
+        for epoch in range(self.epochs):
+            order = self._epoch_order(epoch)
+            n_steps = self.steps_per_epoch()
+            for step in range(n_steps):
+                emitted += 1
+                if emitted <= self.skip_batches:
+                    continue
+                lo = step * self.batch_size
+                idx = order[lo : lo + self.batch_size]
+                if self.shard_by_process and proc_count > 1:
+                    if len(idx) < self.batch_size:
+                        # a short final batch cannot be split consistently across
+                        # processes; every process must drop it in lockstep
+                        continue
+                    idx = idx[proc_index * per_process : (proc_index + 1) * per_process]
+                yield jax.tree_util.tree_unflatten(self._treedef, [leaf[idx] for leaf in self._leaves])
+
+    def _place(self, host_batch: Any) -> Any:
+        if self.sharding is None:
+            return jax.device_put(host_batch)
+        if self.shard_by_process and jax.process_count() > 1:
+            return jax.tree_util.tree_map(
+                lambda leaf: jax.make_array_from_process_local_data(self.sharding, leaf),
+                host_batch,
+            )
+
+        def place_leaf(leaf: Any) -> Any:
+            # rank-0 leaves and indivisible final partial batches are placed replicated;
+            # XLA reshards inside the jitted step if needed.
+            if getattr(leaf, "ndim", 0) == 0:
+                return jax.device_put(leaf)
+            try:
+                self.sharding.shard_shape(leaf.shape)  # raises when indivisible
+            except Exception:
+                return jax.device_put(leaf)
+            return jax.device_put(leaf, self.sharding)
+
+        return jax.tree_util.tree_map(place_leaf, host_batch)
+
+    def __iter__(self) -> Iterator[Any]:
+        # A deque of already-dispatched device transfers: jax.device_put is async, so
+        # holding `prefetch` in-flight batches overlaps H2D copies with compute.
+        queue: collections.deque = collections.deque()
+        source = self._host_batches()
+        try:
+            for _ in range(self.prefetch):
+                queue.append(self._place(next(source)))
+        except StopIteration:
+            pass
+        for host_batch in source:
+            queue.append(self._place(host_batch))
+            yield queue.popleft()
+        while queue:
+            yield queue.popleft()
+
+    def __len__(self) -> int:
+        return max(self.steps_per_epoch() * self.epochs - self.skip_batches, 0)
+
+
+def _nonempty(leaf: Any) -> bool:
+    """Filter out empty target frames produced by the default parser for unlabeled data."""
+    try:
+        return len(leaf) > 0
+    except TypeError:
+        return True

@@ -1,0 +1,31 @@
+"""Default execution settings.
+
+Parity: reference unionml/defaults.py:5 defines ``DEFAULT_RESOURCES = Resources(cpu="1",
+mem="1Gi")`` (a flytekit/k8s pod request). Our analog describes the host + TPU footprint
+a stage asks the scheduler for.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class Resources:
+    """Resource request attached to a :class:`unionml_tpu.stage.Stage`.
+
+    ``accelerator`` names a TPU slice topology (e.g. ``"v5e-1"``, ``"v5e-8"``); ``None``
+    means host-only (CPU) execution, which is the default for data-plumbing stages.
+    """
+
+    cpu: str = "1"
+    mem: str = "1Gi"
+    accelerator: str | None = None
+    chips: int = 0
+
+
+DEFAULT_RESOURCES = Resources()
+
+#: Environment variable used by ``serve``/``load_from_env`` — name kept identical to the
+#: reference so existing user scripts keep working (reference unionml/cli.py:188-201).
+MODEL_PATH_ENV_VAR = "UNIONML_MODEL_PATH"

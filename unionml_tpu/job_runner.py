@@ -1,0 +1,98 @@
+"""Worker entrypoint: execute one job spec from the backend store.
+
+The per-host analog of the reference's container entrypoint + task resolver
+(unionml/task_resolver.py:16-21): re-import the deployed app module from the bundle,
+rebuild the requested workflow, run it, write outputs. Launched as
+``python -m unionml_tpu.job_runner <execution_dir>`` on every host of a slice; when
+``UNIONML_TPU_COORDINATOR`` is set the hosts join one JAX distributed runtime before
+executing, so pjit-compiled stages span the whole slice.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import sys
+import traceback
+from pathlib import Path
+
+
+def _maybe_init_distributed() -> None:
+    coordinator = os.environ.get("UNIONML_TPU_COORDINATOR")
+    if not coordinator:
+        return
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=int(os.environ.get("UNIONML_TPU_NUM_PROCESSES", "1")),
+        process_id=int(os.environ.get("UNIONML_TPU_PROCESS_ID", "0")),
+    )
+
+
+def run_job(execution_dir: str) -> None:
+    exec_path = Path(execution_dir)
+    status = exec_path / "status"
+    outputs = exec_path / "outputs"
+    outputs.mkdir(exist_ok=True)
+    status.write_text("RUNNING")
+    try:
+        with open(exec_path / "spec.pkl", "rb") as f:
+            spec = pickle.load(f)
+
+        _maybe_init_distributed()
+
+        from unionml_tpu.resolver import locate
+
+        model = locate(spec["app_module"])
+        inputs = spec["inputs"]
+
+        if spec["kind"] == "train":
+            model.train(
+                hyperparameters=inputs.get("hyperparameters"),
+                loader_kwargs=inputs.get("loader_kwargs"),
+                splitter_kwargs=inputs.get("splitter_kwargs"),
+                parser_kwargs=inputs.get("parser_kwargs"),
+                trainer_kwargs=inputs.get("trainer_kwargs"),
+                **(inputs.get("reader_kwargs") or {}),
+            )
+            # only process 0 of a slice persists outputs (single writer)
+            if int(os.environ.get("UNIONML_TPU_PROCESS_ID", "0")) == 0:
+                model.save(outputs / "model_object.bin")
+                hp = model.artifact.hyperparameters
+                from dataclasses import is_dataclass
+
+                from unionml_tpu.utils import dataclass_to_dict
+
+                meta = {
+                    "hyperparameters": dataclass_to_dict(hp) if is_dataclass(hp) else hp,
+                    "metrics": model.artifact.metrics,
+                }
+                (outputs / "artifact.json").write_text(json.dumps(meta, default=str))
+        elif spec["kind"] == "predict":
+            model_exec_outputs = Path(spec["model_execution"]) / "outputs"
+            model.load(model_exec_outputs / "model_object.bin")
+            features = inputs.get("features")
+            if features is not None:
+                predictions = model.predict(features=features)
+            else:
+                predictions = model.predict(**(inputs.get("reader_kwargs") or {}))
+            if int(os.environ.get("UNIONML_TPU_PROCESS_ID", "0")) == 0:
+                with open(outputs / "predictions.pkl", "wb") as f:
+                    pickle.dump(predictions, f)
+        else:
+            raise ValueError(f"unknown job kind: {spec['kind']}")
+
+        status.write_text("SUCCEEDED")
+    except Exception:
+        traceback.print_exc()
+        status.write_text("FAILED")
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) != 2:
+        print("usage: python -m unionml_tpu.job_runner <execution_dir>", file=sys.stderr)
+        sys.exit(2)
+    run_job(sys.argv[1])

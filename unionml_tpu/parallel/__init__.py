@@ -1,0 +1,17 @@
+"""Parallelism layer: device meshes, sharding rules, and collectives.
+
+The reference has zero parallelism code — "distribution" there means Flyte schedules
+single-container tasks on k8s (SURVEY.md §2.3). Here parallelism is first-class: every
+trainer/predictor compiles over a named :class:`jax.sharding.Mesh` and XLA emits the
+collectives (all-reduce / reduce-scatter / all-gather over ICI/DCN) implied by the
+sharding annotations.
+"""
+
+from unionml_tpu.parallel.mesh import MeshSpec  # noqa: F401
+from unionml_tpu.parallel.sharding import (  # noqa: F401
+    PartitionRules,
+    batch_sharding,
+    infer_fsdp_sharding,
+    named_sharding,
+    shard_pytree,
+)

@@ -1,0 +1,58 @@
+"""Thin, named wrappers over XLA collectives + ring-topology helpers.
+
+The reference's "communication backend" is a blob store + gRPC control plane
+(SURVEY.md §5.8); the TPU-native data plane is compiler-emitted collectives over
+ICI/DCN. These wrappers exist so framework code names intent (``allreduce_gradients``)
+rather than primitives, and so ring-attention can share one ppermute helper.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+AxisName = Union[str, Sequence[str]]
+
+
+def allreduce_mean(tree: Any, axis: AxisName) -> Any:
+    """Mean-all-reduce a pytree over mesh axis/axes (DP gradient reduction)."""
+    return jax.tree_util.tree_map(lambda x: lax.pmean(x, axis_name=axis), tree)
+
+
+def allreduce_sum(tree: Any, axis: AxisName) -> Any:
+    return jax.tree_util.tree_map(lambda x: lax.psum(x, axis_name=axis), tree)
+
+
+def all_gather(x: jax.Array, axis: AxisName, *, tiled: bool = True, gather_axis: int = 0) -> jax.Array:
+    return lax.all_gather(x, axis_name=axis, axis=gather_axis, tiled=tiled)
+
+
+def reduce_scatter(x: jax.Array, axis: AxisName, *, scatter_axis: int = 0) -> jax.Array:
+    return lax.psum_scatter(x, axis_name=axis, scatter_dimension=scatter_axis, tiled=True)
+
+
+def axis_index(axis: str) -> jax.Array:
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: str) -> int:
+    return lax.axis_size(axis)
+
+
+def ring_permute(x: Any, axis: str, *, shift: int = 1) -> Any:
+    """Rotate a pytree around a mesh-axis ring (block rotation for ring attention).
+
+    Each device sends its value to ``(index + shift) % size`` — with the mesh built by
+    ``mesh_utils`` these transfers ride neighboring ICI links.
+    """
+    size = lax.axis_size(axis)
+    perm = [(i, (i + shift) % size) for i in range(size)]
+    return jax.tree_util.tree_map(lambda leaf: lax.ppermute(leaf, axis_name=axis, perm=perm), x)
+
+
+def all_to_all(x: jax.Array, axis: str, *, split_axis: int, concat_axis: int) -> jax.Array:
+    """All-to-all over a mesh axis — the Ulysses-style sequence<->head reshard."""
+    return lax.all_to_all(x, axis_name=axis, split_axis=split_axis, concat_axis=concat_axis, tiled=True)

@@ -1,0 +1,101 @@
+"""Named TPU device meshes.
+
+Replaces the reference's L1 substrate (flytekit/k8s scheduling, SURVEY.md §1) with the
+JAX mesh model: pick a mesh, annotate shardings, let XLA insert collectives.
+
+Axis conventions (all optional, size-1 axes are free):
+
+- ``data``     — data parallelism; gradients all-reduced over this axis.
+- ``fsdp``     — parameter/optimizer sharding (ZeRO-3 style); params all-gathered
+                 per-layer, gradients reduce-scattered. Batches are sharded over
+                 ``("data", "fsdp")`` jointly.
+- ``model``    — tensor parallelism; per-layer PartitionSpecs split attention heads
+                 and MLP hidden dims.
+- ``sequence`` — sequence/context parallelism for long-context (ring attention
+                 KV-block rotation rides this axis).
+- ``expert``   — expert parallelism for MoE layers.
+
+Cross-slice scaling: ``dcn_data`` adds an outer pure-DP axis over DCN so that only
+gradient all-reduces cross the slower inter-slice network, as recommended by the
+scaling-book recipe.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+#: Canonical axis ordering — outermost (slowest-varying, DCN-adjacent) first.
+AXIS_ORDER: Tuple[str, ...] = ("dcn_data", "data", "fsdp", "sequence", "expert", "model")
+
+#: Axes over which the batch dimension is sharded.
+BATCH_AXES: Tuple[str, ...] = ("dcn_data", "data", "fsdp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh topology. ``-1`` on at most one axis means "all remaining devices"."""
+
+    data: int = -1
+    fsdp: int = 1
+    model: int = 1
+    sequence: int = 1
+    expert: int = 1
+    dcn_data: int = 1
+
+    def axis_sizes(self, n_devices: int) -> "dict[str, int]":
+        sizes = {
+            "dcn_data": self.dcn_data,
+            "data": self.data,
+            "fsdp": self.fsdp,
+            "sequence": self.sequence,
+            "expert": self.expert,
+            "model": self.model,
+        }
+        wildcards = [k for k, v in sizes.items() if v == -1]
+        if len(wildcards) > 1:
+            raise ValueError(f"at most one mesh axis may be -1, got {wildcards}")
+        fixed = math.prod(v for v in sizes.values() if v != -1)
+        if wildcards:
+            if n_devices % fixed:
+                raise ValueError(f"{n_devices} devices not divisible by fixed axes product {fixed}")
+            sizes[wildcards[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(f"mesh axes product {fixed} != device count {n_devices}")
+        return sizes
+
+    def build(self, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+        """Materialize a :class:`jax.sharding.Mesh` over ``devices``.
+
+        Uses :func:`jax.experimental.mesh_utils.create_device_mesh` so the ``model``
+        (innermost) axis lands on physically adjacent chips and rides ICI.
+        """
+        devices = list(jax.devices()) if devices is None else list(devices)
+        sizes = self.axis_sizes(len(devices))
+        shape = tuple(sizes[name] for name in AXIS_ORDER)
+        try:
+            from jax.experimental import mesh_utils
+
+            device_array = mesh_utils.create_device_mesh(shape, devices=devices)
+        except Exception:
+            device_array = np.asarray(devices).reshape(shape)
+        return Mesh(device_array, AXIS_ORDER)
+
+    @property
+    def num_devices_required(self) -> int:
+        sizes = [self.data, self.fsdp, self.model, self.sequence, self.expert, self.dcn_data]
+        if any(s == -1 for s in sizes):
+            return -1
+        return math.prod(sizes)
+
+
+def single_device_mesh() -> Mesh:
+    """A 1-device mesh with the full axis set — lets all sharding code paths run
+    unchanged on one chip (every axis has size 1 except ``data``)."""
+    return MeshSpec(data=1).build(devices=jax.devices()[:1])

@@ -1,0 +1,158 @@
+"""Sharding-rule resolution for parameter/optimizer pytrees.
+
+The reference never looks inside a model (SURVEY.md §5.7); here the framework owns
+parameter layout. Two mechanisms, composable:
+
+1. :class:`PartitionRules` — an ordered table of ``(path-regex, PartitionSpec)`` pairs
+   applied to flattened pytree paths (the idiomatic t5x/maxtext pattern). First match
+   wins; unmatched leaves replicate.
+2. :func:`infer_fsdp_sharding` — automatic ZeRO-3-style layout: each large parameter's
+   largest divisible axis is sharded over the ``fsdp`` mesh axis; small params
+   replicate. Covers user models with no hand-written specs (SURVEY.md §7 hard part 3).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from unionml_tpu.parallel.mesh import BATCH_AXES
+
+
+def named_sharding(mesh: Mesh, *spec: Any) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for a batch: leading (sample) dim over all batch axes, rest replicated.
+
+    The spec is rank-1 (a PartitionSpec shorter than the array rank replicates the
+    trailing dims), so one sharding works for every batch leaf rank >= 1; rank-0 leaves
+    must be placed replicated by the caller.
+    """
+    present = tuple(a for a in BATCH_AXES if a in mesh.axis_names and mesh.shape[a] > 1)
+    lead = present if present else None
+    return NamedSharding(mesh, P(lead))
+
+
+def batch_axis_size(mesh: Mesh) -> int:
+    """Number of shards the batch dim is split into under :func:`batch_sharding`."""
+    size = 1
+    for a in BATCH_AXES:
+        if a in mesh.axis_names:
+            size *= mesh.shape[a]
+    return size
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def _path_str(path: Tuple[Any, ...]) -> str:
+    parts = []
+    for entry in path:
+        if hasattr(entry, "key"):
+            parts.append(str(entry.key))
+        elif hasattr(entry, "idx"):
+            parts.append(str(entry.idx))
+        elif hasattr(entry, "name"):
+            parts.append(str(entry.name))
+        else:
+            parts.append(str(entry))
+    return "/".join(parts)
+
+
+class PartitionRules:
+    """Ordered ``(regex, PartitionSpec)`` table mapped over pytree paths.
+
+    >>> rules = PartitionRules([
+    ...     (r".*attention.*(query|key|value)/kernel", P("fsdp", "model")),
+    ...     (r".*mlp/wi/kernel", P("fsdp", "model")),
+    ...     (r".*mlp/wo/kernel", P("model", "fsdp")),
+    ...     (r".*embedding", P("model", None)),
+    ... ])
+    """
+
+    def __init__(self, rules: Sequence[Tuple[str, P]]):
+        self._rules = [(re.compile(pattern), spec) for pattern, spec in rules]
+
+    def spec_for(self, path: str) -> "Optional[P]":
+        """First matching rule's spec, or ``None`` when no rule matches.
+
+        ``None`` (not ``P()``) is the no-match sentinel so that an explicit user rule
+        requesting replication (``P()``) is honored rather than overridden by
+        inferred FSDP sharding in :func:`combine_fsdp_tp`.
+        """
+        for pattern, spec in self._rules:
+            if pattern.search(path):
+                return spec
+        return None
+
+    def shardings(self, pytree: Any, mesh: Mesh) -> Any:
+        """Resolve a NamedSharding pytree matching ``pytree``'s structure."""
+        paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(pytree)
+        shardings = [
+            NamedSharding(mesh, self.spec_for(_path_str(path)) or P()) for path, _ in paths_leaves
+        ]
+        return jax.tree_util.tree_unflatten(treedef, shardings)
+
+
+def infer_fsdp_sharding(
+    pytree: Any,
+    mesh: Mesh,
+    *,
+    axis: str = "fsdp",
+    min_weight_size: int = 2**14,
+) -> Any:
+    """Automatic FSDP layout: shard each large leaf's largest divisible dim over ``axis``.
+
+    Leaves smaller than ``min_weight_size`` elements (biases, norms) replicate — the
+    all-gather cost would exceed the HBM savings.
+    """
+    axis_size = mesh.shape.get(axis, 1)
+
+    def leaf_sharding(leaf: Any) -> NamedSharding:
+        shape = getattr(leaf, "shape", ())
+        if axis_size <= 1 or not shape or int(np.prod(shape)) < min_weight_size:
+            return NamedSharding(mesh, P())
+        # prefer the largest dim divisible by the axis size; ties -> last dim (lane-friendly)
+        candidates = [(dim_size, idx) for idx, dim_size in enumerate(shape) if dim_size % axis_size == 0]
+        if not candidates:
+            return NamedSharding(mesh, P())
+        _, best = max(candidates, key=lambda t: (t[0], t[1]))
+        spec = [None] * len(shape)
+        spec[best] = axis
+        return NamedSharding(mesh, P(*spec))
+
+    return jax.tree_util.tree_map(leaf_sharding, pytree)
+
+
+def shard_pytree(pytree: Any, shardings: Any) -> Any:
+    """Place a host/device pytree according to a sharding pytree."""
+    return jax.tree_util.tree_map(lambda leaf, s: jax.device_put(leaf, s), pytree, shardings)
+
+
+def combine_fsdp_tp(
+    pytree: Any,
+    mesh: Mesh,
+    rules: Optional[PartitionRules],
+    *,
+    min_weight_size: int = 2**14,
+) -> Any:
+    """Resolve shardings: explicit TP rules where they match, inferred FSDP elsewhere."""
+    if rules is None:
+        return infer_fsdp_sharding(pytree, mesh, min_weight_size=min_weight_size)
+
+    paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(pytree)
+    fsdp = infer_fsdp_sharding(pytree, mesh, min_weight_size=min_weight_size)
+    fsdp_leaves = jax.tree_util.tree_leaves(fsdp)
+    out = []
+    for (path, leaf), fallback in zip(paths_leaves, fsdp_leaves):
+        spec = rules.spec_for(_path_str(path))
+        out.append(fallback if spec is None else NamedSharding(mesh, spec))
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -1,0 +1,386 @@
+"""Remote backend: app versioning, bundle packaging, job submission, model registry.
+
+Parity surface: reference unionml/remote.py + the ``Model.remote_*`` methods — app
+version = git HEAD sha with a dirty-tree guard (remote.py:43-57), deploy packages the
+app and registers its three workflows (remote.py:111-147), the model registry is "the
+latest SUCCEEDED training execution" (remote.py:150-183), and patch/fast registration
+re-ships source without rebuilding the image (remote.py:124-138).
+
+Substrate swap: instead of docker images + a Flyte/k8s control plane, an app deploy is
+a **source bundle** in a filesystem/GCS-style store, and an execution is a **job spec**
+scheduled onto TPU workers:
+
+- store layout (``BackendConfig.store``, default ``~/.unionml_tpu`` or
+  ``$UNIONML_TPU_STORE``)::
+
+    <store>/<project>/<domain>/
+      apps/<model>/<app_version>/bundle/...      # deployed source
+      apps/<model>/<app_version>/manifest.json   # workflows, entrypoint, accelerator
+      executions/<model>/<exec_id>/spec.json     # job spec (workflow, inputs)
+      executions/<model>/<exec_id>/status        # QUEUED|RUNNING|SUCCEEDED|FAILED
+      executions/<model>/<exec_id>/outputs/      # model_object / metrics / predictions
+
+- execution: the driver process launches ``python -m unionml_tpu.job_runner <exec>``
+  per host of the requested slice (one locally for the in-tree executor). Each worker
+  re-imports the app module out of the bundle (resolver pattern,
+  :mod:`unionml_tpu.resolver`), joins ``jax.distributed`` when
+  ``UNIONML_TPU_COORDINATOR`` is set, and runs the requested workflow. This is the
+  task_resolver-equivalent seam that a GKE/QueuedResource scheduler plugs into.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import pickle
+import shutil
+import subprocess
+import sys
+import time
+import uuid
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+from unionml_tpu._logging import logger
+from unionml_tpu.artifact import ModelArtifact
+from unionml_tpu.resolver import locate  # noqa: F401  (re-exported as the get_model analog)
+
+get_model = locate
+
+
+class VersionFetchError(RuntimeError):
+    """Raised when the app version cannot be derived (dirty tree, no git repo)."""
+
+
+@dataclasses.dataclass
+class BackendConfig:
+    """Deployment configuration (reference Model.remote kwargs, model.py:625-654)."""
+
+    registry: Optional[str] = None
+    image_name: Optional[str] = None
+    dockerfile: str = "Dockerfile"
+    patch_destination_dir: str = "/root"
+    config_file: Optional[str] = None
+    project: str = "unionml-tpu"
+    domain: str = "development"
+    store: Optional[str] = None
+    accelerator: Optional[str] = None
+
+    def store_path(self) -> Path:
+        root = self.store or os.environ.get("UNIONML_TPU_STORE") or os.path.join(Path.home(), ".unionml_tpu")
+        return Path(root) / self.project / self.domain
+
+
+@dataclasses.dataclass
+class Execution:
+    """Handle to a submitted job (the FlyteWorkflowExecution analog)."""
+
+    id: str
+    workflow: str
+    path: str
+    #: process handle when launched by this client (local executor only, not serialized)
+    proc: Optional[Any] = dataclasses.field(default=None, repr=False, compare=False)
+
+    @property
+    def status(self) -> str:
+        status_file = Path(self.path) / "status"
+        return status_file.read_text().strip() if status_file.exists() else "UNKNOWN"
+
+    @property
+    def is_done(self) -> bool:
+        return self.status in ("SUCCEEDED", "FAILED")
+
+
+def get_app_version(allow_uncommitted: bool = False, cwd: str = ".") -> str:
+    """App version = git HEAD sha, guarded against dirty trees (reference remote.py:43-57)."""
+
+    def git(*args: str) -> str:
+        return subprocess.run(
+            ["git", *args], cwd=cwd, capture_output=True, text=True, check=True
+        ).stdout.strip()
+
+    try:
+        dirty = bool(git("status", "--porcelain"))
+        if dirty and not allow_uncommitted:
+            raise VersionFetchError("Version number cannot be determined with uncommitted changes present.")
+        if dirty:
+            logger.warning("You have uncommitted changes; using the latest commit as the app version.")
+        return git("rev-parse", "HEAD")
+    except subprocess.CalledProcessError as exc:
+        raise VersionFetchError(f"could not derive app version from git: {exc.stderr}") from exc
+
+
+_BUNDLE_IGNORE = shutil.ignore_patterns(
+    ".git", "__pycache__", "*.pyc", ".pytest_cache", "node_modules", ".venv", "*.egg-info"
+)
+
+
+class Backend:
+    """Filesystem-store backend with a local-subprocess TPU worker launcher."""
+
+    def __init__(self, config: BackendConfig):
+        self.config = config
+        self.root = config.store_path()
+
+    # ------------------------------------------------------------------ deploy
+
+    def _app_dir(self, model: Any, app_version: str) -> Path:
+        return self.root / "apps" / model.name / app_version
+
+    def _executions_dir(self, model_name: str) -> Path:
+        return self.root / "executions" / model_name
+
+    def deploy(
+        self,
+        model: Any,
+        app_version: Optional[str] = None,
+        allow_uncommitted: bool = False,
+        patch: bool = False,
+        source_dir: str = ".",
+    ) -> str:
+        """Package the app source into the store and register its workflows.
+
+        ``patch=True`` mirrors the reference's fast-registration (remote.py:124-138):
+        re-ship source under a ``-patch<hex>`` suffixed version without any image work.
+        """
+        explicit = app_version is not None
+        app_version = app_version or get_app_version(allow_uncommitted=allow_uncommitted or patch, cwd=source_dir)
+        if patch and not explicit:
+            app_version = f"{app_version}-patch{uuid.uuid4().hex[:7]}"
+
+        app_dir = self._app_dir(model, app_version)
+        bundle = app_dir / "bundle"
+        if bundle.exists():
+            shutil.rmtree(bundle)
+        bundle.parent.mkdir(parents=True, exist_ok=True)
+
+        store_root = self.root.resolve()
+
+        def ignore(directory: str, names: List[str]) -> set:
+            ignored = set(_BUNDLE_IGNORE(directory, names))
+            for name in names:
+                # never bundle the backend store itself (it may live inside the app dir)
+                if (Path(directory) / name).resolve() == store_root or (
+                    Path(directory) / name
+                ).resolve() in store_root.parents:
+                    ignored.add(name)
+            return ignored
+
+        shutil.copytree(source_dir, bundle, ignore=ignore)
+
+        app_module = _infer_app_module(model)
+        manifest = {
+            "model_name": model.name,
+            "app_version": app_version,
+            "app_module": app_module,
+            "workflows": [
+                model.train_workflow_name,
+                model.predict_workflow_name,
+                model.predict_from_features_workflow_name,
+            ],
+            "accelerator": self.config.accelerator,
+            "deployed_at": time.time(),
+        }
+        (app_dir / "manifest.json").write_text(json.dumps(manifest, indent=2))
+        logger.info(f"deployed app version {app_version} -> {app_dir}")
+        return app_version
+
+    def latest_app_version(self, model: Any) -> Optional[str]:
+        apps = self.root / "apps" / model.name
+        if not apps.exists():
+            return None
+        versions = sorted(
+            apps.iterdir(), key=lambda p: (p / "manifest.json").stat().st_mtime if (p / "manifest.json").exists() else 0
+        )
+        return versions[-1].name if versions else None
+
+    # ------------------------------------------------------------------ submit
+
+    def _new_execution(self, model: Any, workflow: str, spec: Dict[str, Any]) -> Execution:
+        exec_id = f"{workflow.split('.')[-1]}-{uuid.uuid4().hex[:12]}"
+        exec_dir = self._executions_dir(model.name) / exec_id
+        (exec_dir / "outputs").mkdir(parents=True, exist_ok=True)
+        with open(exec_dir / "spec.pkl", "wb") as f:
+            pickle.dump(spec, f)
+        (exec_dir / "spec.json").write_text(
+            json.dumps({k: v for k, v in spec.items() if k != "inputs"}, indent=2, default=str)
+        )
+        (exec_dir / "status").write_text("QUEUED")
+        return Execution(id=exec_id, workflow=workflow, path=str(exec_dir))
+
+    def _launch(self, model: Any, execution: Execution, app_version: str) -> None:
+        """Spawn the worker process(es) for an execution.
+
+        Single-host local executor today; the multi-host seam is: launch this same
+        command on every host of the slice with ``UNIONML_TPU_COORDINATOR`` /
+        ``UNIONML_TPU_NUM_PROCESSES`` / ``UNIONML_TPU_PROCESS_ID`` set, and
+        ``job_runner`` joins them via ``jax.distributed.initialize``.
+        """
+        bundle = self._app_dir(model, app_version) / "bundle"
+        framework_root = Path(__file__).resolve().parent.parent  # unionml_tpu's parent dir
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            filter(None, [str(bundle), str(framework_root), env.get("PYTHONPATH", "")])
+        )
+        log_file = open(Path(execution.path) / "logs.txt", "w")
+        execution.proc = subprocess.Popen(
+            [sys.executable, "-m", "unionml_tpu.job_runner", execution.path],
+            env=env,
+            stdout=log_file,
+            stderr=subprocess.STDOUT,
+        )
+
+    def submit_train(
+        self,
+        model: Any,
+        app_version: Optional[str] = None,
+        hyperparameters: Optional[Dict[str, Any]] = None,
+        loader_kwargs: Optional[Dict[str, Any]] = None,
+        splitter_kwargs: Optional[Dict[str, Any]] = None,
+        parser_kwargs: Optional[Dict[str, Any]] = None,
+        trainer_kwargs: Optional[Dict[str, Any]] = None,
+        reader_kwargs: Optional[Dict[str, Any]] = None,
+    ) -> Execution:
+        app_version = app_version or self.latest_app_version(model)
+        if app_version is None:
+            raise RuntimeError(f"no deployed app versions for model '{model.name}'; run remote_deploy first")
+        manifest = json.loads((self._app_dir(model, app_version) / "manifest.json").read_text())
+        spec = {
+            "workflow": model.train_workflow_name,
+            "kind": "train",
+            "app_module": manifest["app_module"],
+            "app_version": app_version,
+            "model_name": model.name,
+            "accelerator": manifest.get("accelerator"),
+            "inputs": {
+                "hyperparameters": hyperparameters,
+                "loader_kwargs": loader_kwargs,
+                "splitter_kwargs": splitter_kwargs,
+                "parser_kwargs": parser_kwargs,
+                "trainer_kwargs": trainer_kwargs,
+                "reader_kwargs": reader_kwargs or {},
+            },
+        }
+        execution = self._new_execution(model, model.train_workflow_name, spec)
+        self._launch(model, execution, app_version)
+        logger.info(f"executing {model.train_workflow_name}, execution name: {execution.id}")
+        return execution
+
+    def submit_predict(
+        self,
+        model: Any,
+        app_version: Optional[str] = None,
+        model_version: Optional[str] = None,
+        features: Any = None,
+        reader_kwargs: Optional[Dict[str, Any]] = None,
+    ) -> Execution:
+        app_version = app_version or self.latest_app_version(model)
+        if app_version is None:
+            raise RuntimeError(f"no deployed app versions for model '{model.name}'; run remote_deploy first")
+        manifest = json.loads((self._app_dir(model, app_version) / "manifest.json").read_text())
+        model_exec = self.get_model_execution(model, app_version=None, model_version=model_version or "latest")
+        workflow = model.predict_workflow_name if features is None else model.predict_from_features_workflow_name
+        spec = {
+            "workflow": workflow,
+            "kind": "predict",
+            "app_module": manifest["app_module"],
+            "app_version": app_version,
+            "model_name": model.name,
+            "model_execution": model_exec.path,
+            "accelerator": manifest.get("accelerator"),
+            "inputs": {"features": features, "reader_kwargs": reader_kwargs or {}},
+        }
+        execution = self._new_execution(model, workflow, spec)
+        self._launch(model, execution, app_version)
+        logger.info(f"executing {workflow}, execution name: {execution.id}")
+        return execution
+
+    # ------------------------------------------------------------------ wait / fetch
+
+    def wait(self, execution: Execution, timeout: float = 600.0, poll_interval: float = 0.25) -> Execution:
+        deadline = time.monotonic() + timeout
+        while not execution.is_done:
+            if execution.proc is not None and execution.proc.poll() is not None and not execution.is_done:
+                # worker died before reaching the job body (e.g. interpreter-level failure)
+                (Path(execution.path) / "status").write_text("FAILED")
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"execution {execution.id} did not finish within {timeout}s")
+            time.sleep(poll_interval)
+        if execution.status == "FAILED":
+            log = Path(execution.path) / "logs.txt"
+            tail = log.read_text()[-2000:] if log.exists() else "<no logs>"
+            raise RuntimeError(f"execution {execution.id} FAILED; log tail:\n{tail}")
+        return execution
+
+    def fetch_artifact(self, model: Any, execution: Execution) -> ModelArtifact:
+        """Load the ModelArtifact from a SUCCEEDED training execution
+        (reference Model.remote_load, model.py:872-894)."""
+        outputs = Path(execution.path) / "outputs"
+        model_object = model._loader(outputs / "model_object.bin")
+        meta = json.loads((outputs / "artifact.json").read_text())
+        return ModelArtifact(model_object, meta.get("hyperparameters"), meta.get("metrics"))
+
+    def fetch_predictions(self, execution: Execution) -> Any:
+        with open(Path(execution.path) / "outputs" / "predictions.pkl", "rb") as f:
+            return pickle.load(f)
+
+    def get_model_execution(
+        self, model: Any, app_version: Optional[str] = None, model_version: str = "latest"
+    ) -> Execution:
+        """The model registry: executions are model versions (reference remote.py:150-183)."""
+        exec_root = self._executions_dir(model.name)
+        if model_version and model_version != "latest":
+            exec_dir = exec_root / model_version
+            if not exec_dir.exists():
+                raise ValueError(f"model version '{model_version}' not found for model '{model.name}'")
+            return Execution(id=model_version, workflow=model.train_workflow_name, path=str(exec_dir))
+        candidates = self._successful_train_executions(model)
+        if not candidates:
+            raise ValueError(f"no SUCCEEDED training executions found for model '{model.name}'")
+        return candidates[0]
+
+    def _successful_train_executions(self, model: Any) -> List[Execution]:
+        exec_root = self._executions_dir(model.name)
+        if not exec_root.exists():
+            return []
+        out = []
+        for exec_dir in sorted(exec_root.iterdir(), key=lambda p: p.stat().st_mtime, reverse=True):
+            status = exec_dir / "status"
+            spec = exec_dir / "spec.json"
+            if not (status.exists() and spec.exists()):
+                continue
+            meta = json.loads(spec.read_text())
+            if meta.get("kind") == "train" and status.read_text().strip() == "SUCCEEDED":
+                out.append(Execution(id=exec_dir.name, workflow=meta["workflow"], path=str(exec_dir)))
+        return out
+
+    def fetch_latest_artifact(
+        self, model: Any, app_version: Optional[str] = None, model_version: str = "latest"
+    ) -> ModelArtifact:
+        return self.fetch_artifact(model, self.get_model_execution(model, app_version, model_version))
+
+    def list_model_versions(self, model: Any, app_version: Optional[str] = None, limit: int = 10) -> List[str]:
+        return [e.id for e in self._successful_train_executions(model)[:limit]]
+
+
+def _infer_app_module(model: Any) -> str:
+    """Record where the Model object lives so workers can re-import it
+    (the TrackedInstance ``instantiated_in``/``lhs`` analog, reference task_resolver.py:23-31)."""
+    import inspect as _inspect
+
+    module = getattr(model, "__app_module__", None)
+    if module:
+        return module
+    frame = _inspect.currentframe()
+    while frame is not None:
+        mod_name = frame.f_globals.get("__name__", "")
+        if not mod_name.startswith("unionml_tpu"):
+            for var_name, var in frame.f_globals.items():
+                if var is model:
+                    return f"{mod_name}:{var_name}"
+        frame = frame.f_back
+    raise RuntimeError(
+        "could not infer the app module for this model; set model.__app_module__ = 'module:variable'"
+    )

@@ -1,0 +1,12 @@
+"""TPU model serving: HTTP app + dynamic micro-batching.
+
+Parity surface: reference unionml/fastapi.py:15-70 (``serving_app`` registering
+``POST /predict``, ``GET /health``, ``GET /`` and a startup hook that loads the model
+from ``UNIONML_MODEL_PATH`` or the remote backend). FastAPI/uvicorn are not part of our
+dependency set, so the server is a small stdlib-asyncio HTTP implementation — which
+also gives us what FastAPI never could: a dynamic micro-batching queue between the
+socket and the TPU so concurrent single-row requests ride one MXU dispatch.
+"""
+
+from unionml_tpu.serving.app import ServingApp, serving_app  # noqa: F401
+from unionml_tpu.serving.batcher import MicroBatcher, ServingConfig  # noqa: F401

@@ -1,0 +1,211 @@
+"""The model serving application.
+
+Parity: reference unionml/fastapi.py:15-70 — routes ``POST /predict`` (accepting
+``inputs`` = reader kwargs or ``features`` = raw records), ``GET /health``, and a
+``GET /`` banner; startup loads the model from ``UNIONML_MODEL_PATH`` or from the
+remote backend's model registry.
+
+Deviations, both deliberate:
+
+- the reference pushes features through ``dataset.get_features`` twice (fastapi.py:61
+  and again inside ``model.predict`` — SURVEY.md §3.2 notes the quirk); we process
+  them exactly once.
+- prediction requests flow through a :class:`~unionml_tpu.serving.batcher.MicroBatcher`
+  when the predictor has a :class:`ServingConfig`, so concurrent requests share TPU
+  dispatches; the predictor is warmed up at startup over the configured bucket sizes
+  to avoid request-path XLA compiles.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from http import HTTPStatus
+from typing import Any, Optional
+
+from unionml_tpu._logging import logger
+from unionml_tpu.artifact import ModelArtifact
+from unionml_tpu.defaults import MODEL_PATH_ENV_VAR
+from unionml_tpu.serving.batcher import MicroBatcher, ServingConfig
+from unionml_tpu.serving.http import HTTPError, HTTPServer
+
+_BANNER = """
+<html>
+  <head><title>unionml-tpu</title></head>
+  <body>
+    <h1>unionml-tpu</h1>
+    <p>The easiest way to build and deploy models — on TPU.</p>
+  </body>
+</html>
+"""
+
+
+class ServingApp:
+    """HTTP serving app bound to a :class:`unionml_tpu.model.Model`."""
+
+    def __init__(
+        self,
+        model: Any,
+        remote: bool = False,
+        app_version: Optional[str] = None,
+        model_version: str = "latest",
+        batcher: Optional[MicroBatcher] = None,
+    ):
+        self.model = model
+        self.remote = remote
+        self.app_version = app_version
+        self.model_version = model_version
+        self.server = HTTPServer()
+        self._started = False
+
+        config = getattr(model, "_predictor_config", None)
+        if batcher is not None:
+            self.batcher: Optional[MicroBatcher] = batcher
+        elif isinstance(config, ServingConfig):
+            self.batcher = MicroBatcher(self._predict_features_sync, config)
+        else:
+            self.batcher = None
+
+        self.server.route("GET", "/", self._root)
+        self.server.route("GET", "/health", self._health)
+        self.server.route("POST", "/predict", self._predict)
+
+    # ------------------------------------------------------------------ lifecycle
+
+    def startup(self) -> None:
+        """Load the model artifact (reference fastapi.py:22-34 startup hook)."""
+        if self._started:
+            return
+        if self.model.artifact is None:
+            model_path = os.getenv(MODEL_PATH_ENV_VAR)
+            if self.remote:
+                self.model.artifact = self.model._backend.fetch_latest_artifact(
+                    self.model, app_version=self.app_version, model_version=self.model_version
+                )
+            elif model_path is not None:
+                self.model.load(model_path)
+            else:
+                raise ValueError(
+                    "Model artifact path not specified. Make sure to specify the unionml-tpu serve "
+                    "--model-path option when starting the prediction service in local mode."
+                )
+        self._warmup()
+        self._started = True
+
+    def _warmup(self) -> None:
+        """AOT-compile the predictor over the configured batch-size buckets.
+
+        TPU cold-compiles are tens of seconds (SURVEY.md §7 hard part 4); paying them
+        at startup keeps request p50 flat.
+        """
+        config = getattr(self.model, "_predictor_config", None)
+        if not isinstance(config, ServingConfig) or not config.warmup:
+            return
+        warmup_fn = getattr(self.model, "_predictor_warmup", None)
+        if warmup_fn is None:
+            return
+        for bucket in config.buckets():
+            try:
+                warmup_fn(bucket)
+            except Exception as exc:  # warmup is best-effort
+                logger.warning(f"predictor warmup failed for bucket {bucket}: {exc}")
+
+    def _predict_features_sync(self, features: Any) -> Any:
+        return self.model.predict(features=features)
+
+    # ------------------------------------------------------------------ handlers
+
+    async def _root(self, body: bytes):
+        return 200, _BANNER, "text/html"
+
+    async def _health(self, body: bytes):
+        if self.model.artifact is None:
+            raise HTTPError(500, "Model artifact not found.")
+        return 200, {"message": HTTPStatus.OK.phrase, "status": int(HTTPStatus.OK)}, "application/json"
+
+    async def _predict(self, body: bytes):
+        try:
+            payload = json.loads(body.decode() or "{}")
+        except json.JSONDecodeError as exc:
+            raise HTTPError(400, f"invalid JSON body: {exc}")
+        if not isinstance(payload, dict):
+            raise HTTPError(400, "request body must be a JSON object")
+
+        inputs = payload.get("inputs")
+        features = payload.get("features")
+        if inputs is None and features is None:
+            raise HTTPError(500, "inputs or features must be supplied.")
+        if self.model.artifact is None:
+            raise HTTPError(500, "Model artifact not found.")
+
+        try:
+            if inputs is not None:
+                predictions = self.model.predict(**inputs)
+            elif self.batcher is not None:
+                predictions = await self.batcher.submit(self.model._dataset.get_features(features))
+            else:
+                predictions = self.model.predict(features=features)
+        except HTTPError:
+            raise
+        except Exception as exc:
+            raise HTTPError(500, f"prediction failed: {type(exc).__name__}: {exc}")
+        return 200, _to_jsonable(predictions), "application/json"
+
+    # ------------------------------------------------------------------ entry points
+
+    def run(self, host: str = "127.0.0.1", port: int = 8000) -> None:
+        """Blocking server loop (used by the ``serve`` CLI command)."""
+        self.startup()
+        self.server.run(host, port)
+
+    async def dispatch(self, method: str, path: str, body: bytes = b""):
+        """In-process request dispatch — the test-client surface."""
+        self.startup()
+        return await self.server.dispatch(method, path, body)
+
+
+def _to_jsonable(obj: Any) -> Any:
+    import numpy as np
+
+    try:
+        import pandas as pd
+
+        if isinstance(obj, (pd.DataFrame, pd.Series)):
+            return json.loads(obj.to_json(orient="records"))
+    except ImportError:  # pragma: no cover
+        pass
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, (np.generic,)):
+        return obj.item()
+    if isinstance(obj, (list, tuple)):
+        return [_to_jsonable(x) for x in obj]
+    if isinstance(obj, dict):
+        return {k: _to_jsonable(v) for k, v in obj.items()}
+    try:
+        import jax
+
+        if isinstance(obj, jax.Array):
+            return np.asarray(obj).tolist()
+    except ImportError:  # pragma: no cover
+        pass
+    return obj
+
+
+def serving_app(
+    model: Any,
+    app: Any = None,
+    remote: bool = False,
+    app_version: Optional[str] = None,
+    model_version: str = "latest",
+    batcher: Optional[MicroBatcher] = None,
+) -> ServingApp:
+    """Create (or bind) the serving app for a model.
+
+    ``app`` exists for signature parity with the reference (which mutates a FastAPI
+    instance, unionml/fastapi.py:15); passing an existing :class:`ServingApp` rebinds
+    it, anything else is ignored in favor of a fresh app.
+    """
+    if isinstance(app, ServingApp):
+        return app
+    return ServingApp(model, remote=remote, app_version=app_version, model_version=model_version, batcher=batcher)

@@ -1,0 +1,146 @@
+"""Dynamic micro-batching for TPU serving.
+
+The reference serves one request at a time straight through the predictor
+(unionml/fastapi.py:50-64) — fine for sklearn on CPU, wasteful on TPU where a
+batch-1 dispatch occupies the whole MXU. The batcher coalesces concurrent requests:
+
+1. each request's features enqueue with a future,
+2. a collector drains the queue until ``max_batch_size`` rows or ``max_wait_ms``
+   elapse (first-come request never waits longer than the window),
+3. one predictor call runs on the concatenated batch,
+4. per-request slices of the output resolve the futures.
+
+Padding note: the predictor compilation path buckets batch sizes (pow2 up to
+``max_batch_size``) so XLA reuses a handful of compiled shapes instead of
+recompiling per arrival pattern; see :meth:`unionml_tpu.serving.app.ServingApp`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+from unionml_tpu.parallel.mesh import MeshSpec
+
+
+@dataclasses.dataclass
+class ServingConfig:
+    """Execution config attached to ``@model.predictor(config=...)``.
+
+    ``bucket_sizes`` are the padded batch sizes the predictor is compiled for at
+    startup (AOT warmup), avoiding cold-compiles on the request path.
+    """
+
+    max_batch_size: int = 32
+    max_wait_ms: float = 2.0
+    bucket_sizes: Optional[Sequence[int]] = None
+    mesh: Optional[MeshSpec] = None
+    warmup: bool = True
+
+    def buckets(self) -> List[int]:
+        if self.bucket_sizes:
+            return sorted(set(self.bucket_sizes))
+        sizes, n = [], 1
+        while n < self.max_batch_size:
+            sizes.append(n)
+            n *= 2
+        sizes.append(self.max_batch_size)
+        return sizes
+
+
+def _num_rows(features: Any) -> int:
+    try:
+        return len(features)
+    except TypeError:
+        return 1
+
+
+def _concat(parts: List[Any]) -> Any:
+    if len(parts) == 1:
+        return parts[0]
+    first = parts[0]
+    try:
+        import pandas as pd
+
+        if isinstance(first, pd.DataFrame):
+            return pd.concat(parts, ignore_index=True)
+    except ImportError:  # pragma: no cover
+        pass
+    import numpy as np
+
+    if isinstance(first, np.ndarray):
+        return np.concatenate(parts, axis=0)
+    if isinstance(first, list):
+        return [row for part in parts for row in part]
+    raise TypeError(f"micro-batcher cannot concatenate features of type {type(first)}")
+
+
+def _split(result: Any, sizes: List[int]) -> List[Any]:
+    out, lo = [], 0
+    for n in sizes:
+        out.append(result[lo : lo + n])
+        lo += n
+    return out
+
+
+class MicroBatcher:
+    """Coalesce concurrent predict calls into single batched predictor dispatches."""
+
+    def __init__(self, predict_fn: Callable[[Any], Any], config: Optional[ServingConfig] = None):
+        self._predict_fn = predict_fn
+        self.config = config or ServingConfig()
+        self._queue: "asyncio.Queue[Tuple[Any, int, asyncio.Future]]" = asyncio.Queue()
+        self._worker: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        if self._worker is None or self._worker.done():
+            self._worker = asyncio.get_event_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._worker is not None:
+            self._worker.cancel()
+            try:
+                await self._worker
+            except asyncio.CancelledError:
+                pass
+            self._worker = None
+
+    async def submit(self, features: Any) -> Any:
+        """Enqueue features; resolves with this request's slice of the batched output."""
+        self.start()
+        future: asyncio.Future = asyncio.get_event_loop().create_future()
+        await self._queue.put((features, _num_rows(features), future))
+        return await future
+
+    async def _run(self) -> None:
+        while True:
+            features, n, future = await self._queue.get()
+            batch = [(features, n, future)]
+            total = n
+            deadline = asyncio.get_event_loop().time() + self.config.max_wait_ms / 1000.0
+            while total < self.config.max_batch_size:
+                timeout = deadline - asyncio.get_event_loop().time()
+                if timeout <= 0:
+                    break
+                try:
+                    item = await asyncio.wait_for(self._queue.get(), timeout)
+                except asyncio.TimeoutError:
+                    break
+                batch.append(item)
+                total += item[1]
+
+            parts = [b[0] for b in batch]
+            sizes = [b[1] for b in batch]
+            futures = [b[2] for b in batch]
+            try:
+                combined = _concat(parts)
+                # run the (potentially blocking) TPU dispatch off the event loop
+                result = await asyncio.get_event_loop().run_in_executor(None, self._predict_fn, combined)
+                for fut, piece in zip(futures, _split(result, sizes)):
+                    if not fut.done():
+                        fut.set_result(piece)
+            except Exception as exc:  # propagate the batch failure to every caller
+                for fut in futures:
+                    if not fut.done():
+                        fut.set_exception(exc)

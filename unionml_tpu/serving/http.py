@@ -1,0 +1,135 @@
+"""Minimal asyncio HTTP/1.1 server for model serving.
+
+Replaces the reference's FastAPI/uvicorn dependency (unionml/fastapi.py) with a
+self-contained server: request-line + header parsing, Content-Length bodies, JSON
+responses, graceful shutdown. Deliberately small — the serving surface is three
+routes — and dependency-free so the serving container stays lean on TPU VMs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
+
+from unionml_tpu._logging import logger
+
+Handler = Callable[[bytes], Awaitable[Tuple[int, Any, str]]]
+
+_STATUS_PHRASES = {
+    200: "OK",
+    400: "Bad Request",
+    404: "Not Found",
+    405: "Method Not Allowed",
+    500: "Internal Server Error",
+}
+
+MAX_BODY_BYTES = 64 * 1024 * 1024
+
+
+class HTTPServer:
+    """Route table + asyncio socket loop."""
+
+    def __init__(self) -> None:
+        self._routes: Dict[Tuple[str, str], Handler] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    def route(self, method: str, path: str, handler: Handler) -> None:
+        self._routes[(method.upper(), path)] = handler
+
+    async def _read_request(self, reader: asyncio.StreamReader) -> Optional[Tuple[str, str, bytes]]:
+        request_line = await reader.readline()
+        if not request_line:
+            return None
+        try:
+            method, target, _version = request_line.decode("latin1").split(" ", 2)
+        except ValueError:
+            raise ValueError("malformed request line")
+        path = target.split("?", 1)[0]
+
+        content_length = 0
+        while True:
+            header_line = await reader.readline()
+            if header_line in (b"\r\n", b"\n", b""):
+                break
+            name, _, value = header_line.decode("latin1").partition(":")
+            if name.strip().lower() == "content-length":
+                content_length = int(value.strip())
+        if content_length > MAX_BODY_BYTES:
+            raise ValueError("request body too large")
+        body = await reader.readexactly(content_length) if content_length else b""
+        return method.upper(), path, body
+
+    @staticmethod
+    def _encode_response(status: int, payload: Any, content_type: str = "application/json") -> bytes:
+        if content_type == "application/json":
+            body = json.dumps(payload, default=str).encode()
+        elif isinstance(payload, bytes):
+            body = payload
+        else:
+            body = str(payload).encode()
+        head = (
+            f"HTTP/1.1 {status} {_STATUS_PHRASES.get(status, 'Unknown')}\r\n"
+            f"Content-Type: {content_type}\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            "Connection: close\r\n\r\n"
+        )
+        return head.encode("latin1") + body
+
+    async def dispatch(self, method: str, path: str, body: bytes) -> Tuple[int, Any, str]:
+        """Route a request; usable directly by tests (in-process 'test client')."""
+        handler = self._routes.get((method, path))
+        if handler is None:
+            if any(p == path for (_, p) in self._routes):
+                return 405, {"detail": f"method {method} not allowed for {path}"}, "application/json"
+            return 404, {"detail": f"no route for {path}"}, "application/json"
+        try:
+            return await handler(body)
+        except HTTPError as exc:
+            return exc.status, {"detail": exc.detail}, "application/json"
+        except Exception as exc:  # pragma: no cover - defensive
+            logger.exception("handler error")
+            return 500, {"detail": f"{type(exc).__name__}: {exc}"}, "application/json"
+
+    async def _on_connection(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        try:
+            request = await self._read_request(reader)
+            if request is None:
+                return
+            method, path, body = request
+            status, payload, content_type = await self.dispatch(method, path, body)
+            writer.write(self._encode_response(status, payload, content_type))
+            await writer.drain()
+        except (ValueError, asyncio.IncompleteReadError) as exc:
+            try:
+                writer.write(self._encode_response(400, {"detail": str(exc)}))
+                await writer.drain()
+            except Exception:
+                pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def serve(self, host: str = "127.0.0.1", port: int = 8000) -> None:
+        self._server = await asyncio.start_server(self._on_connection, host, port)
+        logger.info(f"serving on http://{host}:{port}")
+        async with self._server:
+            await self._server.serve_forever()
+
+    def run(self, host: str = "127.0.0.1", port: int = 8000) -> None:
+        try:
+            asyncio.run(self.serve(host, port))
+        except KeyboardInterrupt:  # pragma: no cover
+            logger.info("server stopped")
+
+
+class HTTPError(Exception):
+    """Raise inside a handler to produce a non-200 JSON response."""
+
+    def __init__(self, status: int, detail: str):
+        super().__init__(detail)
+        self.status = status
+        self.detail = detail

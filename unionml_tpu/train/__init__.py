@@ -1,0 +1,3 @@
+"""TPU trainer compilation + execution driver."""
+
+from unionml_tpu.train.driver import FitResult, TrainerConfig, evaluate, fit, make_train_step  # noqa: F401

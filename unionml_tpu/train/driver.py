@@ -1,0 +1,317 @@
+"""The pjit train-step driver: compile a (state, batch) -> (state, metrics) step over a
+named mesh and run the donate-and-loop epoch schedule.
+
+This layer is what the reference outsources wholesale to the user's ML framework inside
+a Flyte task (reference unionml/model.py:425-440 simply calls
+``self._trainer(model_object, *train_data)`` once, eagerly). Here the contract is
+step-based so the whole hot loop is XLA:
+
+- The user (or a model-library preset) supplies ``step_fn(state, batch) -> (state,
+  metrics)``; :func:`make_train_step` builds the canonical one from a loss function.
+- :func:`fit` constructs the mesh, resolves parameter shardings (explicit TP rules +
+  inferred FSDP, :mod:`unionml_tpu.parallel.sharding`), compiles the step with
+  ``jax.jit(donate_argnums=0, in_shardings=..., out_shardings=...)``, and loops over a
+  host->HBM prefetch iterator. Buffer donation means the optimizer update is in-place
+  in HBM; XLA inserts all the DP/FSDP collectives implied by the shardings.
+
+Auxiliary subsystems the reference lacks (SURVEY.md §5): per-step profiler annotations,
+step-level orbax checkpointing with resume, NaN guards, and a throughput metrics sink.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from unionml_tpu._logging import logger
+from unionml_tpu.parallel.mesh import MeshSpec
+from unionml_tpu.parallel.sharding import PartitionRules, batch_sharding, combine_fsdp_tp, shard_pytree
+
+
+@dataclasses.dataclass
+class TrainerConfig:
+    """Execution config attached to a step-mode ``@model.trainer``.
+
+    This is the TPU analog of the reference's per-task kwargs (``requests``/``limits``
+    resources, unionml/model.py:227) — but instead of k8s pod sizes it declares the
+    compilation/measurement envelope of the training loop.
+    """
+
+    epochs: int = 1
+    batch_size: int = 32
+    mesh: Optional[MeshSpec] = None
+    partition_rules: Optional[PartitionRules] = None
+    fsdp_min_weight_size: int = 2**14
+    grad_accum_steps: int = 1
+    donate: bool = True
+    shuffle: bool = True
+    seed: int = 0
+    drop_remainder: bool = True
+    prefetch: int = 2
+    shard_batch_by_process: bool = False
+    # checkpoint / resume (step-level; the reference only has final-artifact save)
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every_steps: int = 0
+    max_checkpoints_to_keep: int = 3
+    resume: bool = False
+    # observability
+    log_every_steps: int = 0
+    profile_dir: Optional[str] = None
+    profile_steps: Tuple[int, int] = (10, 15)
+    # debug: the TPU analog of a race detector is donation/NaN misuse (SURVEY.md §5.2)
+    debug_nans: bool = False
+    debug_disable_donation: bool = False
+
+
+@dataclasses.dataclass
+class FitResult:
+    state: Any
+    history: List[Dict[str, float]]
+    steps: int
+    samples_per_sec: float
+    samples_per_sec_per_chip: float
+    compile_time_s: float
+
+
+def make_train_step(
+    loss_fn: Callable[..., Any],
+    *,
+    has_aux: bool = False,
+    grad_accum_steps: int = 1,
+    remat: bool = False,
+) -> Callable[[Any, Any], Tuple[Any, Dict[str, jax.Array]]]:
+    """Build the canonical ``(state, batch) -> (state, metrics)`` step from a loss fn.
+
+    ``loss_fn(params, batch, rngs...)`` -> loss (or ``(loss, aux_dict)`` with
+    ``has_aux=True``). ``state`` must expose ``params`` and ``apply_gradients`` (the
+    flax ``TrainState`` protocol). Gradient accumulation runs microbatches under
+    ``lax.scan`` so the unrolled loop stays a single XLA computation; ``remat``
+    checkpoints the loss computation to trade FLOPs for HBM.
+    """
+    base_loss = jax.checkpoint(loss_fn) if remat else loss_fn
+    grad_fn = jax.value_and_grad(base_loss, has_aux=has_aux)
+
+    def single_step(state: Any, batch: Any) -> Tuple[Any, Dict[str, jax.Array]]:
+        if has_aux:
+            (loss, aux), grads = grad_fn(state.params, batch)
+        else:
+            loss, grads = grad_fn(state.params, batch)
+            aux = {}
+        state = state.apply_gradients(grads=grads)
+        return state, {"loss": loss, **aux}
+
+    if grad_accum_steps <= 1:
+        return single_step
+
+    def accum_step(state: Any, batch: Any) -> Tuple[Any, Dict[str, jax.Array]]:
+        def split(leaf: jax.Array) -> jax.Array:
+            b = leaf.shape[0]
+            return leaf.reshape((grad_accum_steps, b // grad_accum_steps) + leaf.shape[1:])
+
+        microbatches = jax.tree_util.tree_map(split, batch)
+
+        def body(carry, microbatch):
+            grads_acc, loss_acc = carry
+            if has_aux:
+                (loss, aux), grads = grad_fn(state.params, microbatch)
+            else:
+                loss, grads = grad_fn(state.params, microbatch)
+                aux = {}
+            grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
+            return (grads_acc, loss_acc + loss), aux
+
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+        (grads, loss_sum), aux_stacked = jax.lax.scan(body, (zeros, jnp.zeros(())), microbatches)
+        grads = jax.tree_util.tree_map(lambda g: g / grad_accum_steps, grads)
+        new_state = state.apply_gradients(grads=grads)
+        aux_mean = jax.tree_util.tree_map(lambda a: a.mean(axis=0), aux_stacked)
+        return new_state, {"loss": loss_sum / grad_accum_steps, **aux_mean}
+
+    return accum_step
+
+
+def _tree_device_shardings(state: Any, mesh, rules: Optional[PartitionRules], min_weight: int):
+    return combine_fsdp_tp(state, mesh, rules, min_weight_size=min_weight)
+
+
+def _make_checkpoint_manager(config: TrainerConfig):
+    if not config.checkpoint_dir or config.checkpoint_every_steps <= 0:
+        return None
+    import orbax.checkpoint as ocp
+
+    options = ocp.CheckpointManagerOptions(
+        max_to_keep=config.max_checkpoints_to_keep,
+        enable_async_checkpointing=True,
+    )
+    return ocp.CheckpointManager(config.checkpoint_dir, options=options)
+
+
+def fit(
+    state: Any,
+    step_fn: Callable[[Any, Any], Tuple[Any, Dict[str, jax.Array]]],
+    data: Any,
+    config: TrainerConfig,
+) -> FitResult:
+    """Compile ``step_fn`` over the configured mesh and run the training loop.
+
+    :param state: initial train state pytree (e.g. ``flax.training.train_state.TrainState``).
+    :param data: per-split data list (``[features, targets, ...]``) from
+        :meth:`unionml_tpu.dataset.Dataset.get_data`, or any pytree of arrays with a
+        shared leading sample dim.
+    """
+    from unionml_tpu.data.pipeline import PrefetchIterator
+
+    mesh = (config.mesh or MeshSpec()).build()
+    n_chips = mesh.size
+
+    with mesh:
+        state_shardings = _tree_device_shardings(state, mesh, config.partition_rules, config.fsdp_min_weight_size)
+        state = shard_pytree(state, state_shardings)
+        batch_sh = batch_sharding(mesh)
+
+        donate = (0,) if (config.donate and not config.debug_disable_donation) else ()
+        # batch in_sharding is left unconstrained: batches arrive pre-placed by the
+        # prefetch iterator (data-axis sharded normally, replicated for indivisible
+        # final partial batches), and constraining it here would reject the fallback
+        compiled_step = jax.jit(
+            step_fn,
+            donate_argnums=donate,
+            in_shardings=(state_shardings, None),
+            out_shardings=(state_shardings, None),
+        )
+
+        manager = _make_checkpoint_manager(config)
+        start_step = 0
+        if manager is not None and config.resume:
+            latest = manager.latest_step()
+            if latest is not None:
+                import orbax.checkpoint as ocp
+
+                abstract = jax.tree_util.tree_map(
+                    lambda x, s: jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x), sharding=s),
+                    state,
+                    state_shardings,
+                )
+                state = manager.restore(latest, args=ocp.args.StandardRestore(abstract))
+                start_step = latest
+                logger.info(f"resumed train state from checkpoint step {latest}")
+
+        iterator = PrefetchIterator(
+            data,
+            batch_size=config.batch_size,
+            sharding=batch_sh,
+            drop_remainder=config.drop_remainder,
+            shuffle=config.shuffle,
+            seed=config.seed,
+            prefetch=config.prefetch,
+            shard_by_process=config.shard_batch_by_process,
+            epochs=config.epochs,
+            skip_batches=start_step,  # resume reproduces the seeded schedule, minus consumed batches
+        )
+
+        history: List[Dict[str, float]] = []
+        step_idx = start_step  # number of completed optimizer steps
+        compile_time = 0.0
+        samples_seen = 0
+        first_batch_samples = 0
+        loop_start: Optional[float] = None
+        last_metrics: Any = None
+        trace_active = False
+
+        prev_debug_nans = jax.config.jax_debug_nans
+        if config.debug_nans:
+            jax.config.update("jax_debug_nans", True)
+        try:
+            for batch in iterator:
+                if config.profile_dir and step_idx == config.profile_steps[0] and not trace_active:
+                    jax.profiler.start_trace(config.profile_dir)
+                    trace_active = True
+                batch_n = int(jax.tree_util.tree_leaves(batch)[0].shape[0])
+                with jax.profiler.TraceAnnotation("unionml_tpu.train_step"):
+                    if loop_start is None:
+                        t0 = time.perf_counter()
+                        state, last_metrics = compiled_step(state, batch)
+                        jax.block_until_ready(last_metrics)
+                        compile_time = time.perf_counter() - t0
+                        loop_start = time.perf_counter()
+                        first_batch_samples = batch_n
+                    else:
+                        state, last_metrics = compiled_step(state, batch)
+                step_idx += 1
+                samples_seen += batch_n
+                if config.log_every_steps and (step_idx % config.log_every_steps == 0):
+                    host_metrics = {k: float(v) for k, v in last_metrics.items()}
+                    history.append({"step": step_idx, **host_metrics})
+                    logger.info(f"step {step_idx}: {host_metrics}")
+                if manager is not None and config.checkpoint_every_steps and (
+                    step_idx % config.checkpoint_every_steps == 0
+                ):
+                    import orbax.checkpoint as ocp
+
+                    manager.save(step_idx, args=ocp.args.StandardSave(state))
+                if config.profile_dir and trace_active and step_idx > config.profile_steps[1]:
+                    jax.profiler.stop_trace()
+                    trace_active = False
+        finally:
+            if trace_active:
+                jax.profiler.stop_trace()
+            if config.debug_nans:
+                jax.config.update("jax_debug_nans", prev_debug_nans)
+
+        if last_metrics is not None:
+            jax.block_until_ready(last_metrics)
+            host_metrics = {k: float(v) for k, v in last_metrics.items()}
+            if not history or history[-1].get("step") != step_idx:
+                history.append({"step": step_idx, **host_metrics})
+
+        if manager is not None:
+            import orbax.checkpoint as ocp
+
+            if manager.latest_step() != step_idx:
+                manager.save(step_idx, args=ocp.args.StandardSave(state), force=True)
+            manager.wait_until_finished()
+
+        post_compile_samples = samples_seen - first_batch_samples
+        elapsed = (time.perf_counter() - loop_start) if loop_start is not None else 0.0
+        sps = post_compile_samples / elapsed if elapsed > 0 and post_compile_samples > 0 else 0.0
+
+    return FitResult(
+        state=state,
+        history=history,
+        steps=step_idx - start_step,
+        samples_per_sec=sps,
+        samples_per_sec_per_chip=sps / max(n_chips, 1),
+        compile_time_s=compile_time,
+    )
+
+
+def evaluate(
+    state: Any,
+    eval_step: Callable[[Any, Any], Dict[str, jax.Array]],
+    data: Any,
+    *,
+    batch_size: int = 128,
+    mesh: Optional[MeshSpec] = None,
+) -> Dict[str, float]:
+    """Run a jitted eval step over a split and average the metrics."""
+    from unionml_tpu.data.pipeline import PrefetchIterator
+
+    built = (mesh or MeshSpec()).build()
+    with built:
+        batch_sh = batch_sharding(built)
+        compiled = jax.jit(eval_step)
+        totals: Dict[str, float] = {}
+        count = 0
+        for batch in PrefetchIterator(data, batch_size=batch_size, sharding=batch_sh, drop_remainder=False):
+            metrics = compiled(state, batch)
+            n = jax.tree_util.tree_leaves(batch)[0].shape[0]
+            for k, v in metrics.items():
+                totals[k] = totals.get(k, 0.0) + float(v) * n
+            count += n
+    return {k: v / max(count, 1) for k, v in totals.items()}

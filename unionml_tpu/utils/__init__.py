@@ -1,0 +1,121 @@
+"""Shared utilities: JSON-able dataclass synthesis and model-framework sniffing.
+
+The reference leans on ``dataclasses_json`` (unionml/model.py:158-160,
+unionml/dataset.py:243) to make its dynamically synthesized kwargs/hyperparameter
+dataclasses JSON round-trippable. That package is not part of our dependency set, so we
+provide a minimal, self-contained equivalent here (:func:`json_dataclass`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, Type
+
+__all__ = [
+    "resolved_signature",
+    "json_dataclass",
+    "dataclass_to_dict",
+    "dataclass_from_dict",
+    "is_sklearn_model",
+    "is_pytorch_model",
+    "is_keras_model",
+    "is_flax_module",
+]
+
+
+def dataclass_to_dict(obj: Any) -> Dict[str, Any]:
+    """Convert a dataclass instance to a plain dict (shallow for non-dataclass leaves)."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {f.name: getattr(obj, f.name) for f in dataclasses.fields(obj)}
+    if isinstance(obj, dict):
+        return obj
+    raise TypeError(f"expected a dataclass instance or dict, got {type(obj)}")
+
+
+def dataclass_from_dict(cls: Type, data: Dict[str, Any]):
+    """Instantiate ``cls`` from a dict, ignoring unknown keys."""
+    names = {f.name for f in dataclasses.fields(cls)}
+    return cls(**{k: v for k, v in data.items() if k in names})
+
+
+def json_dataclass(cls: Type) -> Type:
+    """Attach ``to_dict``/``from_dict``/``to_json``/``from_json`` methods to a dataclass.
+
+    Drop-in stand-in for ``dataclasses_json.dataclass_json`` as used by the reference
+    (unionml/model.py:158, unionml/dataset.py:243-271) for its synthesized
+    Hyperparameters / LoaderKwargs / SplitterKwargs / ParserKwargs types.
+    """
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclass_to_dict(self)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict())
+
+    @classmethod
+    def from_dict(kls, data: Dict[str, Any]):
+        return dataclass_from_dict(kls, data)
+
+    @classmethod
+    def from_json(kls, payload: str):
+        return kls.from_dict(json.loads(payload))
+
+    cls.to_dict = to_dict
+    cls.to_json = to_json
+    cls.from_dict = from_dict
+    cls.from_json = from_json
+    return cls
+
+
+def _base_module(model_type: type) -> str:
+    bases = getattr(model_type, "__bases__", None)
+    if bases:
+        return bases[0].__module__
+    return ""
+
+
+def is_sklearn_model(model_type: Any) -> bool:
+    try:
+        import sklearn.base
+
+        return isinstance(model_type, type) and issubclass(model_type, sklearn.base.BaseEstimator)
+    except ImportError:  # pragma: no cover
+        return False
+
+
+def is_pytorch_model(model_type: Any) -> bool:
+    """Framework sniffing, parity with reference unionml/utils.py:62-63."""
+    if not isinstance(model_type, type):
+        return False
+    return model_type.__module__.startswith("torch") or _base_module(model_type).startswith("torch")
+
+
+def is_keras_model(model_type: Any) -> bool:
+    """Parity with reference unionml/utils.py:66-67."""
+    if not isinstance(model_type, type):
+        return False
+    return model_type.__module__.startswith("keras") or _base_module(model_type).startswith("keras")
+
+
+def is_flax_module(model_type: Any) -> bool:
+    """TPU-native addition: detect flax ``nn.Module`` subclasses (our first-class path)."""
+    if not isinstance(model_type, type):
+        return False
+    return model_type.__module__.startswith("flax") or _base_module(model_type).startswith("flax")
+
+
+def resolved_signature(fn):
+    """``inspect.signature`` with PEP 563 string annotations resolved when possible.
+
+    Functions defined under ``from __future__ import annotations`` carry *string*
+    annotations; signature-derived typing (the core trick of this framework) needs the
+    real objects. Falls back to the raw signature when resolution fails (e.g. local
+    classes defined in function scope).
+    """
+    import inspect as _inspect
+
+    try:
+        return _inspect.signature(fn, eval_str=True)
+    except Exception:
+        return _inspect.signature(fn)
