@@ -1,0 +1,131 @@
+"""Benchmark harness: headline metric on real TPU hardware.
+
+Measures the BASELINE.md config-2 shape (Flax MLP, MNIST-sized synthetic data)
+through the framework's full step-mode path — Dataset pipeline -> prefetch ->
+jit-compiled donated train step — and reports trainer samples/sec/chip.
+
+``vs_baseline``: the reference delegates training to host frameworks (it has no
+accelerator path of its own; SURVEY.md §0/§6 — no published perf numbers), so the
+baseline is the same model + batch size trained with torch on the host CPU, i.e. what
+a reference user's trainer body actually executes. The ratio is "our TPU substrate vs
+the reference's execution substrate" on identical work.
+
+Prints exactly ONE JSON line on stdout.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BATCH = 512
+INPUT_DIM = 784
+CLASSES = 10
+HIDDEN = (512, 256)
+WARM_STEPS = 5
+MEASURE_STEPS = 60
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _synthetic(n: int = BATCH * 300):  # divisible by steps_per_call: no trailing-group recompile
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(n, INPUT_DIM)).astype("float32")
+    y = rng.integers(0, CLASSES, size=(n,)).astype("int32")
+    return X, y
+
+
+def bench_jax() -> float:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from unionml_tpu import TrainerConfig, make_train_step
+    from unionml_tpu.models import MLPClassifier, MLPConfig
+    from unionml_tpu.models.mlp import make_train_state
+    from unionml_tpu.train import fit
+
+    _log(f"jax devices: {jax.devices()}")
+    X, y = _synthetic()
+    config = MLPConfig(features=HIDDEN, num_classes=CLASSES)
+    module = MLPClassifier(config)
+    state = make_train_state(config, INPUT_DIM, learning_rate=1e-3)
+
+    def loss_fn(params, batch):
+        bx, by = batch
+        logits = module.apply({"params": params}, bx)
+        return optax.softmax_cross_entropy_with_integer_labels(logits.astype(jnp.float32), by).mean()
+
+    step = make_train_step(loss_fn)
+    result = fit(
+        state,
+        step,
+        [X, y],
+        TrainerConfig(epochs=1, batch_size=BATCH, shuffle=False, device_data=True, steps_per_call=50),
+    )
+    _log(f"jax: {result.steps} steps, compile {result.compile_time_s:.2f}s, {result.samples_per_sec:.0f} samples/s")
+    return result.samples_per_sec_per_chip
+
+
+def bench_torch_cpu() -> float:
+    """The reference-substrate baseline: identical MLP/batch trained with torch on CPU."""
+    import torch
+
+    torch.manual_seed(0)
+    X, y = _synthetic(BATCH * (WARM_STEPS + MEASURE_STEPS))
+    Xt, yt = torch.from_numpy(X), torch.from_numpy(y).long()
+    model = torch.nn.Sequential(
+        torch.nn.Linear(INPUT_DIM, HIDDEN[0]),
+        torch.nn.ReLU(),
+        torch.nn.Linear(HIDDEN[0], HIDDEN[1]),
+        torch.nn.ReLU(),
+        torch.nn.Linear(HIDDEN[1], CLASSES),
+    )
+    opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+    loss_fn = torch.nn.CrossEntropyLoss()
+
+    def one_step(i: int) -> None:
+        lo = i * BATCH
+        opt.zero_grad()
+        loss = loss_fn(model(Xt[lo : lo + BATCH]), yt[lo : lo + BATCH])
+        loss.backward()
+        opt.step()
+
+    for i in range(WARM_STEPS):
+        one_step(i)
+    start = time.perf_counter()
+    for i in range(WARM_STEPS, WARM_STEPS + MEASURE_STEPS):
+        one_step(i)
+    elapsed = time.perf_counter() - start
+    sps = MEASURE_STEPS * BATCH / elapsed
+    _log(f"torch-cpu baseline: {sps:.0f} samples/s")
+    return sps
+
+
+def main() -> None:
+    value = bench_jax()
+    try:
+        baseline = bench_torch_cpu()
+        vs_baseline = value / baseline if baseline > 0 else 0.0
+    except Exception as exc:  # baseline failure shouldn't kill the bench
+        _log(f"torch baseline failed: {exc}")
+        vs_baseline = 0.0
+    print(
+        json.dumps(
+            {
+                "metric": "mlp_train_throughput",
+                "value": round(value, 1),
+                "unit": "samples/sec/chip",
+                "vs_baseline": round(vs_baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -171,3 +171,46 @@ def test_infer_fsdp_sharding_rules():
     shardings = infer_fsdp_sharding(params, mesh, min_weight_size=1024)
     assert "fsdp" in str(shardings["big"].spec)
     assert str(shardings["bias"].spec) == "PartitionSpec()"
+
+
+def test_device_data_mode_matches_host_path():
+    module, state = _make_state()
+    step = make_train_step(_loss(module))
+    data = _make_data()
+    host = fit(state, step, data, TrainerConfig(epochs=2, batch_size=128, shuffle=False, donate=False))
+    _, state2 = _make_state()
+    dev = fit(
+        state2,
+        step,
+        data,
+        TrainerConfig(epochs=2, batch_size=128, shuffle=False, donate=False, device_data=True, steps_per_call=3),
+    )
+    assert dev.steps == host.steps == 16
+    np.testing.assert_allclose(
+        float(dev.history[-1]["loss"]), float(host.history[-1]["loss"]), rtol=1e-4
+    )
+
+
+def test_device_data_small_dataset_still_trains():
+    """steps_per_call larger than the schedule must not silently train nothing."""
+    module, state = _make_state()
+    step = make_train_step(_loss(module))
+    result = fit(
+        state,
+        step,
+        _make_data(n=256),
+        TrainerConfig(epochs=1, batch_size=64, device_data=True, steps_per_call=50),
+    )
+    assert result.steps == 4
+
+
+def test_device_data_log_trigger_with_stride(tmp_path):
+    module, state = _make_state()
+    step = make_train_step(_loss(module))
+    result = fit(
+        state,
+        step,
+        _make_data(),
+        TrainerConfig(epochs=2, batch_size=128, device_data=True, steps_per_call=3, log_every_steps=5),
+    )
+    assert len(result.history) >= 3  # crossing semantics: logs fire despite stride 3

@@ -108,7 +108,28 @@ class PrefetchIterator:
             return np.arange(self._num_samples)
         return np.random.default_rng(self.seed + epoch).permutation(self._num_samples)
 
-    def _host_batches(self) -> Iterator[Any]:
+    def contiguous_schedule(self) -> Iterator[tuple]:
+        """Yield ``(epoch, offset, size)`` for each step of the epoch schedule.
+
+        The schedule view used by the train driver's device-resident mode: after a
+        once-per-epoch permutation of the device-resident data, every batch is the
+        contiguous slice ``[offset, offset+size)``. Honors ``skip_batches`` (resume)
+        counting only steps that would actually execute.
+        """
+        emitted = 0
+        for epoch in range(self.epochs):
+            n_steps = self.steps_per_epoch()
+            for step in range(n_steps):
+                lo = step * self.batch_size
+                size = min(self.batch_size, self._num_samples - lo)
+                emitted += 1
+                if emitted <= self.skip_batches:
+                    continue
+                yield epoch, lo, size
+
+    def index_batches(self) -> Iterator[np.ndarray]:
+        """Yield the per-step sample-index vectors of the full epoch schedule (host
+        batching path)."""
         per_process = self.batch_size
         proc_count = jax.process_count()
         proc_index = jax.process_index()
@@ -133,7 +154,11 @@ class PrefetchIterator:
                         # processes; every process must drop it in lockstep
                         continue
                     idx = idx[proc_index * per_process : (proc_index + 1) * per_process]
-                yield jax.tree_util.tree_unflatten(self._treedef, [leaf[idx] for leaf in self._leaves])
+                yield idx
+
+    def _host_batches(self) -> Iterator[Any]:
+        for idx in self.index_batches():
+            yield jax.tree_util.tree_unflatten(self._treedef, [leaf[idx] for leaf in self._leaves])
 
     def _place(self, host_batch: Any) -> Any:
         if self.sharding is None:

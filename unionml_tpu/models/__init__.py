@@ -1,0 +1,13 @@
+"""Model library: flagship flax models for the benchmark configs (BASELINE.json)."""
+
+from unionml_tpu.models.bert import BertConfig, BertEncoder, bert_partition_rules, classification_loss  # noqa: F401
+from unionml_tpu.models.llama import (  # noqa: F401
+    Llama,
+    LlamaConfig,
+    causal_lm_loss,
+    llama_partition_rules,
+    lora_optimizer,
+    lora_param_labels,
+)
+from unionml_tpu.models.mlp import MLPClassifier, MLPConfig  # noqa: F401
+from unionml_tpu.models.vit import ViT, ViTConfig, vit_partition_rules  # noqa: F401

@@ -1,0 +1,159 @@
+"""BERT-family bidirectional encoder + classification head.
+
+Backs BASELINE.json config 3 ("HF BERT-base SST-2 fine-tune, DP all-reduce over
+v5e-8"). Standard learned-position encoder with pre-norm blocks; weights can be
+imported from a HuggingFace checkpoint via :func:`load_hf_bert_params` (host-side
+torch -> numpy conversion, no torch in the compiled path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from unionml_tpu.models.layers import TransformerBlock
+from unionml_tpu.parallel.sharding import PartitionRules
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    hidden_dim: int = 3072
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    num_classes: int = 2
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @classmethod
+    def base(cls, **overrides: Any) -> "BertConfig":
+        return cls(**overrides)
+
+    @classmethod
+    def tiny(cls, **overrides: Any) -> "BertConfig":
+        defaults = dict(vocab_size=512, dim=128, n_layers=2, n_heads=4, hidden_dim=256, max_seq_len=128)
+        defaults.update(overrides)
+        return cls(**defaults)
+
+
+class BertEncoder(nn.Module):
+    """Token/position/type embeddings -> encoder stack -> [CLS] pooled logits."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        tokens: jax.Array,
+        attention_mask: Optional[jax.Array] = None,
+        token_type_ids: Optional[jax.Array] = None,
+    ) -> jax.Array:
+        cfg = self.config
+        length = tokens.shape[1]
+        x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="tok_embed")(tokens)
+        pos = nn.Embed(cfg.max_seq_len, cfg.dim, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="pos_embed")(
+            jnp.arange(length)
+        )
+        x = x + pos[None]
+        if token_type_ids is not None:
+            x = x + nn.Embed(
+                cfg.type_vocab_size, cfg.dim, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="type_embed"
+            )(token_type_ids)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="embed_norm")(x)
+
+        for i in range(cfg.n_layers):
+            x = TransformerBlock(
+                n_heads=cfg.n_heads,
+                hidden_dim=cfg.hidden_dim,
+                decoder=False,
+                dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+                name=f"layer_{i}",
+            )(x)
+
+        pooled = jnp.tanh(
+            nn.Dense(cfg.dim, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="pooler")(x[:, 0])
+        )
+        return nn.Dense(cfg.num_classes, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="classifier")(pooled)
+
+
+def bert_partition_rules() -> PartitionRules:
+    return PartitionRules(
+        [
+            (r"attn/(q_proj|k_proj|v_proj)/kernel", P("fsdp", "model")),
+            (r"attn/o_proj/kernel", P("model", "fsdp")),
+            (r"mlp/wi/kernel", P("fsdp", "model")),
+            (r"mlp/wo/kernel", P("model", "fsdp")),
+            (r"(tok|pos|type)_embed/embedding", P(None, "fsdp")),
+            (r"(pooler|classifier)/kernel", P("fsdp", None)),
+            (r".*(norm|scale|bias)", P()),
+        ]
+    )
+
+
+def classification_loss(apply_fn, params, batch) -> Any:
+    """(tokens, labels) -> (loss, {'accuracy': ...}); use with make_train_step(has_aux=True)."""
+    import optax
+
+    tokens, labels = batch
+    labels = labels.reshape(-1).astype(jnp.int32)
+    logits = apply_fn(params, tokens)
+    loss = optax.softmax_cross_entropy_with_integer_labels(logits.astype(jnp.float32), labels).mean()
+    accuracy = (jnp.argmax(logits, -1) == labels).mean()
+    return loss, {"accuracy": accuracy}
+
+
+def load_hf_bert_params(hf_model_name: str, config: BertConfig):  # pragma: no cover - network/weights
+    """Convert a HuggingFace torch BERT checkpoint into this module's param tree.
+
+    Host-side only (numpy); the compiled path never touches torch. Requires the
+    checkpoint to be available locally (zero-egress environments must pre-seed the
+    HF cache).
+    """
+    import numpy as np
+    from transformers import AutoModel
+
+    hf = AutoModel.from_pretrained(hf_model_name)
+    sd = {k: np.asarray(v.detach()) for k, v in hf.state_dict().items()}
+
+    def dense(prefix):
+        return {"kernel": sd[f"{prefix}.weight"].T, "bias": sd[f"{prefix}.bias"]}
+
+    params = {
+        "tok_embed": {"embedding": sd["embeddings.word_embeddings.weight"]},
+        "pos_embed": {"embedding": sd["embeddings.position_embeddings.weight"][: config.max_seq_len]},
+        "type_embed": {"embedding": sd["embeddings.token_type_embeddings.weight"]},
+        "embed_norm": {"scale": sd["embeddings.LayerNorm.weight"], "bias": sd["embeddings.LayerNorm.bias"]},
+        "pooler": dense("pooler.dense"),
+    }
+    for i in range(config.n_layers):
+        hf_prefix = f"encoder.layer.{i}"
+        params[f"layer_{i}"] = {
+            "attn_norm": {
+                "scale": sd[f"{hf_prefix}.attention.output.LayerNorm.weight"],
+                "bias": sd[f"{hf_prefix}.attention.output.LayerNorm.bias"],
+            },
+            "attn": {
+                "q_proj": {"kernel": sd[f"{hf_prefix}.attention.self.query.weight"].T},
+                "k_proj": {"kernel": sd[f"{hf_prefix}.attention.self.key.weight"].T},
+                "v_proj": {"kernel": sd[f"{hf_prefix}.attention.self.value.weight"].T},
+                "o_proj": {"kernel": sd[f"{hf_prefix}.attention.output.dense.weight"].T},
+            },
+            "mlp_norm": {
+                "scale": sd[f"{hf_prefix}.output.LayerNorm.weight"],
+                "bias": sd[f"{hf_prefix}.output.LayerNorm.bias"],
+            },
+            "mlp": {
+                "wi": {"kernel": sd[f"{hf_prefix}.intermediate.dense.weight"].T},
+                "wo": {"kernel": sd[f"{hf_prefix}.output.dense.weight"].T},
+            },
+        }
+    return params

@@ -1,0 +1,209 @@
+"""Shared transformer building blocks (flax), written mesh-first.
+
+No analog in the reference (it never looks inside a model, SURVEY.md §0); this is the
+model library backing the BASELINE.json configs. Conventions:
+
+- activations ``[batch, length, heads, head_dim]`` so sequence-parallel specs are
+  rank-stable (:mod:`unionml_tpu.ops.ring_attention`);
+- ``dtype`` (compute, default bf16 — the MXU native format) is separate from
+  ``param_dtype`` (storage, default f32);
+- parameter names are chosen so the PartitionRules regexes in
+  :func:`unionml_tpu.models.llama.llama_partition_rules` etc. resolve TP layouts
+  without per-model spec tables.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from unionml_tpu.ops.attention import dot_product_attention, multihead_attention
+
+Dtype = Any
+
+
+class RMSNorm(nn.Module):
+    """Root-mean-square layer norm (pre-norm default for decoder stacks)."""
+
+    epsilon: float = 1e-6
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        x32 = x.astype(jnp.float32)
+        norm = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.epsilon)
+        return (norm * scale).astype(self.dtype)
+
+
+def rotary_embedding(x: jax.Array, positions: jax.Array, theta: float = 10000.0) -> jax.Array:
+    """Apply RoPE to ``x: [B, L, H, D]`` at integer ``positions: [L]`` (or ``[B, L]``)."""
+    head_dim = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    angles = positions.astype(jnp.float32)[..., None] * freqs  # [..., L, D/2]
+    while angles.ndim < x.ndim:  # broadcast over batch/head dims
+        angles = angles[None] if angles.ndim == 2 else angles[:, :, None]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    rotated = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return rotated.reshape(x.shape).astype(x.dtype)
+
+
+class LoRADense(nn.Module):
+    """Dense layer with an optional low-rank adapter: ``y = xW + (xA)B * (alpha/r)``.
+
+    With ``rank == 0`` this is a plain Dense. The adapter params live under
+    ``lora_a``/``lora_b`` so :func:`unionml_tpu.models.llama.lora_param_labels` can
+    mask the base weights out of the optimizer for LoRA fine-tuning.
+    """
+
+    features: int
+    rank: int = 0
+    alpha: float = 16.0
+    use_bias: bool = False
+    dtype: Dtype = jnp.bfloat16
+    param_dtype: Dtype = jnp.float32
+    kernel_init: Callable = nn.initializers.lecun_normal()
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        in_features = x.shape[-1]
+        kernel = self.param("kernel", self.kernel_init, (in_features, self.features), self.param_dtype)
+        y = jnp.dot(x, kernel.astype(self.dtype))
+        if self.rank > 0:
+            a = self.param("lora_a", nn.initializers.normal(0.02), (in_features, self.rank), self.param_dtype)
+            b = self.param("lora_b", nn.initializers.zeros, (self.rank, self.features), self.param_dtype)
+            y = y + jnp.dot(jnp.dot(x, a.astype(self.dtype)), b.astype(self.dtype)) * (self.alpha / self.rank)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros, (self.features,), self.param_dtype)
+            y = y + bias.astype(self.dtype)
+        return y
+
+
+class Attention(nn.Module):
+    """Multi-head (optionally grouped-query) attention with RoPE and impl dispatch.
+
+    ``impl``: ``"auto"`` (pallas flash on TPU when aligned, XLA otherwise),
+    ``"xla"``, ``"flash"``, or ``"ring"`` (sequence-parallel exact attention; requires
+    running inside shard_map with a ``sequence`` axis).
+    """
+
+    n_heads: int
+    n_kv_heads: Optional[int] = None
+    head_dim: Optional[int] = None
+    causal: bool = False
+    rope: bool = False
+    rope_theta: float = 10000.0
+    impl: str = "auto"
+    lora_rank: int = 0
+    dtype: Dtype = jnp.bfloat16
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, positions: Optional[jax.Array] = None) -> jax.Array:
+        features = x.shape[-1]
+        n_kv = self.n_kv_heads or self.n_heads
+        head_dim = self.head_dim or features // self.n_heads
+        dense = lambda feats, name: LoRADense(  # noqa: E731
+            feats, rank=self.lora_rank, dtype=self.dtype, param_dtype=self.param_dtype, name=name
+        )
+
+        q = dense(self.n_heads * head_dim, "q_proj")(x)
+        k = dense(n_kv * head_dim, "k_proj")(x)
+        v = dense(n_kv * head_dim, "v_proj")(x)
+
+        batch, length = x.shape[0], x.shape[1]
+        q = q.reshape(batch, length, self.n_heads, head_dim)
+        k = k.reshape(batch, length, n_kv, head_dim)
+        v = v.reshape(batch, length, n_kv, head_dim)
+
+        if self.rope:
+            if positions is None:
+                positions = jnp.arange(length)
+            q = rotary_embedding(q, positions, self.rope_theta)
+            k = rotary_embedding(k, positions, self.rope_theta)
+
+        if self.impl == "ring":
+            from unionml_tpu.ops.ring_attention import ring_attention
+
+            out = ring_attention(q, k, v, causal=self.causal)
+        elif self.impl in ("flash", "xla"):
+            if self.impl == "flash":
+                out = multihead_attention(q, k, v, causal=self.causal, impl="flash")
+            else:
+                out = dot_product_attention(q, k, v, causal=self.causal)
+        else:
+            out = multihead_attention(q, k, v, causal=self.causal, impl="auto")
+
+        out = out.reshape(batch, length, self.n_heads * head_dim)
+        return dense(features, "o_proj")(out)
+
+
+class MLP(nn.Module):
+    """Feed-forward block: gated SwiGLU (decoder default) or plain GELU (encoder)."""
+
+    hidden_dim: int
+    gated: bool = True
+    lora_rank: int = 0
+    dtype: Dtype = jnp.bfloat16
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        features = x.shape[-1]
+        dense = lambda feats, name: LoRADense(  # noqa: E731
+            feats, rank=self.lora_rank, dtype=self.dtype, param_dtype=self.param_dtype, name=name
+        )
+        if self.gated:
+            gate = jax.nn.silu(dense(self.hidden_dim, "wg")(x))
+            up = dense(self.hidden_dim, "wi")(x)
+            return dense(features, "wo")(gate * up)
+        h = jax.nn.gelu(dense(self.hidden_dim, "wi")(x))
+        return dense(features, "wo")(h)
+
+
+class TransformerBlock(nn.Module):
+    """Pre-norm transformer block, encoder (bidirectional+LN) or decoder (causal+RMS)."""
+
+    n_heads: int
+    hidden_dim: int
+    n_kv_heads: Optional[int] = None
+    decoder: bool = True
+    rope: bool = False
+    rope_theta: float = 10000.0
+    attention_impl: str = "auto"
+    lora_rank: int = 0
+    dtype: Dtype = jnp.bfloat16
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, positions: Optional[jax.Array] = None) -> jax.Array:
+        norm = (
+            (lambda name: RMSNorm(dtype=self.dtype, name=name))
+            if self.decoder
+            else (lambda name: nn.LayerNorm(dtype=self.dtype, name=name))
+        )
+        x = x + Attention(
+            n_heads=self.n_heads,
+            n_kv_heads=self.n_kv_heads,
+            causal=self.decoder,
+            rope=self.rope,
+            rope_theta=self.rope_theta,
+            impl=self.attention_impl,
+            lora_rank=self.lora_rank,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="attn",
+        )(norm("attn_norm")(x), positions)
+        x = x + MLP(
+            hidden_dim=self.hidden_dim,
+            gated=self.decoder,
+            lora_rank=self.lora_rank,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="mlp",
+        )(norm("mlp_norm")(x))
+        return x

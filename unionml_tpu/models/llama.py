@@ -1,0 +1,154 @@
+"""Llama-family causal decoder with LoRA and TP/FSDP/SP partition rules.
+
+Backs BASELINE.json config 4 ("Llama-3-8B LoRA fine-tune + serve, pjit FSDP"). The
+module is a standard pre-norm RoPE/SwiGLU/GQA decoder; parallelism comes entirely
+from the outside: the train driver resolves :func:`llama_partition_rules` (megatron
+TP + fsdp) against the param tree, the sequence axis rides
+:mod:`unionml_tpu.ops.ring_attention` when ``attention_impl='ring'``, and
+:func:`lora_param_labels` masks the base weights out of the optimizer for LoRA.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from unionml_tpu.models.layers import RMSNorm, TransformerBlock
+from unionml_tpu.parallel.sharding import PartitionRules
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    hidden_dim: int = 14336
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    lora_rank: int = 0
+    attention_impl: str = "auto"
+    remat: bool = False
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @classmethod
+    def llama3_8b(cls, **overrides: Any) -> "LlamaConfig":
+        return cls(
+            vocab_size=128256, dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+            hidden_dim=14336, rope_theta=500000.0, **overrides,
+        )
+
+    @classmethod
+    def tiny(cls, **overrides: Any) -> "LlamaConfig":
+        """Test/dry-run scale."""
+        defaults = dict(
+            vocab_size=512, dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
+            hidden_dim=256, max_seq_len=256,
+        )
+        defaults.update(overrides)
+        return cls(**defaults)
+
+
+class Llama(nn.Module):
+    """Causal LM: tokens ``[B, L]`` -> logits ``[B, L, vocab]``."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array, positions: Optional[jax.Array] = None) -> jax.Array:
+        cfg = self.config
+        x = nn.Embed(
+            cfg.vocab_size, cfg.dim, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="embed"
+        )(tokens)
+        if positions is None:
+            positions = jnp.arange(tokens.shape[1])
+
+        block_cls = TransformerBlock
+        if cfg.remat:
+            block_cls = nn.remat(TransformerBlock, static_argnums=())
+        for i in range(cfg.n_layers):
+            x = block_cls(
+                n_heads=cfg.n_heads,
+                n_kv_heads=cfg.n_kv_heads,
+                hidden_dim=cfg.hidden_dim,
+                decoder=True,
+                rope=True,
+                rope_theta=cfg.rope_theta,
+                attention_impl=cfg.attention_impl,
+                lora_rank=cfg.lora_rank,
+                dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+                name=f"layer_{i}",
+            )(x, positions)
+
+        x = RMSNorm(dtype=cfg.dtype, name="final_norm")(x)
+        # untied LM head (kept separate so vocab-parallel TP sharding is per-rule)
+        logits = nn.Dense(
+            cfg.vocab_size, use_bias=False, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="lm_head"
+        )(x)
+        return logits
+
+
+def llama_partition_rules() -> PartitionRules:
+    """Megatron-style TP layout + vocab-parallel embedding/head.
+
+    Column-parallel (shard output dim over ``model``): q/k/v, mlp wi/wg.
+    Row-parallel (shard input dim over ``model``): o_proj, mlp wo.
+    The complementary dim takes ``fsdp`` so ZeRO-3 and TP compose on a 2D mesh.
+    """
+    return PartitionRules(
+        [
+            (r"attn/(q_proj|k_proj|v_proj)/kernel", P("fsdp", "model")),
+            (r"attn/o_proj/kernel", P("model", "fsdp")),
+            (r"mlp/(wi|wg)/kernel", P("fsdp", "model")),
+            (r"mlp/wo/kernel", P("model", "fsdp")),
+            (r"embed/embedding", P("model", "fsdp")),
+            (r"lm_head/kernel", P("fsdp", "model")),
+            (r"lora_a", P("fsdp", None)),
+            (r"lora_b", P(None, "model")),
+            (r".*(norm|scale|bias)", P()),
+        ]
+    )
+
+
+def lora_param_labels(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Label pytree for ``optax.multi_transform``: ``"lora"`` for adapter params,
+    ``"frozen"`` for base weights — LoRA fine-tuning trains ~0.5% of the params."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: "lora" if any("lora" in str(getattr(p, "key", "")) for p in path) else "frozen",
+        params,
+    )
+
+
+def lora_optimizer(learning_rate: float = 1e-4, **adam_kwargs: Any):
+    """Adam on LoRA params only; base weights frozen via ``optax.set_to_zero``."""
+    import optax
+
+    return optax.multi_transform(
+        {"lora": optax.adamw(learning_rate, **adam_kwargs), "frozen": optax.set_to_zero()},
+        lora_param_labels,
+    )
+
+
+def causal_lm_loss(apply_fn, params, batch) -> jax.Array:
+    """Next-token cross-entropy. ``batch``: ``(tokens, loss_mask?)`` or tokens array."""
+    tokens, mask = (batch if isinstance(batch, (tuple, list)) and len(batch) == 2 else (batch, None))
+    if isinstance(tokens, (tuple, list)):
+        tokens = tokens[0]
+    logits = apply_fn(params, tokens)
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    import optax
+
+    losses = optax.softmax_cross_entropy_with_integer_labels(logits.astype(jnp.float32), targets)
+    if mask is not None:
+        m = mask[:, 1:].astype(jnp.float32)
+        return (losses * m).sum() / jnp.maximum(m.sum(), 1.0)
+    return losses.mean()

@@ -1,0 +1,41 @@
+"""Flax MLP classifier — the minimal step-mode model (BASELINE.json config 2)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPConfig:
+    features: Sequence[int] = (512, 256)
+    num_classes: int = 10
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+
+class MLPClassifier(nn.Module):
+    config: MLPConfig = MLPConfig()
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.config
+        x = x.reshape(x.shape[0], -1).astype(cfg.dtype)
+        for i, width in enumerate(cfg.features):
+            x = nn.Dense(width, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name=f"dense_{i}")(x)
+            x = nn.relu(x)
+        return nn.Dense(cfg.num_classes, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="head")(x)
+
+
+def make_train_state(config: MLPConfig, input_dim: int, learning_rate: float = 1e-3, seed: int = 0):
+    """Convenience ``init`` for ``Model(init=...)`` apps."""
+    import optax
+    from flax.training import train_state
+
+    module = MLPClassifier(config)
+    params = module.init(jax.random.PRNGKey(seed), jnp.zeros((1, input_dim)))["params"]
+    return train_state.TrainState.create(apply_fn=module.apply, params=params, tx=optax.adam(learning_rate))

@@ -1,0 +1,97 @@
+"""Vision Transformer image classifier.
+
+Backs BASELINE.json config 5 ("ViT-L image classifier, reader -> HBM prefetch").
+Patchify-by-conv keeps the embedding a single MXU-friendly convolution; everything
+after reuses the shared encoder blocks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from unionml_tpu.models.layers import TransformerBlock
+from unionml_tpu.parallel.sharding import PartitionRules
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    dim: int = 1024
+    n_layers: int = 24
+    n_heads: int = 16
+    hidden_dim: int = 4096
+    num_classes: int = 1000
+    channels: int = 3
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @classmethod
+    def large(cls, **overrides: Any) -> "ViTConfig":
+        return cls(**overrides)
+
+    @classmethod
+    def tiny(cls, **overrides: Any) -> "ViTConfig":
+        defaults = dict(image_size=32, patch_size=8, dim=128, n_layers=2, n_heads=4, hidden_dim=256, num_classes=10)
+        defaults.update(overrides)
+        return cls(**defaults)
+
+
+class ViT(nn.Module):
+    """Images ``[B, H, W, C]`` -> class logits ``[B, num_classes]``."""
+
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, images: jax.Array) -> jax.Array:
+        cfg = self.config
+        x = nn.Conv(
+            cfg.dim,
+            kernel_size=(cfg.patch_size, cfg.patch_size),
+            strides=(cfg.patch_size, cfg.patch_size),
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            name="patch_embed",
+        )(images.astype(cfg.dtype))
+        batch = x.shape[0]
+        x = x.reshape(batch, -1, cfg.dim)  # [B, n_patches, dim]
+
+        cls_token = self.param("cls_token", nn.initializers.zeros, (1, 1, cfg.dim), cfg.param_dtype)
+        x = jnp.concatenate([jnp.broadcast_to(cls_token.astype(cfg.dtype), (batch, 1, cfg.dim)), x], axis=1)
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02), (1, x.shape[1], cfg.dim), cfg.param_dtype
+        )
+        x = x + pos.astype(cfg.dtype)
+
+        for i in range(cfg.n_layers):
+            x = TransformerBlock(
+                n_heads=cfg.n_heads,
+                hidden_dim=cfg.hidden_dim,
+                decoder=False,
+                dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+                name=f"layer_{i}",
+            )(x)
+
+        x = nn.LayerNorm(dtype=cfg.dtype, name="final_norm")(x)
+        return nn.Dense(cfg.num_classes, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="head")(x[:, 0])
+
+
+def vit_partition_rules() -> PartitionRules:
+    return PartitionRules(
+        [
+            (r"attn/(q_proj|k_proj|v_proj)/kernel", P("fsdp", "model")),
+            (r"attn/o_proj/kernel", P("model", "fsdp")),
+            (r"mlp/wi/kernel", P("fsdp", "model")),
+            (r"mlp/wo/kernel", P("model", "fsdp")),
+            (r"patch_embed/kernel", P(None, None, None, "fsdp")),
+            (r"head/kernel", P("fsdp", None)),
+            (r".*(norm|scale|bias|cls_token|pos_embed)", P()),
+        ]
+    )

@@ -1,0 +1,4 @@
+"""TPU compute ops: attention kernels (XLA reference, pallas flash, ring/SP)."""
+
+from unionml_tpu.ops.attention import dot_product_attention, multihead_attention  # noqa: F401
+from unionml_tpu.ops.ring_attention import ring_attention  # noqa: F401

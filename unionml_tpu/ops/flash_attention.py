@@ -1,0 +1,154 @@
+"""Pallas TPU flash attention (forward kernel + recompute backward).
+
+Blocked online-softmax attention: Q/K/V stream HBM->VMEM in (block_q x block_k)
+tiles, the running max/denominator and the f32 output accumulator live in VMEM
+scratch, and the [L, L] score matrix is never materialized in HBM. The TPU grid is
+sequential over its innermost dimension, so scratch persists across the k-block loop
+— the canonical pallas accumulation pattern (see /opt/skills/guides/pallas_guide.md,
+"Patterns: Double Buffering" / grid accumulation).
+
+Backward: ``jax.custom_vjp`` recomputes attention with the XLA reference
+implementation and differentiates through it — the memory win of the flash forward is
+preserved for inference and for activations under ``jax.checkpoint``; a fused pallas
+backward kernel is a later optimization.
+
+Shapes: ``q, k, v: [B, L, H, D]`` with ``D % 128 == 0`` and ``L`` divisible by the
+block size. Grouped-query is handled by the caller (head repetition) before dispatch.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific memory spaces; fall back to interpreter-friendly defaults on CPU
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+_NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch, acc_scratch, *, causal, block_q, block_k, scale):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    num_k = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, _NEG_INF)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale  # [block_q, D]
+        k = k_ref[0].astype(jnp.float32)  # [block_k, D]
+        v = v_ref[0].astype(jnp.float32)  # [block_k, D]
+        scores = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [block_q, block_k]
+
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+            scores = jnp.where(q_pos >= k_pos, scores, _NEG_INF)
+
+        m_prev = m_scratch[:]  # [block_q, 1]
+        m_curr = jnp.max(scores, axis=-1, keepdims=True)
+        m_next = jnp.maximum(m_prev, m_curr)
+        alpha = jnp.exp(m_prev - m_next)
+        p = jnp.exp(scores - m_next)
+
+        l_next = l_scratch[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scratch[:] = acc_scratch[:] * alpha + jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())))
+        m_scratch[:] = m_next
+        l_scratch[:] = l_next
+
+    if causal:
+        # skip k blocks entirely above the diagonal
+        @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        denom = jnp.where(l_scratch[:] == 0.0, 1.0, l_scratch[:])
+        o_ref[0] = (acc_scratch[:] / denom).astype(o_ref.dtype)
+
+
+def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool, interpret: bool) -> jax.Array:
+    batch, q_len, n_heads, head_dim = q.shape
+    k_len = k.shape[1]
+    block_q = min(DEFAULT_BLOCK_Q, q_len)
+    block_k = min(DEFAULT_BLOCK_K, k_len)
+    scale = head_dim**-0.5
+
+    # fold heads into batch; kernel operates on [BH, L, D]
+    def fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(x.shape[0] * x.shape[2], x.shape[1], x.shape[3])
+
+    qf, kf, vf = fold(q), fold(k), fold(v)
+    grid = (batch * n_heads, q_len // block_q, k_len // block_k)
+
+    kernel = functools.partial(
+        _flash_fwd_kernel, causal=causal, block_q=block_q, block_k=block_k, scale=scale
+    )
+    if pltpu is None:  # pragma: no cover
+        raise RuntimeError("pallas TPU backend unavailable; use impl='xla' attention instead")
+    scratch_shapes = [
+        pltpu.VMEM((block_q, 1), jnp.float32),
+        pltpu.VMEM((block_q, 1), jnp.float32),
+        pltpu.VMEM((block_q, head_dim), jnp.float32),
+    ]
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, head_dim), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, head_dim), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, head_dim), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, head_dim), lambda bh, qi, ki: (bh, qi, 0)),
+        scratch_shapes=scratch_shapes,
+        interpret=interpret,
+    )(qf, kf, vf)
+
+    return out.reshape(batch, n_heads, q_len, head_dim).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, causal, interpret):
+    return _flash_forward(q, k, v, causal, interpret)
+
+
+def _flash_fwd_rule(q, k, v, causal, interpret):
+    return _flash_forward(q, k, v, causal, interpret), (q, k, v)
+
+
+def _flash_bwd_rule(causal, interpret, residuals, g):
+    from unionml_tpu.ops.attention import dot_product_attention
+
+    q, k, v = residuals
+    _, vjp = jax.vjp(lambda q_, k_, v_: dot_product_attention(q_, k_, v_, causal=causal), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = False, interpret: bool = False
+) -> jax.Array:
+    """Flash attention entry point. ``interpret=True`` runs the kernel in the pallas
+    interpreter (CPU) — used by the test ring."""
+    return _flash(q, k, v, causal, interpret)

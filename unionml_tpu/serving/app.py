@@ -111,7 +111,12 @@ class ServingApp:
                 logger.warning(f"predictor warmup failed for bucket {bucket}: {exc}")
 
     def _predict_features_sync(self, features: Any) -> Any:
-        return self.model.predict(features=features)
+        # features arriving here are already model-ready (the handler ran
+        # dataset.get_features before enqueueing) — go straight to the
+        # predict-from-features graph so loader/transformer don't run twice
+        return self.model.predict_from_features_workflow()(
+            model_object=self.model.artifact.model_object, features=features
+        )
 
     # ------------------------------------------------------------------ handlers
 
