@@ -57,6 +57,39 @@ def test_flash_attention_gradients_match():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
 
 
+def test_flash_attention_causal_cross_lengths():
+    """q_len != k_len: causal masking must use the shifted diagonal (query i attends
+    keys up to i + k_len - q_len), matching the XLA reference."""
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 128, 2, 128))
+    k = jax.random.normal(jax.random.PRNGKey(1), (1, 256, 2, 128))
+    v = jax.random.normal(jax.random.PRNGKey(2), (1, 256, 2, 128))
+    ref = dot_product_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_multihead_attention_flash_dispatch_repeats_gqa_heads(monkeypatch):
+    """The flash kernel expects equal Q/KV head counts; the dispatch must repeat KV
+    heads for grouped-query inputs before handing off."""
+    from unionml_tpu.ops import attention as attn_mod
+    from unionml_tpu.ops import flash_attention as fa_mod
+
+    captured = {}
+
+    def fake_flash(q, k, v, causal=False, **kwargs):
+        captured["kv_heads"] = k.shape[2]
+        return dot_product_attention(q, k, v, causal=causal)
+
+    monkeypatch.setattr(fa_mod, "flash_attention", fake_flash)
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 64, 8, 32))
+    k = jax.random.normal(jax.random.PRNGKey(1), (1, 64, 2, 32))
+    v = jax.random.normal(jax.random.PRNGKey(2), (1, 64, 2, 32))
+    ref = dot_product_attention(q, k, v, causal=True)
+    out = attn_mod.multihead_attention(q, k, v, causal=True, impl="flash")
+    assert captured["kv_heads"] == 8
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
 def test_ring_attention_matches_reference():
     q, k, v = (jax.random.normal(jax.random.PRNGKey(i), (2, 256, 4, 64)) for i in range(3))
     mesh = MeshSpec(data=2, sequence=4).build()
@@ -191,6 +224,34 @@ def test_bert_classification_step():
         TrainerConfig(epochs=2, batch_size=4, mesh=MeshSpec(data=-1), partition_rules=bert_partition_rules()),
     )
     assert "accuracy" in result.history[-1]
+
+
+def test_bert_attention_mask_blocks_padding():
+    """Pad tokens must not influence the [CLS] representation: changing token ids at
+    masked positions leaves the logits unchanged, and masking must change the output
+    vs. no mask."""
+    cfg = BertConfig.tiny(dtype=jnp.float32)
+    module = BertEncoder(cfg)
+    tokens = np.asarray(_tokens(2, 16, cfg.vocab_size))
+    params = module.init(RNG, jnp.asarray(tokens))["params"]
+    mask = np.ones((2, 16), dtype=np.int32)
+    mask[:, 8:] = 0  # second half is padding
+
+    logits = module.apply({"params": params}, jnp.asarray(tokens), jnp.asarray(mask))
+    tokens_perturbed = tokens.copy()
+    tokens_perturbed[:, 8:] = (tokens_perturbed[:, 8:] + 7) % cfg.vocab_size
+    logits_perturbed = module.apply({"params": params}, jnp.asarray(tokens_perturbed), jnp.asarray(mask))
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(logits_perturbed), atol=1e-6)
+
+    logits_unmasked = module.apply({"params": params}, jnp.asarray(tokens))
+    assert not np.allclose(np.asarray(logits), np.asarray(logits_unmasked))
+
+    # the 3-tuple batch shape routes the mask through classification_loss
+    labels = np.zeros((2,), dtype=np.int32)
+    loss, aux = classification_loss(
+        lambda pp, t, m=None: module.apply({"params": pp}, t, m), params, (tokens, mask, labels)
+    )
+    assert np.isfinite(float(loss)) and "accuracy" in aux
 
 
 def test_bert_aux_metrics_survive_grad_accum():

@@ -1,0 +1,145 @@
+"""CLI tests — modeled on the reference's CLI surface (unionml/cli.py:26-212):
+init renders a project, deploy/train/predict/list-model-versions/fetch-model run the
+remote path end-to-end against a temp backend store, and serve guards its env var."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+from click.testing import CliRunner
+
+from unionml_tpu.cli import app
+from unionml_tpu.templating import list_templates, render_template, validate_app_name
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+APP_SOURCE = textwrap.dedent(
+    """
+    from typing import List
+
+    import pandas as pd
+    from sklearn.linear_model import LogisticRegression
+
+    from unionml_tpu import Dataset, Model
+
+    dataset = Dataset(name="ds", test_size=0.2, shuffle=True, targets=["y"])
+    model = Model(name="cli_test_model", init=LogisticRegression, dataset=dataset)
+    model.__app_module__ = "cli_app:model"
+
+
+    @dataset.reader
+    def reader(n: int = 60) -> pd.DataFrame:
+        rows = []
+        for i in range(n):
+            rows.append({"x0": float(i % 7), "x1": float((i * 3) % 5), "y": i % 2})
+        return pd.DataFrame(rows)
+
+
+    @model.trainer
+    def trainer(est: LogisticRegression, features: pd.DataFrame, target: pd.DataFrame) -> LogisticRegression:
+        return est.fit(features, target.squeeze())
+
+
+    @model.predictor
+    def predictor(est: LogisticRegression, features: pd.DataFrame) -> List[float]:
+        return [float(v) for v in est.predict(features)]
+    """
+)
+
+
+@pytest.fixture()
+def cli_project(tmp_path, monkeypatch):
+    """A committed git project containing a unionml-tpu app + an isolated backend store."""
+    (tmp_path / "cli_app.py").write_text(APP_SOURCE)
+    subprocess.run(["git", "init", "-q"], cwd=tmp_path, check=True)
+    subprocess.run(["git", "add", "."], cwd=tmp_path, check=True)
+    subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t", "commit", "-q", "-m", "init"],
+        cwd=tmp_path,
+        check=True,
+    )
+    monkeypatch.setenv("UNIONML_TPU_STORE", str(tmp_path / "store"))
+    monkeypatch.setenv("PYTHONPATH", os.pathsep.join([str(tmp_path), str(REPO_ROOT)]))
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.syspath_prepend(str(tmp_path))
+    yield tmp_path
+    sys.modules.pop("cli_app", None)
+
+
+def test_templating_list_and_validate():
+    names = list_templates()
+    assert {"basic", "basic-serverless", "image-classification"} <= set(names)
+    validate_app_name("my-app_1")
+    with pytest.raises(ValueError):
+        validate_app_name("1bad")
+    with pytest.raises(ValueError):
+        validate_app_name("bad name")
+
+
+def test_init_renders_template(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    runner = CliRunner()
+    result = runner.invoke(app, ["init", "my_digits_app", "--template", "basic"])
+    assert result.exit_code == 0, result.output
+    project = tmp_path / "my_digits_app"
+    assert (project / "app.py").exists()
+    assert "my_digits_app" in (project / "README.md").read_text()
+    assert "{{app_name}}" not in (project / "app.py").read_text()
+
+
+def test_init_rejects_existing_dir(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / "dup_app").mkdir()
+    result = CliRunner().invoke(app, ["init", "dup_app"])
+    assert result.exit_code != 0
+
+
+def test_render_template_unknown():
+    with pytest.raises(ValueError, match="unknown template"):
+        render_template("nope", "x_app", Path("/tmp"))
+
+
+def test_deploy_train_predict_roundtrip(cli_project):
+    runner = CliRunner()
+    result = runner.invoke(app, ["deploy", "cli_app:model", "--allow-uncommitted"])
+    assert result.exit_code == 0, result.output
+    assert "Deployed" in result.output
+
+    result = runner.invoke(app, ["train", "cli_app:model", "-i", json.dumps({"hyperparameters": {"max_iter": 500}})])
+    assert result.exit_code == 0, result.output
+    assert "Metrics" in result.output
+
+    result = runner.invoke(app, ["list-model-versions", "cli_app:model"])
+    assert result.exit_code == 0, result.output
+    assert "- train-" in result.output
+
+    features = [{"x0": 1.0, "x1": 2.0}, {"x0": 3.0, "x1": 1.0}]
+    features_file = cli_project / "features.json"
+    features_file.write_text(json.dumps(features))
+    result = runner.invoke(app, ["predict", "cli_app:model", "--features", str(features_file)])
+    assert result.exit_code == 0, result.output
+    assert "Predictions" in result.output
+
+    out_file = cli_project / "fetched.joblib"
+    result = runner.invoke(app, ["fetch-model", "cli_app:model", "-o", str(out_file)])
+    assert result.exit_code == 0, result.output
+    assert out_file.exists()
+
+
+def test_serve_rejects_preset_env(cli_project, monkeypatch, tmp_path):
+    model_file = tmp_path / "m.joblib"
+    model_file.write_text("x")
+    monkeypatch.setenv("UNIONML_MODEL_PATH", "/somewhere")
+    result = CliRunner().invoke(app, ["serve", "cli_app:model", "--model-path", str(model_file)])
+    assert result.exit_code != 0
+    assert "already set" in result.output
+
+
+def test_serve_requires_existing_model_path(cli_project):
+    result = CliRunner().invoke(app, ["serve", "cli_app:model", "--model-path", "/does/not/exist"])
+    assert result.exit_code != 0
+    assert "does not exist" in result.output

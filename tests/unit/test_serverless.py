@@ -1,0 +1,182 @@
+"""Serverless adapter tests — modeled on the reference's
+tests/unit/test_aws_lambda_handler.py: an API-Gateway event fixture driven through the
+handler in-process, and an S3-event batch flow with an injected object-store client."""
+
+import json
+from pathlib import Path
+from typing import List
+
+import pandas as pd
+import pytest
+from sklearn.linear_model import LogisticRegression
+
+from unionml_tpu import Dataset, Model
+from unionml_tpu.serving.serverless import lambda_handler, make_batch_handler
+
+
+@pytest.fixture()
+def trained_model():
+    dataset = Dataset(name="ds", test_size=0.2, shuffle=True, targets=["y"])
+    model = Model(name="serverless_model", init=LogisticRegression, dataset=dataset)
+
+    @dataset.reader
+    def reader(n: int = 60) -> pd.DataFrame:
+        rows = [{"x0": float(i % 7), "x1": float((i * 3) % 5), "y": i % 2} for i in range(n)]
+        return pd.DataFrame(rows)
+
+    @model.trainer
+    def trainer(est: LogisticRegression, features: pd.DataFrame, target: pd.DataFrame) -> LogisticRegression:
+        return est.fit(features, target.squeeze())
+
+    @model.predictor
+    def predictor(est: LogisticRegression, features: pd.DataFrame) -> List[float]:
+        return [float(v) for v in est.predict(features)]
+
+    model.train(hyperparameters={"max_iter": 500})
+    return model
+
+
+FEATURES = [{"x0": 1.0, "x1": 2.0}, {"x0": 3.0, "x1": 1.0}, {"x0": 0.0, "x1": 4.0}]
+
+
+def _api_gateway_v1_event(payload: dict) -> dict:
+    """Reference fixture shape: tests/unit/test_aws_lambda_handler.py:18-72."""
+    return {
+        "httpMethod": "POST",
+        "path": "/predict",
+        "headers": {"Content-Type": "application/json"},
+        "body": json.dumps(payload),
+        "isBase64Encoded": False,
+    }
+
+
+def _api_gateway_v2_event(payload: dict) -> dict:
+    return {
+        "rawPath": "/predict",
+        "requestContext": {"http": {"method": "POST", "path": "/predict"}},
+        "body": json.dumps(payload),
+    }
+
+
+def test_lambda_handler_predict_v1(trained_model):
+    handler = lambda_handler(trained_model.serve())
+    response = handler(_api_gateway_v1_event({"features": FEATURES}), None)
+    assert response["statusCode"] == 200
+    predictions = json.loads(response["body"])
+    assert len(predictions) == len(FEATURES)
+    assert all(p in (0.0, 1.0) for p in predictions)
+
+
+def test_lambda_handler_predict_v2(trained_model):
+    handler = lambda_handler(trained_model.serve())
+    response = handler(_api_gateway_v2_event({"features": FEATURES}), None)
+    assert response["statusCode"] == 200
+    assert len(json.loads(response["body"])) == len(FEATURES)
+
+
+def test_lambda_handler_health_and_404(trained_model):
+    handler = lambda_handler(trained_model.serve())
+    health = handler({"httpMethod": "GET", "path": "/health"}, None)
+    assert health["statusCode"] == 200
+    missing = handler({"httpMethod": "GET", "path": "/nope"}, None)
+    assert missing["statusCode"] == 404
+
+
+def test_lambda_handler_base64_body(trained_model):
+    import base64
+
+    handler = lambda_handler(trained_model.serve())
+    event = _api_gateway_v1_event({"features": FEATURES})
+    event["body"] = base64.b64encode(event["body"].encode()).decode()
+    event["isBase64Encoded"] = True
+    response = handler(event, None)
+    assert response["statusCode"] == 200
+
+
+class InMemoryStore:
+    """Object-store stand-in (the reference mocks boto3's s3_client the same way,
+    test_aws_lambda_handler.py:141-161)."""
+
+    def __init__(self):
+        self.objects = {}
+
+    def download_file(self, bucket: str, key: str, filename: str) -> None:
+        Path(filename).write_bytes(self.objects[(bucket, key)])
+
+    def upload_file(self, filename: str, bucket: str, key: str) -> None:
+        self.objects[(bucket, key)] = Path(filename).read_bytes()
+
+
+def _s3_event(bucket: str, key: str) -> dict:
+    """Reference fixture shape: tests/unit/test_aws_lambda_handler.py:75-110."""
+    return {"Records": [{"s3": {"bucket": {"name": bucket}, "object": {"key": key}}}]}
+
+
+def test_batch_handler_s3_flow(trained_model):
+    store = InMemoryStore()
+    store.objects[("inbox", "uploads/features.json")] = json.dumps(FEATURES).encode()
+
+    handler = make_batch_handler(trained_model, store)
+    result = handler(_s3_event("inbox", "uploads/features.json"), None)
+    assert result["statusCode"] == 200
+    assert result["outputs"] == [{"bucket": "inbox", "key": "predictions/features.json"}]
+    predictions = json.loads(store.objects[("inbox", "predictions/features.json")])
+    assert len(predictions) == len(FEATURES)
+
+
+def test_batch_handler_runs_feature_pipeline_once():
+    """A feature_loader that only accepts a Path: the handler must not re-run
+    dataset.get_features on already-loaded features (SURVEY.md §3.2 double-processing
+    quirk)."""
+    dataset = Dataset(name="ds", test_size=0.2, shuffle=True, targets=["y"])
+    model = Model(name="once_model", init=LogisticRegression, dataset=dataset)
+
+    @dataset.reader
+    def reader(n: int = 60) -> pd.DataFrame:
+        rows = [{"x0": float(i % 7), "x1": float((i * 3) % 5), "y": i % 2} for i in range(n)]
+        return pd.DataFrame(rows)
+
+    @dataset.feature_loader
+    def feature_loader(features: Path) -> pd.DataFrame:
+        assert isinstance(features, Path), f"feature_loader re-invoked on {type(features)}"
+        return pd.DataFrame(json.loads(features.read_text()))
+
+    @model.trainer
+    def trainer(est: LogisticRegression, features: pd.DataFrame, target: pd.DataFrame) -> LogisticRegression:
+        return est.fit(features, target.squeeze())
+
+    @model.predictor
+    def predictor(est: LogisticRegression, features: pd.DataFrame) -> List[float]:
+        return [float(v) for v in est.predict(features)]
+
+    model.train(hyperparameters={"max_iter": 500})
+
+    store = InMemoryStore()
+    store.objects[("inbox", "uploads/features.json")] = json.dumps(FEATURES).encode()
+    handler = make_batch_handler(model, store)
+    result = handler(_s3_event("inbox", "uploads/features.json"), None)
+    assert result["statusCode"] == 200
+    assert len(json.loads(store.objects[("inbox", "predictions/features.json")])) == len(FEATURES)
+
+
+def test_batch_handler_skips_malformed_records(trained_model):
+    handler = make_batch_handler(trained_model, InMemoryStore())
+    result = handler({"Records": [{"s3": {}}]}, None)
+    assert result == {"statusCode": 200, "outputs": []}
+
+
+def test_batch_handler_ignores_own_outputs(trained_model):
+    """Whole-bucket event notifications must not recurse on the handler's own
+    predictions objects."""
+    store = InMemoryStore()
+    store.objects[("inbox", "predictions/features.json")] = json.dumps([1.0]).encode()
+    handler = make_batch_handler(trained_model, store)
+    result = handler(_s3_event("inbox", "predictions/features.json"), None)
+    assert result == {"statusCode": 200, "outputs": []}
+
+    # a distinct output bucket is safe: same-prefix inputs still process
+    store2 = InMemoryStore()
+    store2.objects[("inbox", "predictions/features.json")] = json.dumps(FEATURES).encode()
+    handler2 = make_batch_handler(trained_model, store2, output_bucket="outbox")
+    result2 = handler2(_s3_event("inbox", "predictions/features.json"), None)
+    assert result2["outputs"] == [{"bucket": "outbox", "key": "predictions/features.json"}]

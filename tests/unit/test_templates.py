@@ -1,0 +1,45 @@
+"""Template integrity tests: each shipped template renders and its app module imports
+(decoration-time guards pass); the basic template additionally trains and predicts.
+Analog of the reference's template-carried test suites
+(templates/basic-aws-lambda/.../tests/unit/test_handler.py)."""
+
+import importlib
+import sys
+from pathlib import Path
+
+import pytest
+
+from unionml_tpu.templating import list_templates, render_template
+
+
+@pytest.fixture()
+def render(tmp_path, monkeypatch):
+    def _render(template: str):
+        project = render_template(template, "rendered_app", tmp_path, git_init=False)
+        monkeypatch.syspath_prepend(str(project))
+        for mod in ("app", "handler"):
+            sys.modules.pop(mod, None)
+        return project
+
+    yield _render
+    for mod in ("app", "handler"):
+        sys.modules.pop(mod, None)
+
+
+@pytest.mark.parametrize("template", sorted(set(list_templates())))
+def test_template_app_imports(render, template):
+    render(template)
+    module = importlib.import_module("app")
+    assert module.model.name
+    assert module.dataset._reader is not None
+
+
+def test_basic_template_trains_and_predicts(render):
+    render("basic")
+    module = importlib.import_module("app")
+    from sklearn.datasets import load_digits
+
+    model_object, metrics = module.model.train(hyperparameters={"max_iter": 10000})
+    assert metrics["train"] > 0.9
+    sample = load_digits(as_frame=True).frame.sample(5, random_state=42)
+    assert len(module.model.predict(features=sample)) == 5

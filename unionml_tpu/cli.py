@@ -1,0 +1,230 @@
+"""unionml-tpu command-line interface.
+
+Parity surface: reference unionml/cli.py:26-212 — a typer app exposing ``init``,
+``deploy``, ``train``, ``predict``, ``list-model-versions``, ``fetch-model`` and a
+``serve`` command that boots the HTTP prediction service with ``--model-path``. typer
+is not in the TPU image, so this is a plain ``click`` group with the same command
+names, options, and behaviors; ``serve`` runs our self-contained asyncio server
+(:mod:`unionml_tpu.serving.http`) instead of wrapping uvicorn (cli.py:172-205).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from pathlib import Path
+from typing import Any, Optional
+
+import click
+
+from unionml_tpu.defaults import MODEL_PATH_ENV_VAR
+
+
+@click.group(name="unionml-tpu")
+@click.version_option(package_name="unionml-tpu", message="%(version)s")
+def app() -> None:
+    """unionml-tpu: deploy TPU-native machine learning microservices."""
+
+
+def _locate_model(app_ref: str) -> Any:
+    """Import ``module:variable`` and return the Model (reference remote.get_model)."""
+    from unionml_tpu.resolver import locate
+
+    sys.path.insert(0, os.getcwd())
+    obj = locate(app_ref)
+    return obj
+
+
+def _parse_json_option(raw: Optional[str], option: str) -> Any:
+    if raw is None:
+        return None
+    try:
+        return json.loads(raw)
+    except json.JSONDecodeError as exc:
+        raise click.BadParameter(f"{option} must be valid JSON: {exc}")
+
+
+@app.command("init")
+@click.argument("app_name")
+@click.option(
+    "--template",
+    "-t",
+    default="basic",
+    show_default=True,
+    help="template to scaffold the app from (see `unionml-tpu templates`)",
+)
+def init(app_name: str, template: str) -> None:
+    """Initialize a new unionml-tpu project (reference cli.py:33-51)."""
+    from unionml_tpu.templating import render_template
+
+    try:
+        project_dir = render_template(template, app_name, Path.cwd())
+    except (ValueError, FileExistsError) as exc:
+        raise click.ClickException(str(exc))
+    click.echo(f"Created unionml-tpu project at {project_dir}")
+
+
+@app.command("templates")
+def templates() -> None:
+    """List available project templates."""
+    from unionml_tpu.templating import list_templates
+
+    for name in list_templates():
+        click.echo(name)
+
+
+@app.command("deploy")
+@click.argument("app_ref", metavar="APP")
+@click.option("--app-version", default=None, help="app version; defaults to the git HEAD sha")
+@click.option("--allow-uncommitted", is_flag=True, default=False, help="deploy with uncommitted changes")
+@click.option("--patch", is_flag=True, default=False, help="fast re-registration: re-ship source only")
+def deploy(app_ref: str, app_version: Optional[str], allow_uncommitted: bool, patch: bool) -> None:
+    """Deploy a model's train/predict services to the backend (reference cli.py:54-82)."""
+    model = _locate_model(app_ref)
+    version = model.remote_deploy(app_version=app_version, allow_uncommitted=allow_uncommitted, patch=patch)
+    click.echo(f"Deployed {app_ref} version {version}")
+
+
+@app.command("train")
+@click.argument("app_ref", metavar="APP")
+@click.option("--inputs", "-i", default=None, help="training inputs as a JSON object")
+@click.option("--app-version", default=None, help="app version to run; defaults to latest deployed")
+def train(app_ref: str, inputs: Optional[str], app_version: Optional[str]) -> None:
+    """Train a model on the backend (reference cli.py:85-103)."""
+    model = _locate_model(app_ref)
+    parsed = _parse_json_option(inputs, "--inputs") or {}
+    click.echo(f"Training {model.name}")
+    model.remote_train(app_version=app_version, wait=True, **parsed)
+    assert model.artifact is not None
+    click.echo("Done.")
+    click.echo(f"Model: {model.artifact.model_object}")
+    click.echo(f"Metrics: {model.artifact.metrics}")
+
+
+@app.command("predict")
+@click.argument("app_ref", metavar="APP")
+@click.option("--inputs", "-i", default=None, help="prediction inputs (reader kwargs) as a JSON object")
+@click.option(
+    "--features",
+    "-f",
+    default=None,
+    type=click.Path(exists=True, dir_okay=False, path_type=Path),
+    help="generate predictions from a JSON file of features",
+)
+@click.option("--app-version", default=None, help="app version to run; defaults to latest deployed")
+@click.option("--model-version", default="latest", show_default=True, help="model version to predict with")
+def predict(
+    app_ref: str,
+    inputs: Optional[str],
+    features: Optional[Path],
+    app_version: Optional[str],
+    model_version: str,
+) -> None:
+    """Generate predictions on the backend (reference cli.py:106-127)."""
+    model = _locate_model(app_ref)
+    parsed_inputs = _parse_json_option(inputs, "--inputs") or {}
+    parsed_features = json.loads(features.read_text()) if features is not None else None
+    click.echo(f"Generating predictions with {model.name}")
+    predictions = model.remote_predict(
+        app_version=app_version,
+        model_version=None if model_version == "latest" else model_version,
+        wait=True,
+        features=parsed_features,
+        **parsed_inputs,
+    )
+    click.echo(f"Predictions: {predictions}")
+
+
+@app.command("list-model-versions")
+@click.argument("app_ref", metavar="APP")
+@click.option("--app-version", default=None, help="app version; defaults to latest deployed")
+@click.option("--limit", default=10, show_default=True, help="maximum number of versions to list")
+def list_model_versions(app_ref: str, app_version: Optional[str], limit: int) -> None:
+    """List all trained model versions, newest first (reference cli.py:130-144)."""
+    model = _locate_model(app_ref)
+    app_version = app_version or model._backend.latest_app_version(model)
+    click.echo(f"Listing model versions for app {app_ref} (app version: {app_version})")
+    for version in model.remote_list_model_versions(app_version=app_version, limit=limit):
+        click.echo(f"- {version}")
+
+
+@app.command("fetch-model")
+@click.argument("app_ref", metavar="APP")
+@click.option("--app-version", default=None, help="app version; defaults to latest deployed")
+@click.option("--model-version", default="latest", show_default=True, help="model version to fetch")
+@click.option(
+    "--output-file",
+    "-o",
+    required=True,
+    type=click.Path(dir_okay=False, path_type=Path),
+    help="path to write the fetched model object to",
+)
+@click.option("--kwargs", default=None, help="JSON keyword arguments forwarded to the model saver")
+def fetch_model(
+    app_ref: str,
+    app_version: Optional[str],
+    model_version: str,
+    output_file: Path,
+    kwargs: Optional[str],
+) -> None:
+    """Fetch a trained model from the backend registry and save it locally
+    (reference cli.py:147-164)."""
+    model = _locate_model(app_ref)
+    saver_kwargs = _parse_json_option(kwargs, "--kwargs") or {}
+    model.artifact = model._backend.fetch_latest_artifact(
+        model, app_version=app_version, model_version=model_version
+    )
+    model.save(output_file, **saver_kwargs)
+    click.echo(f"Model saved to {output_file}")
+
+
+@app.command("serve")
+@click.argument("app_ref", metavar="APP")
+@click.option("--model-path", default=None, type=click.Path(path_type=Path), help="path to the saved model object")
+@click.option("--host", default="127.0.0.1", show_default=True)
+@click.option("--port", default=8000, show_default=True, type=int)
+@click.option("--remote", is_flag=True, default=False, help="load the model from the remote backend registry")
+@click.option("--app-version", default=None, help="app version for --remote model loading")
+@click.option("--model-version", default="latest", show_default=True, help="model version for --remote loading")
+def serve(
+    app_ref: str,
+    model_path: Optional[Path],
+    host: str,
+    port: int,
+    remote: bool,
+    app_version: Optional[str],
+    model_version: str,
+) -> None:
+    """Start the HTTP prediction service (reference cli.py:172-205).
+
+    The reference clones uvicorn's CLI and injects ``--model-path`` via the
+    ``UNIONML_MODEL_PATH`` env var, refusing to run when the variable is pre-set
+    (cli.py:187-202); identical semantics here, on our own server.
+    """
+    if model_path is not None:
+        if os.getenv(MODEL_PATH_ENV_VAR) is not None:
+            raise click.ClickException(
+                f"{MODEL_PATH_ENV_VAR} environment variable is already set, which takes precedence "
+                "over the --model-path option. Unset it to use --model-path."
+            )
+        if not model_path.exists():
+            raise click.ClickException(f"model path {model_path} does not exist")
+        os.environ[MODEL_PATH_ENV_VAR] = str(model_path)
+
+    target = _locate_model(app_ref)
+    from unionml_tpu.serving import ServingApp
+
+    if isinstance(target, ServingApp):
+        serving = target
+    else:
+        serving = target.serve(remote=remote, app_version=app_version, model_version=model_version)
+    serving.run(host=host, port=port)
+
+
+def main() -> None:  # console-script entry point (reference setup.py:34)
+    app()
+
+
+if __name__ == "__main__":
+    main()

@@ -69,6 +69,10 @@ class BertEncoder(nn.Module):
             )(token_type_ids)
         x = nn.LayerNorm(dtype=cfg.dtype, name="embed_norm")(x)
 
+        # [B, L] padding mask (1 = real token) -> [B, 1, 1, L] broadcast over heads
+        # and query positions, so pad tokens are never attended to
+        mask = attention_mask[:, None, None, :].astype(bool) if attention_mask is not None else None
+
         for i in range(cfg.n_layers):
             x = TransformerBlock(
                 n_heads=cfg.n_heads,
@@ -77,7 +81,7 @@ class BertEncoder(nn.Module):
                 dtype=cfg.dtype,
                 param_dtype=cfg.param_dtype,
                 name=f"layer_{i}",
-            )(x)
+            )(x, mask=mask)
 
         pooled = jnp.tanh(
             nn.Dense(cfg.dim, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="pooler")(x[:, 0])
@@ -100,12 +104,17 @@ def bert_partition_rules() -> PartitionRules:
 
 
 def classification_loss(apply_fn, params, batch) -> Any:
-    """(tokens, labels) -> (loss, {'accuracy': ...}); use with make_train_step(has_aux=True)."""
+    """(tokens, labels) or (tokens, attention_mask, labels) -> (loss, {'accuracy': ...});
+    use with make_train_step(has_aux=True)."""
     import optax
 
-    tokens, labels = batch
+    if len(batch) == 3:
+        tokens, attention_mask, labels = batch
+        logits = apply_fn(params, tokens, attention_mask)
+    else:
+        tokens, labels = batch
+        logits = apply_fn(params, tokens)
     labels = labels.reshape(-1).astype(jnp.int32)
-    logits = apply_fn(params, tokens)
     loss = optax.softmax_cross_entropy_with_integer_labels(logits.astype(jnp.float32), labels).mean()
     accuracy = (jnp.argmax(logits, -1) == labels).mean()
     return loss, {"accuracy": accuracy}

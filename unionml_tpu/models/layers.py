@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from unionml_tpu.ops.attention import dot_product_attention, multihead_attention
+from unionml_tpu.ops.attention import multihead_attention
 
 Dtype = Any
 
@@ -86,9 +86,11 @@ class LoRADense(nn.Module):
 class Attention(nn.Module):
     """Multi-head (optionally grouped-query) attention with RoPE and impl dispatch.
 
-    ``impl``: ``"auto"`` (pallas flash on TPU when aligned, XLA otherwise),
-    ``"xla"``, ``"flash"``, or ``"ring"`` (sequence-parallel exact attention; requires
-    running inside shard_map with a ``sequence`` axis).
+    ``impl``: ``"auto"`` (currently XLA — flash stays opt-in until the pallas kernel
+    beats XLA's fused attention on its benchmark; see
+    :func:`unionml_tpu.ops.attention.multihead_attention`), ``"xla"``, ``"flash"``, or
+    ``"ring"`` (sequence-parallel exact attention; requires running inside shard_map
+    with a ``sequence`` axis).
     """
 
     n_heads: int
@@ -103,7 +105,9 @@ class Attention(nn.Module):
     param_dtype: Dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x: jax.Array, positions: Optional[jax.Array] = None) -> jax.Array:
+    def __call__(
+        self, x: jax.Array, positions: Optional[jax.Array] = None, mask: Optional[jax.Array] = None
+    ) -> jax.Array:
         features = x.shape[-1]
         n_kv = self.n_kv_heads or self.n_heads
         head_dim = self.head_dim or features // self.n_heads
@@ -127,16 +131,13 @@ class Attention(nn.Module):
             k = rotary_embedding(k, positions, self.rope_theta)
 
         if self.impl == "ring":
+            if mask is not None:
+                raise NotImplementedError("ring attention does not support arbitrary masks")
             from unionml_tpu.ops.ring_attention import ring_attention
 
             out = ring_attention(q, k, v, causal=self.causal)
-        elif self.impl in ("flash", "xla"):
-            if self.impl == "flash":
-                out = multihead_attention(q, k, v, causal=self.causal, impl="flash")
-            else:
-                out = dot_product_attention(q, k, v, causal=self.causal)
         else:
-            out = multihead_attention(q, k, v, causal=self.causal, impl="auto")
+            out = multihead_attention(q, k, v, causal=self.causal, mask=mask, impl=self.impl)
 
         out = out.reshape(batch, length, self.n_heads * head_dim)
         return dense(features, "o_proj")(out)
@@ -180,7 +181,9 @@ class TransformerBlock(nn.Module):
     param_dtype: Dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x: jax.Array, positions: Optional[jax.Array] = None) -> jax.Array:
+    def __call__(
+        self, x: jax.Array, positions: Optional[jax.Array] = None, mask: Optional[jax.Array] = None
+    ) -> jax.Array:
         norm = (
             (lambda name: RMSNorm(dtype=self.dtype, name=name))
             if self.decoder
@@ -197,7 +200,7 @@ class TransformerBlock(nn.Module):
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             name="attn",
-        )(norm("attn_norm")(x), positions)
+        )(norm("attn_norm")(x), positions, mask)
         x = x + MLP(
             hidden_dim=self.hidden_dim,
             gated=self.decoder,

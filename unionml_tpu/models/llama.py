@@ -40,10 +40,12 @@ class LlamaConfig:
 
     @classmethod
     def llama3_8b(cls, **overrides: Any) -> "LlamaConfig":
-        return cls(
+        defaults = dict(
             vocab_size=128256, dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
-            hidden_dim=14336, rope_theta=500000.0, **overrides,
+            hidden_dim=14336, rope_theta=500000.0,
         )
+        defaults.update(overrides)
+        return cls(**defaults)
 
     @classmethod
     def tiny(cls, **overrides: Any) -> "LlamaConfig":

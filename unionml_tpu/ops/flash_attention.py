@@ -37,7 +37,11 @@ DEFAULT_BLOCK_K = 128
 _NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch, acc_scratch, *, causal, block_q, block_k, scale):
+def _flash_fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch, acc_scratch, *, causal, block_q, block_k, scale, offset
+):
+    # offset = k_len - q_len: with unequal lengths, query row i may attend keys up to
+    # i + offset (matching dot_product_attention's shifted diagonal)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     num_k = pl.num_programs(2)
@@ -57,7 +61,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch, acc_scra
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
-            scores = jnp.where(q_pos >= k_pos, scores, _NEG_INF)
+            scores = jnp.where(q_pos + offset >= k_pos, scores, _NEG_INF)
 
         m_prev = m_scratch[:]  # [block_q, 1]
         m_curr = jnp.max(scores, axis=-1, keepdims=True)
@@ -71,8 +75,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch, acc_scra
         l_scratch[:] = l_next
 
     if causal:
-        # skip k blocks entirely above the diagonal
-        @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+        # skip k blocks entirely above the (offset-shifted) diagonal
+        @pl.when(ki * block_k <= qi * block_q + block_q - 1 + offset)
         def _():
             _compute()
     else:
@@ -99,7 +103,7 @@ def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool, inter
     grid = (batch * n_heads, q_len // block_q, k_len // block_k)
 
     kernel = functools.partial(
-        _flash_fwd_kernel, causal=causal, block_q=block_q, block_k=block_k, scale=scale
+        _flash_fwd_kernel, causal=causal, block_q=block_q, block_k=block_k, scale=scale, offset=k_len - q_len
     )
     if pltpu is None:  # pragma: no cover
         raise RuntimeError("pallas TPU backend unavailable; use impl='xla' attention instead")

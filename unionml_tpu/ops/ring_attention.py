@@ -56,9 +56,8 @@ def ring_attention(
     l = jnp.zeros((batch, n_heads, q_len, 1), dtype=jnp.float32)
     acc = jnp.zeros((batch, n_heads, q_len, head_dim), dtype=jnp.float32)
 
-    def body(step, carry):
-        m, l, acc, k_blk, v_blk = carry
-        # which global block this device holds at this step (blocks rotate forward)
+    def attend(step, m, l, acc, k_blk, v_blk):
+        # which global block this device holds after ``step`` rotations
         src = (my_index - step) % ring_size
         scores = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32))
         if causal:
@@ -72,13 +71,20 @@ def ring_attention(
         p = jnp.exp(scores - m_next)
         l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc = acc * alpha + jnp.einsum("bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
+        return m_next, l, acc
 
+    def body(step, carry):
+        # rotate first, then accumulate: the loop runs steps 1..ring_size-1, so only
+        # ring_size-1 ppermutes happen — no discarded final K/V transfer
+        m, l, acc, k_blk, v_blk = carry
         perm = [(i, (i + 1) % ring_size) for i in range(ring_size)]
         k_blk = lax.ppermute(k_blk, axis_name=axis, perm=perm)
         v_blk = lax.ppermute(v_blk, axis_name=axis, perm=perm)
-        return m_next, l, acc, k_blk, v_blk
+        m, l, acc = attend(step, m, l, acc, k_blk, v_blk)
+        return m, l, acc, k_blk, v_blk
 
-    m, l, acc, _, _ = lax.fori_loop(0, ring_size, body, (m, l, acc, k, v))
+    m, l, acc = attend(0, m, l, acc, k, v)
+    m, l, acc, _, _ = lax.fori_loop(1, ring_size, body, (m, l, acc, k, v))
     denom = jnp.where(l == 0.0, 1.0, l)
     out = (acc / denom).astype(q.dtype)  # [B, H, Lq, D]
     return out.transpose(0, 2, 1, 3)

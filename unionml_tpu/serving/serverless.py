@@ -1,0 +1,130 @@
+"""Serverless serving adapters.
+
+Parity surface: the reference ships two AWS Lambda deployment styles —
+
+- an API-Gateway HTTP app wrapped with ``Mangum(app)``
+  (templates/basic-aws-lambda/{{cookiecutter.app_name}}/app.py), and
+- an S3-event batch handler that downloads a features file, runs
+  ``dataset.get_features`` -> ``model.predict``, and uploads predictions
+  (templates/basic-aws-lambda-s3/{{cookiecutter.app_name}}/app.py; tested in
+  tests/unit/test_aws_lambda_handler.py:75-161).
+
+Mangum/boto3 are not in the TPU image, so this module implements the two adapters
+directly against our :class:`~unionml_tpu.serving.app.ServingApp`: a tiny
+API-Gateway-event <-> HTTP bridge (the Mangum analog, supporting both RESTv1 and
+HTTP-API-v2 event shapes) and an object-store batch handler with an injectable client
+so cloud SDKs plug in without being imports of the framework.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+from pathlib import Path
+from typing import Any, Callable, Dict, Optional, Protocol
+
+from unionml_tpu._logging import logger
+from unionml_tpu.serving.app import ServingApp, _to_jsonable
+
+
+def _event_request(event: Dict[str, Any]) -> tuple:
+    """Extract (method, path, body) from an API Gateway event (v1 or v2 payload)."""
+    if "requestContext" in event and "http" in event.get("requestContext", {}):  # HTTP API v2
+        method = event["requestContext"]["http"]["method"]
+        path = event.get("rawPath") or event["requestContext"]["http"].get("path", "/")
+    else:  # REST API v1
+        method = event.get("httpMethod", "GET")
+        path = event.get("path", "/")
+    body = event.get("body") or ""
+    if event.get("isBase64Encoded"):
+        raw = base64.b64decode(body)
+    else:
+        raw = body.encode() if isinstance(body, str) else body
+    return method, path, raw
+
+
+def lambda_handler(serving: ServingApp) -> Callable[[Dict[str, Any], Any], Dict[str, Any]]:
+    """Wrap a :class:`ServingApp` as an API-Gateway Lambda handler (the Mangum analog).
+
+    Usage in an app module::
+
+        model.serve()               # returns the ServingApp
+        handler = lambda_handler(model.serve())
+    """
+
+    def handler(event: Dict[str, Any], context: Any = None) -> Dict[str, Any]:
+        method, path, body = _event_request(event)
+        status, payload, content_type = asyncio.run(serving.dispatch(method, path, body))
+        body_out = payload if isinstance(payload, str) else json.dumps(payload, default=str)
+        return {
+            "statusCode": status,
+            "headers": {"Content-Type": content_type},
+            "body": body_out,
+            "isBase64Encoded": False,
+        }
+
+    return handler
+
+
+class ObjectStoreClient(Protocol):
+    """Minimal get/put protocol for the batch handler. boto3's S3 client satisfies it
+    via the adapter below; tests inject an in-memory implementation."""
+
+    def download_file(self, bucket: str, key: str, filename: str) -> None: ...
+
+    def upload_file(self, filename: str, bucket: str, key: str) -> None: ...
+
+
+def make_batch_handler(
+    model: Any,
+    client: ObjectStoreClient,
+    *,
+    output_bucket: Optional[str] = None,
+    output_prefix: str = "predictions/",
+    model_path_env: Optional[str] = None,
+) -> Callable[[Dict[str, Any], Any], Dict[str, Any]]:
+    """Build an S3-event batch-prediction handler.
+
+    Parity: templates/basic-aws-lambda-s3 ``lambda_handler`` — for each S3 record:
+    download the features file, run it through ``dataset.get_features`` ->
+    ``model.predict``, and upload the predictions JSON next to the input (or to
+    ``output_bucket``/``output_prefix``).
+    """
+    import tempfile
+
+    def handler(event: Dict[str, Any], context: Any = None) -> Dict[str, Any]:
+        if model.artifact is None:
+            model.load_from_env(**({"env_var": model_path_env} if model_path_env else {}))
+        outputs = []
+        for record in event.get("Records", []):
+            s3_info = record.get("s3", {})
+            bucket = s3_info.get("bucket", {}).get("name")
+            key = s3_info.get("object", {}).get("key")
+            if not bucket or not key:
+                logger.warning(f"skipping malformed S3 record: {record}")
+                continue
+            if output_bucket in (None, bucket) and key.startswith(output_prefix):
+                # our own output landing back as an event — processing it would loop
+                # forever when the bucket notification covers the whole bucket
+                logger.info(f"skipping own output object s3://{bucket}/{key}")
+                continue
+            with tempfile.TemporaryDirectory() as tmp:
+                local_in = str(Path(tmp) / Path(key).name)
+                client.download_file(bucket, key, local_in)
+                # run the feature pipeline exactly once, then go straight to the
+                # predict-from-features graph (model.predict(features=...) would
+                # re-apply dataset.get_features — the double-processing quirk
+                # SURVEY.md §3.2 flags in the reference)
+                features = model._dataset.get_features(Path(local_in))
+                predictions = model.predict_from_features_workflow()(
+                    model_object=model.artifact.model_object, features=features
+                )
+                out_key = f"{output_prefix}{Path(key).stem}.json"
+                local_out = str(Path(tmp) / "predictions.json")
+                Path(local_out).write_text(json.dumps(_to_jsonable(predictions), default=str))
+                client.upload_file(local_out, output_bucket or bucket, out_key)
+                outputs.append({"bucket": output_bucket or bucket, "key": out_key})
+        return {"statusCode": 200, "outputs": outputs}
+
+    return handler

@@ -1,0 +1,31 @@
+import json
+import os
+
+import pytest
+from sklearn.datasets import load_digits
+
+
+@pytest.fixture(scope="module")
+def trained_model(tmp_path_factory):
+    from app import model
+
+    model.train(hyperparameters={"max_iter": 10000})
+    path = tmp_path_factory.mktemp("model") / "model_object.joblib"
+    model.save(path)
+    os.environ["UNIONML_MODEL_PATH"] = str(path)
+    yield model
+    os.environ.pop("UNIONML_MODEL_PATH", None)
+
+
+def test_predict_event(trained_model):
+    from handler import handler
+
+    sample = load_digits(as_frame=True).frame.sample(5, random_state=42).drop(["target"], axis="columns")
+    event = {
+        "httpMethod": "POST",
+        "path": "/predict",
+        "body": json.dumps({"features": json.loads(sample.to_json(orient="records"))}),
+    }
+    response = handler(event, None)
+    assert response["statusCode"] == 200
+    assert len(json.loads(response["body"])) == 5

@@ -1,0 +1,42 @@
+"""{{app_name}}: a unionml-tpu app serving an sklearn digits classifier."""
+
+from typing import List
+
+import pandas as pd
+from sklearn.datasets import load_digits
+from sklearn.linear_model import LogisticRegression
+from sklearn.metrics import accuracy_score
+
+from unionml_tpu import Dataset, Model
+
+dataset = Dataset(name="digits_dataset", test_size=0.2, shuffle=True, targets=["target"])
+model = Model(name="digits_classifier", init=LogisticRegression, dataset=dataset)
+model.__app_module__ = "app:model"
+
+
+@dataset.reader
+def reader() -> pd.DataFrame:
+    return load_digits(as_frame=True).frame
+
+
+@model.trainer
+def trainer(estimator: LogisticRegression, features: pd.DataFrame, target: pd.DataFrame) -> LogisticRegression:
+    return estimator.fit(features, target.squeeze())
+
+
+@model.predictor
+def predictor(estimator: LogisticRegression, features: pd.DataFrame) -> List[float]:
+    return [float(x) for x in estimator.predict(features)]
+
+
+@model.evaluator
+def evaluator(estimator: LogisticRegression, features: pd.DataFrame, target: pd.DataFrame) -> float:
+    return float(accuracy_score(target.squeeze(), estimator.predict(features)))
+
+
+if __name__ == "__main__":
+    model_object, metrics = model.train(hyperparameters={"max_iter": 10000})
+    predictions = model.predict(features=load_digits(as_frame=True).frame.sample(5, random_state=42))
+    print(model_object, metrics, predictions, sep="\n")
+
+    model.save("model_object.joblib")

@@ -1,0 +1,11 @@
+from sklearn.datasets import load_digits
+
+from app import model
+
+
+def test_train_and_predict():
+    model_object, metrics = model.train(hyperparameters={"max_iter": 10000})
+    assert metrics["train"] > 0.9
+    sample = load_digits(as_frame=True).frame.sample(5, random_state=42)
+    predictions = model.predict(features=sample)
+    assert len(predictions) == 5

@@ -1,0 +1,107 @@
+"""{{app_name}}: a TPU-native image classifier with a step-mode (jit-compiled) trainer.
+
+Analog of the reference's quickdraw template (pytorch + HF Trainer CNN): the trainer
+here is a ``(state, batch) -> (state, metrics)`` step compiled under ``jax.jit`` by the
+framework; swap ``MeshSpec`` in the TrainerConfig to shard across a TPU slice.
+"""
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pandas as pd
+from flax import linen as nn
+from flax.training import train_state
+from sklearn.datasets import load_digits
+
+from unionml_tpu import Dataset, Model, TrainerConfig
+
+IMAGE_SIZE = 8
+NUM_CLASSES = 10
+
+dataset = Dataset(name="digits_images", test_size=0.2, shuffle=True, targets=["target"])
+model = Model(name="{{app_name}}", dataset=dataset)
+model.__app_module__ = "app:model"
+
+
+class CNN(nn.Module):
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = x.reshape(x.shape[0], IMAGE_SIZE, IMAGE_SIZE, 1).astype(jnp.bfloat16)
+        x = nn.Conv(32, kernel_size=(3, 3))(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, window_shape=(2, 2), strides=(2, 2))
+        x = nn.Conv(64, kernel_size=(3, 3))(x)
+        x = nn.relu(x)
+        x = x.reshape(x.shape[0], -1)
+        x = nn.Dense(128)(x)
+        x = nn.relu(x)
+        return nn.Dense(NUM_CLASSES)(x).astype(jnp.float32)
+
+
+module = CNN()
+
+
+@dataset.reader
+def reader() -> pd.DataFrame:
+    return load_digits(as_frame=True).frame
+
+
+@model.init
+def init(hyperparameters: dict) -> train_state.TrainState:
+    params = module.init(jax.random.PRNGKey(0), jnp.zeros((1, IMAGE_SIZE * IMAGE_SIZE)))["params"]
+    return train_state.TrainState.create(
+        apply_fn=module.apply,
+        params=params,
+        tx=optax.adam(hyperparameters.get("learning_rate", 1e-3)),
+    )
+
+
+@model.trainer(config=TrainerConfig(epochs=10, batch_size=64, shuffle=True))
+def trainer(state: train_state.TrainState, batch) -> tuple:
+    features, target = batch
+
+    def loss_fn(params):
+        logits = module.apply({"params": params}, features)
+        return optax.softmax_cross_entropy_with_integer_labels(logits, target).mean()
+
+    loss, grads = jax.value_and_grad(loss_fn)(state.params)
+    return state.apply_gradients(grads=grads), {"loss": loss}
+
+
+@dataset.feature_transformer
+def feature_transformer(features) -> np.ndarray:
+    arr = np.asarray(features, dtype=np.float32)
+    if arr.ndim == 1:
+        arr = arr[None, :]
+    return arr / 16.0  # digits pixels are 0..16
+
+
+@dataset.parser
+def parser(
+    data: pd.DataFrame, features: Optional[List[str]], targets: List[str]
+) -> Tuple[np.ndarray, np.ndarray]:
+    target_cols = targets or ["target"]
+    feature_frame = data.drop(columns=[c for c in target_cols if c in data.columns])
+    target_arr = data[target_cols[0]].to_numpy(dtype=np.int32) if target_cols[0] in data.columns else np.zeros(len(data), np.int32)
+    return feature_frame.to_numpy(dtype=np.float32), target_arr
+
+
+@model.predictor
+def predictor(state: train_state.TrainState, features: np.ndarray) -> List[int]:
+    logits = module.apply({"params": state.params}, jnp.asarray(features))
+    return [int(i) for i in jnp.argmax(logits, axis=-1)]
+
+
+@model.evaluator
+def evaluator(state: train_state.TrainState, features: np.ndarray, target: np.ndarray) -> float:
+    logits = module.apply({"params": state.params}, jnp.asarray(features))
+    return float((jnp.argmax(logits, axis=-1) == jnp.asarray(target)).mean())
+
+
+if __name__ == "__main__":
+    model_object, metrics = model.train(hyperparameters={"learning_rate": 1e-3})
+    print(metrics)
+    model.save("model_object.ckpt")

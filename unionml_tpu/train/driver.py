@@ -285,7 +285,6 @@ def fit(
             steps_per_call = max(1, min(config.steps_per_call, source.steps_per_epoch() or 1))
 
             def payloads():
-                dropped_partial = 0
                 current_epoch = -1
                 epoch_data = data_dev
                 group: List[int] = []
@@ -297,13 +296,17 @@ def fit(
                         group
                     )
 
-                for epoch, lo, size in source.contiguous_schedule():
+                # the schedule only emits full batches (the source is built with
+                # drop_remainder=True, so steps_per_epoch floors)
+                for epoch, lo, _size in source.contiguous_schedule():
                     if epoch != current_epoch:
                         if group:
                             yield flush(epoch_data, group)
                             group = []
                         # release the previous epoch's permuted copy BEFORE building the
-                        # next one — bounds peak HBM at 2x the dataset, not 3x
+                        # next one — together with the fit loop dropping its payload
+                        # reference each step, peak HBM stays at 2x the dataset
+                        # (base + one permuted copy), not 3x
                         epoch_data = None
                         epoch_data = (
                             permute(data_dev, jnp.asarray(source._epoch_order(epoch)))
@@ -311,20 +314,12 @@ def fit(
                             else data_dev
                         )
                         current_epoch = epoch
-                    if size != config.batch_size:
-                        dropped_partial += 1  # partial batch would clamp/overlap under dynamic_slice
-                        continue
                     group.append(lo)
                     if len(group) == steps_per_call:
                         yield flush(epoch_data, group)
                         group = []
                 if group:
                     yield flush(epoch_data, group)
-                if dropped_partial:
-                    logger.info(
-                        f"device_data mode dropped {dropped_partial} partial final batch(es); "
-                        "use a batch_size dividing the split size to train on every sample"
-                    )
 
             def run_step(state: Any, payload: Any):
                 epoch_data, starts = payload
@@ -380,6 +375,10 @@ def fit(
                         first_batch_samples = batch_n
                     else:
                         state, last_metrics = run_step(state, payload)
+                # drop the payload reference before the generator's next epoch-boundary
+                # permute runs — otherwise the old permuted copy stays live and peak
+                # HBM hits 3x the dataset in device_data mode
+                payload = None
                 prev_step = step_idx
                 step_idx += steps_in_payload
                 samples_seen += batch_n
