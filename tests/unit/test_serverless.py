@@ -119,8 +119,10 @@ def test_batch_handler_s3_flow(trained_model):
     handler = make_batch_handler(trained_model, store)
     result = handler(_s3_event("inbox", "uploads/features.json"), None)
     assert result["statusCode"] == 200
-    assert result["outputs"] == [{"bucket": "inbox", "key": "predictions/features.json"}]
-    predictions = json.loads(store.objects[("inbox", "predictions/features.json")])
+    # the input key's directory prefix is preserved so same-named files under
+    # different prefixes don't overwrite each other's predictions
+    assert result["outputs"] == [{"bucket": "inbox", "key": "predictions/uploads/features.json"}]
+    predictions = json.loads(store.objects[("inbox", "predictions/uploads/features.json")])
     assert len(predictions) == len(FEATURES)
 
 
@@ -156,7 +158,7 @@ def test_batch_handler_runs_feature_pipeline_once():
     handler = make_batch_handler(model, store)
     result = handler(_s3_event("inbox", "uploads/features.json"), None)
     assert result["statusCode"] == 200
-    assert len(json.loads(store.objects[("inbox", "predictions/features.json")])) == len(FEATURES)
+    assert len(json.loads(store.objects[("inbox", "predictions/uploads/features.json")])) == len(FEATURES)
 
 
 def test_batch_handler_skips_malformed_records(trained_model):
@@ -179,4 +181,14 @@ def test_batch_handler_ignores_own_outputs(trained_model):
     store2.objects[("inbox", "predictions/features.json")] = json.dumps(FEATURES).encode()
     handler2 = make_batch_handler(trained_model, store2, output_bucket="outbox")
     result2 = handler2(_s3_event("inbox", "predictions/features.json"), None)
-    assert result2["outputs"] == [{"bucket": "outbox", "key": "predictions/features.json"}]
+    assert result2["outputs"] == [{"bucket": "outbox", "key": "predictions/predictions/features.json"}]
+
+
+def test_batch_handler_url_encoded_keys(trained_model):
+    """S3 event notifications URL-encode keys: 'daily report.csv' arrives as
+    'daily+report.csv' and must be decoded before the GetObject call."""
+    store = InMemoryStore()
+    store.objects[("inbox", "daily report.json")] = json.dumps(FEATURES).encode()
+    handler = make_batch_handler(trained_model, store)
+    result = handler(_s3_event("inbox", "daily+report.json"), None)
+    assert result["outputs"] == [{"bucket": "inbox", "key": "predictions/daily report.json"}]

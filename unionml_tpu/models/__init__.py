@@ -10,4 +10,10 @@ from unionml_tpu.models.llama import (  # noqa: F401
     lora_param_labels,
 )
 from unionml_tpu.models.mlp import MLPClassifier, MLPConfig  # noqa: F401
-from unionml_tpu.models.vit import ViT, ViTConfig, vit_partition_rules  # noqa: F401
+from unionml_tpu.models.vit import (  # noqa: F401
+    PipelinedViT,
+    ViT,
+    ViTConfig,
+    pipelined_vit_partition_rules,
+    vit_partition_rules,
+)

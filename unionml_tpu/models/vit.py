@@ -51,24 +51,7 @@ class ViT(nn.Module):
     @nn.compact
     def __call__(self, images: jax.Array) -> jax.Array:
         cfg = self.config
-        x = nn.Conv(
-            cfg.dim,
-            kernel_size=(cfg.patch_size, cfg.patch_size),
-            strides=(cfg.patch_size, cfg.patch_size),
-            dtype=cfg.dtype,
-            param_dtype=cfg.param_dtype,
-            name="patch_embed",
-        )(images.astype(cfg.dtype))
-        batch = x.shape[0]
-        x = x.reshape(batch, -1, cfg.dim)  # [B, n_patches, dim]
-
-        cls_token = self.param("cls_token", nn.initializers.zeros, (1, 1, cfg.dim), cfg.param_dtype)
-        x = jnp.concatenate([jnp.broadcast_to(cls_token.astype(cfg.dtype), (batch, 1, cfg.dim)), x], axis=1)
-        pos = self.param(
-            "pos_embed", nn.initializers.normal(0.02), (1, x.shape[1], cfg.dim), cfg.param_dtype
-        )
-        x = x + pos.astype(cfg.dtype)
-
+        x = ViTEmbed(cfg, name="embed")(images)
         for i in range(cfg.n_layers):
             x = TransformerBlock(
                 n_heads=cfg.n_heads,
@@ -78,9 +61,126 @@ class ViT(nn.Module):
                 param_dtype=cfg.param_dtype,
                 name=f"layer_{i}",
             )(x)
+        return ViTHead(cfg, name="head")(x)
 
+
+class ViTEmbed(nn.Module):
+    """Patchify + cls token + position embedding: images -> ``[B, 1+n_patches, dim]``."""
+
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, images: jax.Array) -> jax.Array:
+        cfg = self.config
+        x = nn.Conv(
+            cfg.dim,
+            kernel_size=(cfg.patch_size, cfg.patch_size),
+            strides=(cfg.patch_size, cfg.patch_size),
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            name="patch_embed",
+        )(images.astype(cfg.dtype))
+        batch = x.shape[0]
+        x = x.reshape(batch, -1, cfg.dim)
+        cls_token = self.param("cls_token", nn.initializers.zeros, (1, 1, cfg.dim), cfg.param_dtype)
+        x = jnp.concatenate([jnp.broadcast_to(cls_token.astype(cfg.dtype), (batch, 1, cfg.dim)), x], axis=1)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02), (1, x.shape[1], cfg.dim), cfg.param_dtype)
+        return x + pos.astype(cfg.dtype)
+
+
+class ViTStage(nn.Module):
+    """One pipeline stage: ``layers_per_stage`` encoder blocks, shape/dtype-preserving
+    (the contract :func:`unionml_tpu.parallel.pipeline.pipeline_apply` requires)."""
+
+    config: ViTConfig
+    layers_per_stage: int
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.config
+        for i in range(self.layers_per_stage):
+            x = TransformerBlock(
+                n_heads=cfg.n_heads,
+                hidden_dim=cfg.hidden_dim,
+                decoder=False,
+                dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+                name=f"layer_{i}",
+            )(x)
+        return x
+
+
+class ViTHead(nn.Module):
+    """Final norm + classification head on the cls token."""
+
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.config
         x = nn.LayerNorm(dtype=cfg.dtype, name="final_norm")(x)
         return nn.Dense(cfg.num_classes, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="head")(x[:, 0])
+
+
+class PipelinedViT:
+    """ViT partitioned for pipeline parallelism over the ``pipe`` mesh axis.
+
+    Not an ``nn.Module``: the stage stack is a *stacked* parameter tree driven by
+    :func:`unionml_tpu.parallel.pipeline.pipeline_apply` (SPMD pipeline, ppermute
+    rotation), which has no module-tree analog. The embed/head run replicated outside
+    the pipeline; params tree is ``{"embed": ..., "stages": [S, ...], "head": ...}``.
+    """
+
+    def __init__(self, config: ViTConfig, n_stages: int, n_microbatches: int = 4):
+        if config.n_layers % n_stages:
+            raise ValueError(f"n_layers={config.n_layers} not divisible by n_stages={n_stages}")
+        self.config = config
+        self.n_stages = n_stages
+        self.n_microbatches = n_microbatches
+        self.embed = ViTEmbed(config)
+        self.stage = ViTStage(config, layers_per_stage=config.n_layers // n_stages)
+        self.head = ViTHead(config)
+
+    def init(self, rng: jax.Array, images: jax.Array) -> Any:
+        from unionml_tpu.parallel.pipeline import init_stage_params
+
+        k_embed, k_stage, k_head = jax.random.split(rng, 3)
+        embedded = self.embed.init(k_embed, images)
+        sample = self.embed.apply(embedded, images[:1])
+        return {
+            "embed": embedded["params"],
+            "stages": init_stage_params(self.stage, k_stage, sample, self.n_stages),
+            "head": self.head.init(k_head, sample)["params"],
+        }
+
+    def apply(self, params: Any, images: jax.Array, mesh: Any) -> jax.Array:
+        from unionml_tpu.parallel.pipeline import pipeline_apply
+
+        x = self.embed.apply({"params": params["embed"]}, images)
+        stage_fn = lambda p, h: self.stage.apply({"params": p}, h)  # noqa: E731
+        x = pipeline_apply(stage_fn, params["stages"], x, mesh, n_microbatches=self.n_microbatches)
+        return self.head.apply({"params": params["head"]}, x)
+
+
+def pipelined_vit_partition_rules() -> PartitionRules:
+    """Rules for the ``PipelinedViT`` params tree: stacked stages gain a leading
+    ``pipe`` entry; embed/head replicate (they are small relative to the stack)."""
+    from unionml_tpu.parallel.pipeline import pipeline_rule_table
+
+    stage_rules = [
+        (r"attn/(q_proj|k_proj|v_proj)/kernel", P("fsdp", "model")),
+        (r"attn/o_proj/kernel", P("model", "fsdp")),
+        (r"mlp/wi/kernel", P("fsdp", "model")),
+        (r"mlp/wo/kernel", P("model", "fsdp")),
+    ]
+    return PartitionRules(
+        pipeline_rule_table(stage_rules)
+        + [
+            (r"embed/patch_embed/kernel", P(None, None, None, "fsdp")),
+            (r"head/head/kernel", P("fsdp", None)),
+            (r".*", P()),
+        ]
+    )
 
 
 def vit_partition_rules() -> PartitionRules:

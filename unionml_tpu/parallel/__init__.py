@@ -8,6 +8,12 @@ sharding annotations.
 """
 
 from unionml_tpu.parallel.mesh import MeshSpec  # noqa: F401
+from unionml_tpu.parallel.pipeline import (  # noqa: F401
+    init_stage_params,
+    pipeline_apply,
+    pipeline_rule_table,
+    sequential_stage_apply,
+)
 from unionml_tpu.parallel.sharding import (  # noqa: F401
     PartitionRules,
     batch_sharding,

@@ -13,7 +13,11 @@ Axis conventions (all optional, size-1 axes are free):
                  and MLP hidden dims.
 - ``sequence`` — sequence/context parallelism for long-context (ring attention
                  KV-block rotation rides this axis).
-- ``expert``   — expert parallelism for MoE layers.
+- ``pipe``     — pipeline parallelism; transformer stages are stacked on a leading
+                 stage dim sharded here, activations rotate stage-to-stage with
+                 ``ppermute`` (:mod:`unionml_tpu.parallel.pipeline`).
+- ``expert``   — expert parallelism for MoE layers (token dispatch rides this axis,
+                 :mod:`unionml_tpu.models.moe`).
 
 Cross-slice scaling: ``dcn_data`` adds an outer pure-DP axis over DCN so that only
 gradient all-reduces cross the slower inter-slice network, as recommended by the
@@ -32,7 +36,7 @@ import jax
 from jax.sharding import Mesh
 
 #: Canonical axis ordering — outermost (slowest-varying, DCN-adjacent) first.
-AXIS_ORDER: Tuple[str, ...] = ("dcn_data", "data", "fsdp", "sequence", "expert", "model")
+AXIS_ORDER: Tuple[str, ...] = ("dcn_data", "data", "fsdp", "pipe", "sequence", "expert", "model")
 
 #: Axes over which the batch dimension is sharded.
 BATCH_AXES: Tuple[str, ...] = ("dcn_data", "data", "fsdp")
@@ -46,6 +50,7 @@ class MeshSpec:
     fsdp: int = 1
     model: int = 1
     sequence: int = 1
+    pipe: int = 1
     expert: int = 1
     dcn_data: int = 1
 
@@ -54,6 +59,7 @@ class MeshSpec:
             "dcn_data": self.dcn_data,
             "data": self.data,
             "fsdp": self.fsdp,
+            "pipe": self.pipe,
             "sequence": self.sequence,
             "expert": self.expert,
             "model": self.model,
@@ -89,7 +95,7 @@ class MeshSpec:
 
     @property
     def num_devices_required(self) -> int:
-        sizes = [self.data, self.fsdp, self.model, self.sequence, self.expert, self.dcn_data]
+        sizes = [self.data, self.fsdp, self.model, self.sequence, self.pipe, self.expert, self.dcn_data]
         if any(s == -1 for s in sizes):
             return -1
         return math.prod(sizes)

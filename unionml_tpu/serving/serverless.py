@@ -21,6 +21,8 @@ from __future__ import annotations
 import asyncio
 import base64
 import json
+import tempfile
+import urllib.parse
 from pathlib import Path
 from typing import Any, Callable, Dict, Optional, Protocol
 
@@ -91,7 +93,6 @@ def make_batch_handler(
     ``model.predict``, and upload the predictions JSON next to the input (or to
     ``output_bucket``/``output_prefix``).
     """
-    import tempfile
 
     def handler(event: Dict[str, Any], context: Any = None) -> Dict[str, Any]:
         if model.artifact is None:
@@ -101,6 +102,8 @@ def make_batch_handler(
             s3_info = record.get("s3", {})
             bucket = s3_info.get("bucket", {}).get("name")
             key = s3_info.get("object", {}).get("key")
+            # S3 event notifications URL-encode object keys (spaces arrive as '+')
+            key = urllib.parse.unquote_plus(key) if key else key
             if not bucket or not key:
                 logger.warning(f"skipping malformed S3 record: {record}")
                 continue
@@ -120,7 +123,9 @@ def make_batch_handler(
                 predictions = model.predict_from_features_workflow()(
                     model_object=model.artifact.model_object, features=features
                 )
-                out_key = f"{output_prefix}{Path(key).stem}.json"
+                # keep the input key's directory prefix: same-named files under
+                # different prefixes must not overwrite each other's predictions
+                out_key = f"{output_prefix}{Path(key).with_suffix('.json')}"
                 local_out = str(Path(tmp) / "predictions.json")
                 Path(local_out).write_text(json.dumps(_to_jsonable(predictions), default=str))
                 client.upload_file(local_out, output_bucket or bucket, out_key)
