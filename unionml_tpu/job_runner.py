@@ -6,6 +6,14 @@ rebuild the requested workflow, run it, write outputs. Launched as
 ``python -m unionml_tpu.job_runner <execution_dir>`` on every host of a slice; when
 ``UNIONML_TPU_COORDINATOR`` is set the hosts join one JAX distributed runtime before
 executing, so pjit-compiled stages span the whole slice.
+
+Failure detection (SURVEY.md §5.3 — absent in the reference, which delegates retries
+to Flyte): a daemon thread stamps ``<execution_dir>/heartbeat`` every
+``UNIONML_TPU_HEARTBEAT_S`` seconds while the job runs. The backend watchdog
+(:meth:`unionml_tpu.remote.Backend.wait`) treats a RUNNING execution with a stale
+heartbeat as a lost slice and resubmits it; a trainer configured with
+``checkpoint_dir`` resumes from its last orbax step checkpoint. Fault injection for
+tests: ``UNIONML_TPU_FAULT_INJECT=N`` hard-kills attempts ``< N`` mid-run.
 """
 
 from __future__ import annotations
@@ -14,8 +22,50 @@ import json
 import os
 import pickle
 import sys
+import threading
+import time
 import traceback
 from pathlib import Path
+
+
+def _start_heartbeat(exec_path: Path, my_attempt: int) -> threading.Event:
+    """Stamp ``heartbeat`` periodically so the backend can detect a lost worker.
+
+    Fencing: if the attempt counter moves past ``my_attempt`` the backend has
+    declared this worker lost and resubmitted — a stalled-but-alive worker waking
+    back up must not race the new attempt for the outputs dir, so it kills itself.
+    """
+    interval = float(os.environ.get("UNIONML_TPU_HEARTBEAT_S", "5"))
+    stop = threading.Event()
+    heartbeat = exec_path / "heartbeat"
+
+    def beat() -> None:
+        while not stop.is_set():
+            if _current_attempt(exec_path) != my_attempt:
+                os._exit(43)  # fenced: a newer attempt owns this execution
+            try:
+                heartbeat.write_text(repr(time.time()))
+            except OSError:  # execution dir vanished (cancelled); nothing to report to
+                return
+            stop.wait(interval)
+
+    threading.Thread(target=beat, daemon=True, name="unionml-tpu-heartbeat").start()
+    return stop
+
+
+def _current_attempt(exec_path: Path) -> int:
+    attempt_file = exec_path / "attempt"
+    try:
+        return int(attempt_file.read_text().strip())
+    except (OSError, ValueError):
+        return 0
+
+
+def _maybe_inject_fault(exec_path: Path) -> None:
+    """Simulated slice failure: die without writing a terminal status."""
+    inject_below = int(os.environ.get("UNIONML_TPU_FAULT_INJECT", "0"))
+    if _current_attempt(exec_path) < inject_below:
+        os._exit(42)
 
 
 def _maybe_init_distributed() -> None:
@@ -37,11 +87,14 @@ def run_job(execution_dir: str) -> None:
     outputs = exec_path / "outputs"
     outputs.mkdir(exist_ok=True)
     status.write_text("RUNNING")
+    my_attempt = _current_attempt(exec_path)
+    stop_heartbeat = _start_heartbeat(exec_path, my_attempt)
     try:
         with open(exec_path / "spec.pkl", "rb") as f:
             spec = pickle.load(f)
 
         _maybe_init_distributed()
+        _maybe_inject_fault(exec_path)
 
         from unionml_tpu.resolver import locate
 
@@ -84,11 +137,15 @@ def run_job(execution_dir: str) -> None:
         else:
             raise ValueError(f"unknown job kind: {spec['kind']}")
 
+        if _current_attempt(exec_path) != my_attempt:
+            os._exit(43)  # fenced just before commit: a newer attempt owns the outputs
         status.write_text("SUCCEEDED")
     except Exception:
         traceback.print_exc()
         status.write_text("FAILED")
         sys.exit(1)
+    finally:
+        stop_heartbeat.set()
 
 
 if __name__ == "__main__":

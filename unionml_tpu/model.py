@@ -619,6 +619,7 @@ class Model:
         app_version: Optional[str] = None,
         wait: bool = True,
         *,
+        retries: int = 0,
         hyperparameters: Optional[Dict[str, Any]] = None,
         loader_kwargs: Optional[Dict[str, Any]] = None,
         splitter_kwargs: Optional[Dict[str, Any]] = None,
@@ -626,7 +627,13 @@ class Model:
         trainer_kwargs: Optional[Dict[str, Any]] = None,
         **reader_kwargs: Any,
     ) -> Any:
-        """Submit a training job to the backend (reference model.py:732-796)."""
+        """Submit a training job to the backend (reference model.py:732-796).
+
+        ``retries``: additional launch attempts if the worker fails or its slice is
+        lost (stale heartbeat); with a ``checkpoint_dir``-configured trainer each
+        retry resumes from the last step checkpoint. The reference delegates this
+        concern to Flyte (SURVEY.md §5.3); here it is first-class.
+        """
         execution = self._backend.submit_train(
             self,
             app_version=app_version,
@@ -639,7 +646,7 @@ class Model:
         )
         if not wait:
             return execution
-        self.remote_wait(execution)
+        self.remote_wait(execution, retries=retries)
         self.remote_load(execution)
         return self.artifact
 
@@ -649,10 +656,12 @@ class Model:
         model_version: Optional[str] = None,
         wait: bool = True,
         *,
+        retries: int = 0,
         features: Any = None,
         **reader_kwargs: Any,
     ) -> Any:
-        """Submit a prediction job to the backend (reference model.py:798-864)."""
+        """Submit a prediction job to the backend (reference model.py:798-864).
+        ``retries`` as in :meth:`remote_train`."""
         execution = self._backend.submit_predict(
             self,
             app_version=app_version,
@@ -662,7 +671,7 @@ class Model:
         )
         if not wait:
             return execution
-        execution = self._backend.wait(execution)
+        execution = self._backend.wait(execution, retries=retries)
         return self._backend.fetch_predictions(execution)
 
     def remote_wait(self, execution: Any, **kwargs: Any) -> Any:

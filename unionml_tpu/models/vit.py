@@ -153,13 +153,37 @@ class PipelinedViT:
             "head": self.head.init(k_head, sample)["params"],
         }
 
-    def apply(self, params: Any, images: jax.Array, mesh: Any) -> jax.Array:
+    def apply(self, params: Any, images: jax.Array, mesh: Any, rules: Any = None) -> jax.Array:
+        """Forward pass. Pass the same ``rules`` used to place ``params`` so the
+        stage stack stays sharded at rest over fsdp/model inside the pipeline
+        (each device transiently all-gathers only its own stage); without rules the
+        stage params must be replicated over the non-pipe axes."""
         from unionml_tpu.parallel.pipeline import pipeline_apply
 
         x = self.embed.apply({"params": params["embed"]}, images)
         stage_fn = lambda p, h: self.stage.apply({"params": p}, h)  # noqa: E731
-        x = pipeline_apply(stage_fn, params["stages"], x, mesh, n_microbatches=self.n_microbatches)
+        param_specs = stage_param_specs(params["stages"], rules) if rules is not None else None
+        x = pipeline_apply(
+            stage_fn,
+            params["stages"],
+            x,
+            mesh,
+            n_microbatches=self.n_microbatches,
+            param_specs=param_specs,
+        )
         return self.head.apply({"params": params["head"]}, x)
+
+
+def stage_param_specs(stage_params: Any, rules: PartitionRules, prefix: str = "stages/") -> Any:
+    """Resolve the PartitionSpec pytree for a stacked-stage subtree from a rule table
+    whose patterns are written against full-tree paths (``stages/...``)."""
+    from jax.sharding import PartitionSpec
+
+    from unionml_tpu.parallel.sharding import _path_str
+
+    paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(stage_params)
+    specs = [rules.spec_for(prefix + _path_str(path)) or PartitionSpec("pipe") for path, _ in paths_leaves]
+    return jax.tree_util.tree_unflatten(treedef, specs)
 
 
 def pipelined_vit_partition_rules() -> PartitionRules:

@@ -31,7 +31,6 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from unionml_tpu.parallel.mesh import BATCH_AXES
-from unionml_tpu.parallel.sharding import PartitionRules
 
 
 def _shard_map(fn, mesh, in_specs, out_specs):
@@ -77,18 +76,43 @@ def pipeline_apply(
     n_microbatches: int,
     axis: str = "pipe",
     batch_axes: Sequence[str] = BATCH_AXES,
+    param_specs: Any = None,
 ) -> jax.Array:
     """Run stacked stages as a pipelined SPMD computation over ``mesh``.
 
     :param stage_fn: ``(single_stage_params, activations [mb, ...]) -> activations``,
         shape/dtype-preserving.
     :param stage_params: pytree whose leaves carry a leading ``[n_stages, ...]`` dim,
-        placed with ``P("pipe", ...)`` shardings (see :func:`pipeline_partition_rules`).
+        placed with ``P("pipe", ...)`` shardings (see :func:`pipeline_rule_table`).
     :param x: global-batch activations ``[B, ...]``; ``B % n_microbatches == 0``.
+    :param param_specs: optional pytree of :class:`PartitionSpec` matching
+        ``stage_params`` and its actual placement (leading entry must be ``axis``).
+        When given, params stay sharded at rest over their intra-stage axes
+        (fsdp/model) and each device all-gathers only its own stage's params inside
+        the pipeline body — ZeRO-3-style transient materialization instead of a
+        whole-tree all-gather at the shard_map boundary. Gradients flow back through
+        the gather as reduce-scatter. When ``None``, params must be replicated over
+        every axis except ``axis``.
     """
     n_stages = mesh.shape.get(axis, 1)
     if n_stages <= 1:
         return sequential_stage_apply(stage_fn, stage_params, x)
+
+    spec_leaves = None
+    if param_specs is not None:
+        is_spec = lambda s: s is None or isinstance(s, P)  # noqa: E731
+        spec_leaves = [
+            s if isinstance(s, P) else P(axis)
+            for s in jax.tree_util.tree_leaves(param_specs, is_leaf=is_spec)
+        ]
+        for spec in spec_leaves:
+            first = spec[0] if len(spec) else None
+            names = first if isinstance(first, tuple) else (first,)
+            if axis not in names:
+                raise ValueError(
+                    f"stage param spec {spec} does not shard its leading (stage) dim over "
+                    f"the '{axis}' axis; stacked stage params must carry P({axis!r}, ...)"
+                )
 
     present_batch = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
     x_spec = P(present_batch)
@@ -107,6 +131,19 @@ def pipeline_apply(
         stage = lax.axis_index(axis)
         # shard_map hands each device its [1, ...] slice of the stacked params
         params = jax.tree_util.tree_map(lambda p: jnp.squeeze(p, axis=0), params)
+        if spec_leaves is not None:
+            # materialize this stage's full params from their fsdp/model shards
+            # (sharded at rest; gathered transiently — the grad is a reduce-scatter)
+            leaves, treedef = jax.tree_util.tree_flatten(params)
+            gathered = []
+            for leaf, spec in zip(leaves, spec_leaves):
+                for dim, entry in enumerate(spec[1:]):  # entry i+1 -> dim i after squeeze
+                    if entry is None:
+                        continue
+                    for name in entry if isinstance(entry, tuple) else (entry,):
+                        leaf = lax.all_gather(leaf, name, axis=dim, tiled=True)
+                gathered.append(leaf)
+            params = jax.tree_util.tree_unflatten(treedef, gathered)
         batch = h.shape[0]
         mb = batch // n_microbatches
         inputs = h.reshape((n_microbatches, mb) + h.shape[1:])
@@ -138,7 +175,12 @@ def pipeline_apply(
         outputs = lax.psum(outputs, axis_name=axis)
         return outputs.reshape((batch,) + h.shape[1:])
 
-    wrapped = _shard_map(local, mesh, in_specs=(P(axis), x_spec), out_specs=x_spec)
+    if spec_leaves is None:
+        params_in_spec: Any = P(axis)
+    else:
+        leaves_treedef = jax.tree_util.tree_structure(stage_params)
+        params_in_spec = jax.tree_util.tree_unflatten(leaves_treedef, spec_leaves)
+    wrapped = _shard_map(local, mesh, in_specs=(params_in_spec, x_spec), out_specs=x_spec)
     return wrapped(stage_params, x)
 
 

@@ -89,7 +89,24 @@ class Execution:
 
     @property
     def is_done(self) -> bool:
-        return self.status in ("SUCCEEDED", "FAILED")
+        return self.status in ("SUCCEEDED", "FAILED", "LOST")
+
+    @property
+    def attempt(self) -> int:
+        """0-based launch attempt, incremented by the backend on every (re)submit."""
+        attempt_file = Path(self.path) / "attempt"
+        try:
+            return int(attempt_file.read_text().strip())
+        except (OSError, ValueError):
+            return 0
+
+    def heartbeat_age(self) -> Optional[float]:
+        """Seconds since the worker last stamped its heartbeat; None before the first stamp."""
+        heartbeat = Path(self.path) / "heartbeat"
+        try:
+            return max(0.0, time.time() - float(heartbeat.read_text().strip()))
+        except (OSError, ValueError):
+            return None
 
 
 def get_app_version(allow_uncommitted: bool = False, cwd: str = ".") -> str:
@@ -125,8 +142,8 @@ class Backend:
 
     # ------------------------------------------------------------------ deploy
 
-    def _app_dir(self, model: Any, app_version: str) -> Path:
-        return self.root / "apps" / model.name / app_version
+    def _app_dir(self, model_name: str, app_version: str) -> Path:
+        return self.root / "apps" / model_name / app_version
 
     def _executions_dir(self, model_name: str) -> Path:
         return self.root / "executions" / model_name
@@ -149,7 +166,7 @@ class Backend:
         if patch and not explicit:
             app_version = f"{app_version}-patch{uuid.uuid4().hex[:7]}"
 
-        app_dir = self._app_dir(model, app_version)
+        app_dir = self._app_dir(model.name, app_version)
         bundle = app_dir / "bundle"
         if bundle.exists():
             shutil.rmtree(bundle)
@@ -209,7 +226,7 @@ class Backend:
         (exec_dir / "status").write_text("QUEUED")
         return Execution(id=exec_id, workflow=workflow, path=str(exec_dir))
 
-    def _launch(self, model: Any, execution: Execution, app_version: str) -> None:
+    def _launch(self, model_name: str, execution: Execution, app_version: str) -> None:
         """Spawn the worker process(es) for an execution.
 
         Single-host local executor today; the multi-host seam is: launch this same
@@ -217,19 +234,42 @@ class Backend:
         ``UNIONML_TPU_NUM_PROCESSES`` / ``UNIONML_TPU_PROCESS_ID`` set, and
         ``job_runner`` joins them via ``jax.distributed.initialize``.
         """
-        bundle = self._app_dir(model, app_version) / "bundle"
+        bundle = self._app_dir(model_name, app_version) / "bundle"
         framework_root = Path(__file__).resolve().parent.parent  # unionml_tpu's parent dir
         env = dict(os.environ)
         env["PYTHONPATH"] = os.pathsep.join(
             filter(None, [str(bundle), str(framework_root), env.get("PYTHONPATH", "")])
         )
-        log_file = open(Path(execution.path) / "logs.txt", "w")
-        execution.proc = subprocess.Popen(
-            [sys.executable, "-m", "unionml_tpu.job_runner", execution.path],
-            env=env,
-            stdout=log_file,
-            stderr=subprocess.STDOUT,
-        )
+        attempt_file = Path(execution.path) / "attempt"
+        attempt = int(attempt_file.read_text().strip()) + 1 if attempt_file.exists() else 0
+        attempt_file.write_text(str(attempt))
+        mode = "w" if attempt == 0 else "a"
+        with open(Path(execution.path) / "logs.txt", mode) as log_file:
+            execution.proc = subprocess.Popen(
+                [sys.executable, "-m", "unionml_tpu.job_runner", execution.path],
+                env=env,
+                stdout=log_file,
+                stderr=subprocess.STDOUT,
+            )
+
+    def resubmit(self, execution: Execution) -> Execution:
+        """Relaunch a failed/lost execution in place (slice-failure recovery).
+
+        The execution directory — spec, attempt counter, outputs — is reused, so a
+        trainer with ``checkpoint_dir`` set resumes from its last orbax step
+        checkpoint rather than from scratch (SURVEY.md §5.3/§5.4 build plan).
+        """
+        spec = json.loads((Path(execution.path) / "spec.json").read_text())
+        exec_dir = Path(execution.path)
+        for stale in ("heartbeat",):
+            try:
+                (exec_dir / stale).unlink()
+            except OSError:
+                pass
+        (exec_dir / "status").write_text("QUEUED")
+        self._launch(spec["model_name"], execution, spec["app_version"])
+        logger.warning(f"resubmitted execution {execution.id} (attempt {execution.attempt})")
+        return execution
 
     def submit_train(
         self,
@@ -245,7 +285,7 @@ class Backend:
         app_version = app_version or self.latest_app_version(model)
         if app_version is None:
             raise RuntimeError(f"no deployed app versions for model '{model.name}'; run remote_deploy first")
-        manifest = json.loads((self._app_dir(model, app_version) / "manifest.json").read_text())
+        manifest = json.loads((self._app_dir(model.name, app_version) / "manifest.json").read_text())
         spec = {
             "workflow": model.train_workflow_name,
             "kind": "train",
@@ -263,7 +303,7 @@ class Backend:
             },
         }
         execution = self._new_execution(model, model.train_workflow_name, spec)
-        self._launch(model, execution, app_version)
+        self._launch(model.name, execution, app_version)
         logger.info(f"executing {model.train_workflow_name}, execution name: {execution.id}")
         return execution
 
@@ -278,7 +318,7 @@ class Backend:
         app_version = app_version or self.latest_app_version(model)
         if app_version is None:
             raise RuntimeError(f"no deployed app versions for model '{model.name}'; run remote_deploy first")
-        manifest = json.loads((self._app_dir(model, app_version) / "manifest.json").read_text())
+        manifest = json.loads((self._app_dir(model.name, app_version) / "manifest.json").read_text())
         model_exec = self.get_model_execution(model, app_version=None, model_version=model_version or "latest")
         workflow = model.predict_workflow_name if features is None else model.predict_from_features_workflow_name
         spec = {
@@ -292,26 +332,56 @@ class Backend:
             "inputs": {"features": features, "reader_kwargs": reader_kwargs or {}},
         }
         execution = self._new_execution(model, workflow, spec)
-        self._launch(model, execution, app_version)
+        self._launch(model.name, execution, app_version)
         logger.info(f"executing {workflow}, execution name: {execution.id}")
         return execution
 
     # ------------------------------------------------------------------ wait / fetch
 
-    def wait(self, execution: Execution, timeout: float = 600.0, poll_interval: float = 0.25) -> Execution:
+    def wait(
+        self,
+        execution: Execution,
+        timeout: float = 600.0,
+        poll_interval: float = 0.25,
+        retries: int = 0,
+        heartbeat_timeout: Optional[float] = None,
+    ) -> Execution:
+        """Watchdog wait: poll status, detect dead/lost workers, resubmit up to ``retries``.
+
+        A worker is *dead* when its process exits without a terminal status (e.g. the
+        interpreter was killed), and *lost* when the execution is RUNNING but the
+        heartbeat is older than ``heartbeat_timeout`` (default: 6x the heartbeat
+        interval) — the single-host analog of losing a TPU slice host. Both cases
+        consume a retry; with ``checkpoint_dir`` configured the retried run resumes
+        from the last step checkpoint.
+        """
+        if heartbeat_timeout is None:
+            heartbeat_timeout = 6 * float(os.environ.get("UNIONML_TPU_HEARTBEAT_S", "5"))
         deadline = time.monotonic() + timeout
-        while not execution.is_done:
-            if execution.proc is not None and execution.proc.poll() is not None and not execution.is_done:
-                # worker died before reaching the job body (e.g. interpreter-level failure)
-                (Path(execution.path) / "status").write_text("FAILED")
-                break
-            if time.monotonic() > deadline:
-                raise TimeoutError(f"execution {execution.id} did not finish within {timeout}s")
-            time.sleep(poll_interval)
-        if execution.status == "FAILED":
+        while True:
+            while not execution.is_done:
+                failure: Optional[str] = None
+                if execution.proc is not None and execution.proc.poll() is not None and not execution.is_done:
+                    # worker died without writing a terminal status (interpreter-level failure)
+                    failure = "FAILED"
+                elif execution.status == "RUNNING" and execution.proc is None:
+                    age = execution.heartbeat_age()
+                    if age is not None and age > heartbeat_timeout:
+                        failure = "LOST"
+                if failure is not None:
+                    (Path(execution.path) / "status").write_text(failure)
+                    break
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"execution {execution.id} did not finish within {timeout}s")
+                time.sleep(poll_interval)
+            if execution.status in ("FAILED", "LOST") and execution.attempt < retries:
+                self.resubmit(execution)
+                continue
+            break
+        if execution.status in ("FAILED", "LOST"):
             log = Path(execution.path) / "logs.txt"
             tail = log.read_text()[-2000:] if log.exists() else "<no logs>"
-            raise RuntimeError(f"execution {execution.id} FAILED; log tail:\n{tail}")
+            raise RuntimeError(f"execution {execution.id} {execution.status}; log tail:\n{tail}")
         return execution
 
     def fetch_artifact(self, model: Any, execution: Execution) -> ModelArtifact:
