@@ -1,0 +1,104 @@
+"""Config 3 (BASELINE.md): BERT-base SST-2-shaped fine-tune, DP all-reduce.
+
+Metric: trainer samples/sec/chip at SST-2 fine-tune shapes (seq 128, classification
+head), bf16 compute / f32 params, through the framework's device-resident step path.
+Data parallelism is pure SPMD — on N chips the same program shards the batch over the
+``data`` mesh axis and XLA emits the gradient all-reduce; per-chip throughput is the
+scale-invariant number (validated multi-chip by the emulated-mesh tests and
+``__graft_entry__.dryrun_multichip``).
+
+``vs_baseline``: measured against the north-star target from BASELINE.json — a
+single A100's BERT-base fine-tune throughput, for which the commonly reported
+HF-Trainer figure at these shapes is ~400 samples/sec (fp16, batch 32-64). 1.0 means
+one v5e chip matches one A100.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+from benchmarks.common import V5E_PEAK_BF16_FLOPS, emit, log
+
+SEQ_LEN = 128
+BATCH_PER_CHIP = 64
+STEPS = 30
+A100_REFERENCE_SPS = 400.0
+
+
+def main() -> None:
+    import jax
+    import optax
+    from flax.training import train_state
+
+    from unionml_tpu import MeshSpec, TrainerConfig, make_train_step
+    from unionml_tpu.models import BertConfig, BertEncoder, bert_partition_rules, classification_loss
+    from unionml_tpu.train import fit
+
+    log(f"devices: {jax.devices()}")
+    n_chips = len(jax.devices())
+    config = BertConfig.base(max_seq_len=SEQ_LEN)
+    module = BertEncoder(config)
+
+    rng = np.random.default_rng(0)
+    n = BATCH_PER_CHIP * n_chips * (STEPS + 10)
+    tokens = rng.integers(0, config.vocab_size, size=(n, SEQ_LEN), dtype=np.int32)
+    labels = rng.integers(0, config.num_classes, size=(n,), dtype=np.int32)
+
+    import jax.numpy as jnp
+
+    params = module.init(jax.random.PRNGKey(0), jnp.asarray(tokens[:1]))["params"]
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
+    log(f"bert-base params: {n_params/1e6:.1f}M")
+    state = train_state.TrainState.create(
+        apply_fn=module.apply, params=params, tx=optax.adamw(2e-5, weight_decay=0.01)
+    )
+
+    def loss_fn(p, batch):
+        return classification_loss(lambda pp, t: module.apply({"params": pp}, t), p, batch)
+
+    step = make_train_step(loss_fn, has_aux=True)
+    result = fit(
+        state,
+        step,
+        [tokens, labels],
+        TrainerConfig(
+            epochs=1,
+            batch_size=BATCH_PER_CHIP * n_chips,
+            mesh=MeshSpec(data=-1),
+            partition_rules=bert_partition_rules(),
+            shuffle=False,
+            device_data=True,
+            steps_per_call=10,
+        ),
+    )
+    sps_chip = result.samples_per_sec_per_chip
+    log(
+        f"{result.steps} steps, compile {result.compile_time_s:.1f}s, "
+        f"{result.samples_per_sec:.1f} samples/s total, {sps_chip:.1f}/chip, "
+        f"final loss {result.history[-1]['loss']:.3f}"
+    )
+    # MFU: fwd+bwd ~ 6 * params * tokens FLOPs (matmul-dominated; embeds excluded is
+    # noise at seq 128)
+    flops_per_sample = 6 * n_params * SEQ_LEN
+    mfu = sps_chip * flops_per_sample / V5E_PEAK_BF16_FLOPS
+
+    emit(
+        "bert_base_sst2_train_throughput",
+        sps_chip,
+        "samples/sec/chip",
+        sps_chip / A100_REFERENCE_SPS,
+        mfu=mfu,
+        compile_time_s=result.compile_time_s,
+        n_chips=n_chips,
+        batch_per_chip=BATCH_PER_CHIP,
+        seq_len=SEQ_LEN,
+    )
+
+
+if __name__ == "__main__":
+    main()

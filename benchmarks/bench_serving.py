@@ -1,0 +1,174 @@
+"""Serving benchmark (BASELINE.json metric: "predictor req/s + p50 latency").
+
+Boots the real server (``unionml_tpu.cli serve`` equivalent: subprocess running
+``model.serve().run()``) on the digits quickstart app, then drives ``POST /predict``
+with 16 concurrent closed-loop clients. Metric: req/s; extras carry p50/p99 (ms).
+
+``vs_baseline``: fraction of the raw in-process predictor throughput (tight loop,
+no HTTP/batching) retained through the full serving stack — 1.0 means the HTTP
+server adds zero cost. The reference publishes no serving numbers (SURVEY.md §6).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from benchmarks.common import Timer, emit, log
+
+CLIENTS = 16
+DURATION_S = 10.0
+APP = textwrap.dedent(
+    """
+    from typing import List
+    import pandas as pd
+    from sklearn.datasets import load_digits
+    from sklearn.linear_model import LogisticRegression
+    from unionml_tpu import Dataset, Model
+
+    dataset = Dataset(name="digits_dataset", test_size=0.2, shuffle=True, targets=["target"])
+    model = Model(name="digits_classifier", init=LogisticRegression, dataset=dataset)
+
+    @dataset.reader
+    def reader() -> pd.DataFrame:
+        return load_digits(as_frame=True).frame
+
+    @model.trainer
+    def trainer(estimator: LogisticRegression, features: pd.DataFrame, target: pd.DataFrame) -> LogisticRegression:
+        return estimator.fit(features, target.squeeze())
+
+    @model.predictor
+    def predictor(estimator: LogisticRegression, features: pd.DataFrame) -> List[float]:
+        return [float(x) for x in estimator.predict(features)]
+
+    @model.evaluator
+    def evaluator(estimator: LogisticRegression, features: pd.DataFrame, target: pd.DataFrame) -> float:
+        return float(estimator.score(features, target.squeeze()))
+    """
+)
+SERVE = textwrap.dedent(
+    """
+    import sys
+    import app
+    app.model.load(sys.argv[1])
+    app.model.serve().run(port=int(sys.argv[2]))
+    """
+)
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def post(url: str, payload: dict) -> dict:
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), headers={"Content-Type": "application/json"}
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def main() -> None:
+    import tempfile
+
+    workdir = Path(tempfile.mkdtemp(prefix="unionml_tpu_bench_serving"))
+    (workdir / "app.py").write_text(APP)
+    (workdir / "serve.py").write_text(SERVE)
+    repo_root = str(Path(__file__).resolve().parent.parent)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(filter(None, [repo_root, str(workdir), env.get("PYTHONPATH", "")]))
+
+    # train once in-process to produce the artifact + measure raw predictor throughput
+    sys.path.insert(0, str(workdir))
+    import app as digits_app  # noqa: E402
+
+    digits_app.model.train(hyperparameters={"max_iter": 10000})
+    digits_app.model.save(workdir / "model.joblib")
+    from sklearn.datasets import load_digits
+
+    records = load_digits(as_frame=True).frame.drop(columns=["target"]).head(1).to_dict(orient="records")
+
+    digits_app.model.predict(features=records)
+    with Timer() as t:
+        raw_n = 300
+        for _ in range(raw_n):
+            digits_app.model.predict(features=records)
+    raw_rps = raw_n / t.elapsed
+    log(f"raw in-process predict: {raw_rps:.0f} req/s")
+
+    port = free_port()
+    proc = subprocess.Popen(
+        [sys.executable, str(workdir / "serve.py"), str(workdir / "model.joblib"), str(port)],
+        env=env,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.STDOUT,
+    )
+    try:
+        base = f"http://127.0.0.1:{port}"
+        for _ in range(100):  # poll /health
+            try:
+                with urllib.request.urlopen(base + "/health", timeout=1):
+                    break
+            except Exception:
+                time.sleep(0.2)
+        else:
+            raise RuntimeError("server did not come up")
+
+        payload = {"features": records}
+        post(base + "/predict", payload)  # warm
+
+        latencies: list = []
+        lock = threading.Lock()
+        stop_at = time.perf_counter() + DURATION_S
+
+        def client() -> None:
+            local = []
+            while time.perf_counter() < stop_at:
+                start = time.perf_counter()
+                post(base + "/predict", payload)
+                local.append(time.perf_counter() - start)
+            with lock:
+                latencies.extend(local)
+
+        threads = [threading.Thread(target=client) for _ in range(CLIENTS)]
+        with Timer() as t:
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+        n = len(latencies)
+        rps = n / t.elapsed
+        latencies.sort()
+        p50 = latencies[n // 2] * 1000
+        p99 = latencies[int(n * 0.99)] * 1000
+        log(f"{n} requests in {t.elapsed:.1f}s: {rps:.0f} req/s, p50 {p50:.1f}ms, p99 {p99:.1f}ms")
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+    emit(
+        "digits_serving_throughput",
+        rps,
+        "req/s",
+        rps / raw_rps,
+        p50_ms=p50,
+        p99_ms=p99,
+        concurrency=CLIENTS,
+        raw_inprocess_rps=raw_rps,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""Shared helpers for the benchmark scripts (BASELINE.md configs).
+
+Every script prints exactly ONE JSON line on stdout:
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}``.
+Progress goes to stderr. ``run_all.py`` aggregates the lines into BENCH_ALL.json.
+
+Timing note (axon/TPU): ``jax.block_until_ready`` is not a reliable fence on this
+platform — fence with a literal scalar fetch instead (see ``fence``).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Any, Dict
+
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def emit(metric: str, value: float, unit: str, vs_baseline: float, **extras: Any) -> None:
+    line: Dict[str, Any] = {
+        "metric": metric,
+        "value": round(float(value), 2),
+        "unit": unit,
+        "vs_baseline": round(float(vs_baseline), 3),
+    }
+    for key, val in extras.items():
+        line[key] = round(float(val), 3) if isinstance(val, float) else val
+    print(json.dumps(line))
+
+
+def fence(x: Any) -> float:
+    """Force completion of all queued device work feeding ``x`` via a literal fetch."""
+    import jax
+
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    return float(np.asarray(leaf).ravel()[0])
+
+
+class Timer:
+    def __enter__(self) -> "Timer":
+        self.start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.elapsed = time.perf_counter() - self.start
+
+
+# v5e (TPU v5 lite) peak bf16 matmul throughput, per chip — used for MFU reporting.
+V5E_PEAK_BF16_FLOPS = 197e12

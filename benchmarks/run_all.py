@@ -1,0 +1,56 @@
+"""Run every benchmark config and aggregate the JSON lines into BENCH_ALL.json.
+
+Usage: ``python benchmarks/run_all.py [--only digits,bert,...]``. Each script runs in
+its own interpreter (fresh XLA client; one failure doesn't kill the suite). The
+headline metric (``bench.py`` at the repo root) is separate and unchanged.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+SCRIPTS = {
+    "digits": "bench_digits.py",
+    "mlp": "../bench.py",  # headline config 2
+    "bert": "bench_bert.py",
+    "llama_lora": "bench_llama_lora.py",
+    "vit": "bench_vit.py",
+    "serving": "bench_serving.py",
+}
+
+
+def main() -> None:
+    only = None
+    if len(sys.argv) > 2 and sys.argv[1] == "--only":
+        only = set(sys.argv[2].split(","))
+    results = {}
+    for name, script in SCRIPTS.items():
+        if only and name not in only:
+            continue
+        path = (Path(__file__).parent / script).resolve()
+        print(f"=== {name} ({path.name}) ===", file=sys.stderr, flush=True)
+        start = time.perf_counter()
+        proc = subprocess.run(
+            [sys.executable, str(path)], capture_output=True, text=True, cwd=ROOT, timeout=3600
+        )
+        wall = time.perf_counter() - start
+        if proc.returncode != 0:
+            print(proc.stderr[-2000:], file=sys.stderr)
+            results[name] = {"error": proc.returncode, "stderr_tail": proc.stderr[-500:]}
+            continue
+        line = [ln for ln in proc.stdout.strip().splitlines() if ln.startswith("{")][-1]
+        results[name] = json.loads(line)
+        results[name]["bench_wall_s"] = round(wall, 1)
+        print(line, file=sys.stderr, flush=True)
+    out = ROOT / "BENCH_ALL.json"
+    out.write_text(json.dumps(results, indent=2))
+    print(json.dumps(results, indent=2))
+
+
+if __name__ == "__main__":
+    main()

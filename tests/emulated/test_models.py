@@ -281,3 +281,32 @@ def test_mlp_classifier():
     x = jax.random.normal(RNG, (5, 16))
     params = module.init(RNG, x)["params"]
     assert module.apply({"params": params}, x).shape == (5, 3)
+
+
+def test_chunked_causal_lm_loss_matches_plain():
+    """chunked_causal_lm_loss (scan over vocab-chunks, remat body) must equal
+    causal_lm_loss exactly — loss, gradients, and the masked variant."""
+    from unionml_tpu.models import Llama, LlamaConfig, causal_lm_loss, chunked_causal_lm_loss
+
+    cfg = LlamaConfig.tiny(
+        dim=64, n_layers=2, n_heads=4, n_kv_heads=2, hidden_dim=128, vocab_size=97,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    module = Llama(cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (3, 33), 0, 97)  # 32 targets: pads to 2x16
+    params = module.init(jax.random.PRNGKey(1), tokens)["params"]
+
+    plain = causal_lm_loss(lambda p, t: module.apply({"params": p}, t), params, tokens)
+    chunked = chunked_causal_lm_loss(module, params, tokens, chunk_size=16)
+    np.testing.assert_allclose(float(plain), float(chunked), rtol=1e-5)
+
+    g_plain = jax.grad(lambda p: causal_lm_loss(lambda pp, t: module.apply({"params": pp}, t), p, tokens))(params)
+    g_chunked = jax.grad(lambda p: chunked_causal_lm_loss(module, p, tokens, chunk_size=16))(params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5), g_plain, g_chunked
+    )
+
+    mask = (tokens > 10).astype(jnp.int32)
+    plain_m = causal_lm_loss(lambda p, t: module.apply({"params": p}, t), params, (tokens, mask))
+    chunked_m = chunked_causal_lm_loss(module, params, (tokens, mask), chunk_size=16)
+    np.testing.assert_allclose(float(plain_m), float(chunked_m), rtol=1e-5)

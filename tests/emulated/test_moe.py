@@ -1,0 +1,141 @@
+"""MoE / expert parallelism on the emulated 8-device CPU mesh.
+
+Oracles: (1) dispatch/combine tensors must reproduce a per-token loop over the
+router's top-k choices when capacity is ample; (2) the MoE layer must equal a
+directly-indexed per-token expert mixture; (3) the expert-parallel train step must
+run sharded over an ``expert`` mesh axis and move the params.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax.training import train_state
+
+from unionml_tpu.models import MoEConfig, MoELayer, MoETransformer, moe_lm_loss, moe_partition_rules, top_k_dispatch
+from unionml_tpu.parallel import MeshSpec, shard_pytree
+
+pytestmark = pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 emulated devices")
+
+
+def test_top_k_dispatch_matches_loop_oracle():
+    rng = np.random.default_rng(0)
+    n_tokens, n_experts, k, capacity = 32, 4, 2, 32  # ample capacity: nothing dropped
+    probs = jax.nn.softmax(jnp.asarray(rng.normal(size=(n_tokens, n_experts))), -1)
+    dispatch, combine, aux = top_k_dispatch(probs, k, capacity)
+
+    probs_np = np.asarray(probs)
+    for token in range(n_tokens):
+        top = np.argsort(-probs_np[token])[:k]
+        gates = probs_np[token][top]
+        gates = gates / gates.sum()
+        for expert in range(n_experts):
+            d_row = np.asarray(dispatch[token, expert])
+            c_row = np.asarray(combine[token, expert])
+            if expert in top:
+                assert d_row.sum() == pytest.approx(1.0), (token, expert)  # one capacity slot
+                np.testing.assert_allclose(c_row.sum(), gates[list(top).index(expert)], rtol=1e-5)
+            else:
+                assert d_row.sum() == 0.0 and c_row.sum() == 0.0
+    assert float(aux) > 0
+
+
+def test_top_k_dispatch_drops_overflow():
+    # all tokens pick expert 0 -> only `capacity` of them may land
+    probs = jnp.tile(jnp.asarray([[0.97, 0.01, 0.01, 0.01]]), (16, 1))
+    dispatch, _, _ = top_k_dispatch(probs, 1, 4)
+    assert float(dispatch[:, 0].sum()) == 4.0  # capacity slots filled, 12 dropped
+    for slot in range(4):
+        assert float(dispatch[:, 0, slot].sum()) == 1.0  # each slot used exactly once
+
+
+def test_moe_layer_matches_per_token_oracle():
+    """Ample capacity: layer output == directly applying each token's top-k experts."""
+    config = dict(n_experts=4, hidden_dim=32, k=2, capacity_factor=8.0, dtype=jnp.float32, param_dtype=jnp.float32)
+    layer = MoELayer(**config)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 16))
+    params = layer.init(jax.random.PRNGKey(1), x)["params"]
+    out, _ = layer.apply({"params": params}, x, mutable=["losses"])
+
+    # oracle: run every expert densely on every token, combine by renormalized top-k gates
+    tokens = np.asarray(x.reshape(-1, 16))
+    router_w = np.asarray(params["router"]["kernel"])
+    logits = tokens @ router_w
+    probs = np.asarray(jax.nn.softmax(jnp.asarray(logits), -1))
+
+    from unionml_tpu.models.layers import MLP
+
+    expert_params = params["experts"]
+    per_expert = []
+    for e in range(4):
+        p_e = jax.tree_util.tree_map(lambda leaf: leaf[e], expert_params)
+        per_expert.append(np.asarray(MLP(hidden_dim=32, gated=True, dtype=jnp.float32, param_dtype=jnp.float32).apply({"params": p_e}, jnp.asarray(tokens))))
+
+    expected = np.zeros_like(tokens)
+    for t in range(tokens.shape[0]):
+        top = np.argsort(-probs[t])[:2]
+        gates = probs[t][top] / probs[t][top].sum()
+        for gate, e in zip(gates, top):
+            expected[t] += gate * per_expert[e][t]
+    np.testing.assert_allclose(np.asarray(out).reshape(-1, 16), expected, atol=1e-4)
+
+
+def test_moe_transformer_expert_parallel_train_step():
+    """One train step with experts sharded over the expert axis on a data x expert mesh."""
+    mesh = MeshSpec(data=2, expert=4).build()
+    config = MoEConfig.tiny(n_experts=4, dtype=jnp.float32, param_dtype=jnp.float32)
+    module = MoETransformer(config)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (8, 16), 0, config.vocab_size)
+    params = module.init(jax.random.PRNGKey(1), tokens)["params"]
+
+    rules = moe_partition_rules()
+    from jax.sharding import PartitionSpec as P
+
+    assert rules.spec_for("layer_0/moe/experts/wi/kernel") == P("expert", "fsdp", "model")
+    shardings = rules.shardings(params, mesh)
+    params = shard_pytree(params, shardings)
+    expert_leaf = params["layer_0"]["moe"]["experts"]["wi"]["kernel"]
+    assert "expert" in expert_leaf.sharding.spec
+
+    state = train_state.TrainState.create(apply_fn=None, params=params, tx=optax.adam(1e-3))
+
+    @jax.jit
+    def step(state, batch):
+        loss, grads = jax.value_and_grad(lambda p: moe_lm_loss(module, p, batch))(state.params)
+        return state.apply_gradients(grads=grads), loss
+
+    with mesh:
+        state2, loss = step(state, tokens)
+        state2, loss2 = step(state2, tokens)
+    assert np.isfinite(float(loss)) and np.isfinite(float(loss2))
+    assert float(loss2) < float(loss)  # optimizing
+    diff = jax.tree_util.tree_map(lambda a, b: float(jnp.abs(a - b).max()), state.params, state2.params)
+    assert max(jax.tree_util.tree_leaves(diff)) > 0
+
+
+def test_moe_aux_loss_encourages_balance():
+    """The aux loss is minimal (== 1.0 for top-1 uniform) when routing is uniform."""
+    n = 64
+    uniform = jnp.full((n, 4), 0.25)
+    _, _, aux_uniform = top_k_dispatch(uniform, 1, 64)
+    skewed = jnp.tile(jnp.asarray([[0.9, 0.05, 0.03, 0.02]]), (n, 1))
+    _, _, aux_skewed = top_k_dispatch(skewed, 1, 64)
+    assert float(aux_skewed) > float(aux_uniform)
+
+
+def test_moe_sharding_constraint_engages_under_mesh():
+    """Regression: the expert-dim sharding constraint must appear in the lowered
+    program when tracing under a mesh with an expert axis (it silently no-ops
+    without a visible mesh, which would turn EP into full replication)."""
+    from jax.sharding import PartitionSpec as P
+
+    from unionml_tpu.models.moe import _constrain
+
+    mesh = MeshSpec(data=2, expert=4).build()
+    with mesh:
+        txt = jax.jit(lambda x: _constrain(x, P("expert", None, None)) * 2).lower(
+            jnp.zeros((4, 8, 16))
+        ).as_text()
+    assert "sharding" in txt.lower(), "expert sharding constraint did not lower"

@@ -160,6 +160,7 @@ def test_stale_heartbeat_marks_lost_and_resubmits(remote_app, monkeypatch):
     import json as _json
     import time as _time
 
+    monkeypatch.setenv("UNIONML_TPU_HEARTBEAT_S", "0.2")  # resubmitted worker beats fast
     model = remote_app.model
     model.remote_deploy(app_version="v5")
     execution = model.remote_train(wait=False, hyperparameters={"max_iter": 100})

@@ -142,6 +142,8 @@ def run_job(execution_dir: str) -> None:
         status.write_text("SUCCEEDED")
     except Exception:
         traceback.print_exc()
+        if _current_attempt(exec_path) != my_attempt:
+            os._exit(43)  # fenced: don't clobber the replacement attempt's status
         status.write_text("FAILED")
         sys.exit(1)
     finally:

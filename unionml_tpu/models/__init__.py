@@ -5,11 +5,20 @@ from unionml_tpu.models.llama import (  # noqa: F401
     Llama,
     LlamaConfig,
     causal_lm_loss,
+    chunked_causal_lm_loss,
     llama_partition_rules,
     lora_optimizer,
     lora_param_labels,
 )
 from unionml_tpu.models.mlp import MLPClassifier, MLPConfig  # noqa: F401
+from unionml_tpu.models.moe import (  # noqa: F401
+    MoEConfig,
+    MoELayer,
+    MoETransformer,
+    moe_lm_loss,
+    moe_partition_rules,
+    top_k_dispatch,
+)
 from unionml_tpu.models.vit import (  # noqa: F401
     PipelinedViT,
     ViT,

@@ -64,7 +64,9 @@ class Llama(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, tokens: jax.Array, positions: Optional[jax.Array] = None) -> jax.Array:
+    def __call__(
+        self, tokens: jax.Array, positions: Optional[jax.Array] = None, return_hidden: bool = False
+    ) -> jax.Array:
         cfg = self.config
         x = nn.Embed(
             cfg.vocab_size, cfg.dim, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="embed"
@@ -91,6 +93,11 @@ class Llama(nn.Module):
             )(x, positions)
 
         x = RMSNorm(dtype=cfg.dtype, name="final_norm")(x)
+        if return_hidden:
+            # pre-head hidden states for chunked-loss paths; init always runs with
+            # return_hidden=False so the lm_head params exist in the tree (flax
+            # ignores unvisited params at apply time)
+            return x
         # untied LM head (kept separate so vocab-parallel TP sharding is per-rule)
         logits = nn.Dense(
             cfg.vocab_size, use_bias=False, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="lm_head"
@@ -137,6 +144,54 @@ def lora_optimizer(learning_rate: float = 1e-4, **adam_kwargs: Any):
         {"lora": optax.adamw(learning_rate, **adam_kwargs), "frozen": optax.set_to_zero()},
         lora_param_labels,
     )
+
+
+def chunked_causal_lm_loss(module: "Llama", params, batch, *, chunk_size: int = 256) -> jax.Array:
+    """Next-token cross-entropy without materializing the full ``[B, S, vocab]``
+    f32 logits tensor.
+
+    For large vocabularies (Llama-3: 128k) the f32 logits of a whole sequence are
+    the peak-memory *and* bandwidth hot spot of the training step — at B=4, S=1024
+    they are 2 GiB that the plain loss writes to and re-reads from HBM. This variant
+    runs the LM head + softmax over ``chunk_size``-token slices under ``lax.scan``
+    with a rematerialized body, so peak logits memory drops to
+    ``B * chunk_size * vocab`` and the backward pass recomputes each chunk's logits
+    instead of storing them. Numerically identical to :func:`causal_lm_loss`.
+    """
+    import optax
+
+    tokens, mask = (batch if isinstance(batch, (tuple, list)) and len(batch) == 2 else (batch, None))
+    if isinstance(tokens, (tuple, list)):
+        tokens = tokens[0]
+    hidden = module.apply({"params": params}, tokens, return_hidden=True)  # [B, S, D]
+    head = params["lm_head"]["kernel"]  # [D, V]
+    hidden, targets = hidden[:, :-1], tokens[:, 1:]
+    valid = jnp.ones(targets.shape, jnp.float32) if mask is None else mask[:, 1:].astype(jnp.float32)
+
+    batch_dim, seq, dim = hidden.shape
+    pad = (-seq) % chunk_size
+    if pad:
+        hidden = jnp.pad(hidden, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+        valid = jnp.pad(valid, ((0, 0), (0, pad)))
+    n_chunks = (seq + pad) // chunk_size
+    # scan over chunks: [n, B, chunk, ...]
+    hs = hidden.reshape(batch_dim, n_chunks, chunk_size, dim).swapaxes(0, 1)
+    ts = targets.reshape(batch_dim, n_chunks, chunk_size).swapaxes(0, 1)
+    ms = valid.reshape(batch_dim, n_chunks, chunk_size).swapaxes(0, 1)
+
+    @jax.checkpoint
+    def chunk_loss(h, t, m):
+        logits = (h @ head.astype(h.dtype)).astype(jnp.float32)
+        losses = optax.softmax_cross_entropy_with_integer_labels(logits, t)
+        return (losses * m).sum()
+
+    def body(total, xs):
+        h, t, m = xs
+        return total + chunk_loss(h, t, m), None
+
+    total, _ = jax.lax.scan(body, jnp.float32(0.0), (hs, ts, ms))
+    return total / jnp.maximum(valid.sum(), 1.0)
 
 
 def causal_lm_loss(apply_fn, params, batch) -> jax.Array:

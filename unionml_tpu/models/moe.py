@@ -1,0 +1,293 @@
+"""Mixture-of-Experts layer + decoder with expert parallelism over an ``expert`` axis.
+
+The reference has no MoE (SURVEY.md §2.3 lists EP as absent; the mesh keeps an
+``expert`` axis open per the build plan). The TPU-native design is the
+Switch/Mixtral dense-dispatch formulation rather than per-rank alltoall calls:
+
+- the router's top-k choice becomes one-hot **dispatch/combine tensors**, and
+  token->expert movement is two einsums — large, static-shape matmuls the MXU
+  likes, with no data-dependent control flow under ``jit``;
+- expert FFN weights are stacked on a leading ``[n_experts, ...]`` dim and sharded
+  ``P("expert", ...)``; the dispatched activations are sharding-constrained to
+  ``P("expert", ...)`` on their expert dim, so **XLA emits the all-to-all** from the
+  sharding propagation — the compiler-emitted analog of NCCL alltoall in GPU MoE
+  stacks;
+- each expert processes a fixed ``capacity`` of tokens (static shapes); overflow
+  tokens are dropped by the dispatch mask and pass through the residual, the
+  standard TPU-friendly trade (capacity_factor controls the drop rate).
+
+Load balancing uses the Switch aux loss (fraction-of-tokens x mean-router-prob per
+expert, scaled by n_experts); the layer ``sow``s it under the ``"losses"``
+collection and :func:`moe_lm_loss` adds it to the LM loss.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from unionml_tpu.models.layers import MLP, Attention, RMSNorm
+from unionml_tpu.parallel.sharding import PartitionRules
+
+Dtype = Any
+
+
+def top_k_dispatch(
+    router_probs: jax.Array, k: int, capacity: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Build dispatch/combine tensors from router probabilities.
+
+    :param router_probs: ``[n_tokens, n_experts]`` softmax outputs.
+    :returns: ``(dispatch [N, E, C] bool-ish, combine [N, E, C], aux_loss scalar)``.
+    """
+    n_tokens, n_experts = router_probs.shape
+    gate_vals, gate_idx = jax.lax.top_k(router_probs, k)  # [N, k]
+    # Mixtral-style renormalization: the k selected gates sum to 1 per token
+    gate_vals = gate_vals / jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
+
+    dispatch = jnp.zeros((n_tokens, n_experts, capacity), router_probs.dtype)
+    combine = jnp.zeros((n_tokens, n_experts, capacity), router_probs.dtype)
+    counts = jnp.zeros((n_experts,), jnp.int32)
+    for slot in range(k):  # k is small and static; unrolled at trace time
+        onehot = jax.nn.one_hot(gate_idx[:, slot], n_experts, dtype=jnp.int32)  # [N, E]
+        # position of each token within its chosen expert's capacity buffer
+        pos = jnp.cumsum(onehot, axis=0) - 1 + counts[None, :]
+        counts = counts + onehot.sum(axis=0)
+        within = (pos < capacity) & (onehot > 0)
+        pos_oh = jax.nn.one_hot(pos, capacity, dtype=router_probs.dtype)  # [N, E, C]
+        slot_dispatch = pos_oh * within.astype(router_probs.dtype)[..., None]
+        dispatch = dispatch + slot_dispatch
+        combine = combine + gate_vals[:, slot, None, None] * slot_dispatch
+
+    # Switch load-balance loss: n_experts * sum_e f_e * p_e, minimized at uniform
+    token_frac = jax.nn.one_hot(gate_idx[:, 0], n_experts).mean(axis=0)
+    prob_frac = router_probs.mean(axis=0)
+    aux_loss = n_experts * jnp.sum(token_frac * prob_frac)
+    return dispatch, combine, aux_loss
+
+
+class MoELayer(nn.Module):
+    """Top-k routed expert FFNs replacing a dense MLP.
+
+    Expert weights live under ``experts/...`` with a leading ``[n_experts]`` dim
+    (``nn.vmap``); shard them ``P("expert", ...)`` via :func:`moe_partition_rules`.
+    """
+
+    n_experts: int
+    hidden_dim: int
+    k: int = 2
+    capacity_factor: float = 1.25
+    gated: bool = True
+    dtype: Dtype = jnp.bfloat16
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        batch, length, dim = x.shape
+        n_tokens = batch * length
+        tokens = x.reshape(n_tokens, dim)
+        capacity = max(1, int(self.capacity_factor * self.k * n_tokens / self.n_experts))
+
+        # router runs in f32: routing decisions are precision-sensitive
+        router_logits = nn.Dense(
+            self.n_experts, use_bias=False, dtype=jnp.float32, param_dtype=self.param_dtype, name="router"
+        )(tokens.astype(jnp.float32))
+        dispatch, combine, aux_loss = top_k_dispatch(jax.nn.softmax(router_logits, -1), self.k, capacity)
+        self.sow("losses", "moe_aux_loss", aux_loss)
+
+        # dispatch: one einsum, [E, C, D] sharded over the expert axis -> XLA
+        # inserts the all-to-all between the data-sharded and expert-sharded layouts
+        expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(self.dtype), tokens.astype(self.dtype))
+        expert_in = _constrain(expert_in, P("expert", None, None))
+
+        experts = nn.vmap(
+            MLP,
+            in_axes=0,
+            out_axes=0,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+        )(
+            hidden_dim=self.hidden_dim,
+            gated=self.gated,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="experts",
+        )
+        expert_out = experts(expert_in)  # [E, C, D]
+        expert_out = _constrain(expert_out, P("expert", None, None))
+
+        out = jnp.einsum("nec,ecd->nd", combine.astype(self.dtype), expert_out)
+        return out.reshape(batch, length, dim)
+
+
+def _constrain(x: jax.Array, spec: P) -> jax.Array:
+    """Apply a sharding constraint when running under a mesh that has the axes."""
+    names = set()
+    for entry in spec:
+        if entry is not None:
+            names.update(entry if isinstance(entry, tuple) else (entry,))
+    # mesh discovery may drift across jax versions — degrade to "no mesh visible";
+    # but once a mesh with the right axes is found, constraint errors must surface
+    # (a swallowed error here silently turns expert parallelism into replication)
+    abstract = None
+    try:
+        abstract = jax.sharding.get_abstract_mesh()  # set by jax.sharding.use_mesh
+    except AttributeError:
+        pass
+    if abstract is not None and not abstract.empty:
+        if not names.issubset(abstract.axis_names):
+            return x
+        return jax.lax.with_sharding_constraint(x, spec)
+    try:
+        # `with mesh:` (Mesh context manager) sets only the physical mesh
+        from jax._src.mesh import thread_resources
+
+        mesh = thread_resources.env.physical_mesh
+    except ImportError:
+        return x
+    if mesh.empty or not names.issubset(mesh.axis_names):
+        return x
+    return jax.lax.with_sharding_constraint(x, jax.sharding.NamedSharding(mesh, spec))
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 8
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    hidden_dim: int = 14336
+    n_experts: int = 8
+    k: int = 2
+    capacity_factor: float = 1.25
+    moe_every: int = 2  # every Nth block uses MoE FFN (1 = all blocks, Mixtral-style)
+    max_seq_len: int = 4096
+    rope_theta: float = 500000.0
+    aux_loss_weight: float = 0.01
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @classmethod
+    def tiny(cls, **overrides: Any) -> "MoEConfig":
+        defaults = dict(
+            vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, hidden_dim=128,
+            n_experts=4, k=2, moe_every=1, max_seq_len=128,
+        )
+        defaults.update(overrides)
+        return cls(**defaults)
+
+
+class MoEBlock(nn.Module):
+    """Pre-norm decoder block with a routed-experts FFN."""
+
+    config: MoEConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, positions: Optional[jax.Array] = None) -> jax.Array:
+        cfg = self.config
+        x = x + Attention(
+            n_heads=cfg.n_heads,
+            n_kv_heads=cfg.n_kv_heads,
+            causal=True,
+            rope=True,
+            rope_theta=cfg.rope_theta,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            name="attn",
+        )(RMSNorm(dtype=cfg.dtype, name="attn_norm")(x), positions)
+        x = x + MoELayer(
+            n_experts=cfg.n_experts,
+            hidden_dim=cfg.hidden_dim,
+            k=cfg.k,
+            capacity_factor=cfg.capacity_factor,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            name="moe",
+        )(RMSNorm(dtype=cfg.dtype, name="moe_norm")(x))
+        return x
+
+
+class MoETransformer(nn.Module):
+    """Causal LM with routed-expert FFNs (Mixtral-family shape): tokens -> logits."""
+
+    config: MoEConfig
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array, positions: Optional[jax.Array] = None) -> jax.Array:
+        from unionml_tpu.models.layers import TransformerBlock
+
+        cfg = self.config
+        x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="embed")(tokens)
+        if positions is None:
+            positions = jnp.arange(tokens.shape[1])
+        for i in range(cfg.n_layers):
+            if i % cfg.moe_every == cfg.moe_every - 1:
+                x = MoEBlock(cfg, name=f"layer_{i}")(x, positions)
+            else:
+                x = TransformerBlock(
+                    n_heads=cfg.n_heads,
+                    n_kv_heads=cfg.n_kv_heads,
+                    hidden_dim=cfg.hidden_dim,
+                    decoder=True,
+                    rope=True,
+                    rope_theta=cfg.rope_theta,
+                    dtype=cfg.dtype,
+                    param_dtype=cfg.param_dtype,
+                    name=f"layer_{i}",
+                )(x, positions)
+        x = RMSNorm(dtype=cfg.dtype, name="final_norm")(x)
+        return nn.Dense(
+            cfg.vocab_size, use_bias=False, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="lm_head"
+        )(x)
+
+
+def moe_partition_rules() -> PartitionRules:
+    """Expert-parallel layout: stacked expert weights shard their leading dim over
+    ``expert``; within an expert the megatron TP pattern applies on the trailing
+    dims; everything else follows the llama rules."""
+    return PartitionRules(
+        [
+            (r"experts/(wi|wg)/kernel", P("expert", "fsdp", "model")),
+            (r"experts/wo/kernel", P("expert", "model", "fsdp")),
+            (r"experts/.*(bias|scale)", P("expert")),
+            (r"router/kernel", P()),
+            (r"attn/(q_proj|k_proj|v_proj)/kernel", P("fsdp", "model")),
+            (r"attn/o_proj/kernel", P("model", "fsdp")),
+            # dense interleaved blocks (moe_every > 1) follow the llama MLP layout
+            (r"mlp/(wi|wg)/kernel", P("fsdp", "model")),
+            (r"mlp/wo/kernel", P("model", "fsdp")),
+            (r"embed/embedding", P("model", "fsdp")),
+            (r"lm_head/kernel", P("fsdp", "model")),
+            (r".*(norm|scale|bias)", P()),
+        ]
+    )
+
+
+def moe_lm_loss(module: MoETransformer, params: Any, batch: Any) -> jax.Array:
+    """Next-token cross-entropy + weighted router load-balance aux loss.
+
+    ``batch``: tokens array or ``(tokens, loss_mask)`` — same contract as
+    :func:`unionml_tpu.models.llama.causal_lm_loss`.
+    """
+    import optax
+
+    tokens, mask = (batch if isinstance(batch, (tuple, list)) and len(batch) == 2 else (batch, None))
+    if isinstance(tokens, (tuple, list)):
+        tokens = tokens[0]
+    logits, state = module.apply({"params": params}, tokens, mutable=["losses"])
+    targets = tokens[:, 1:]
+    losses = optax.softmax_cross_entropy_with_integer_labels(logits[:, :-1].astype(jnp.float32), targets)
+    aux_terms = jax.tree_util.tree_leaves(state.get("losses", {}))
+    aux = sum(jnp.sum(t) for t in aux_terms) if aux_terms else jnp.float32(0.0)
+    if mask is not None:
+        m = mask[:, 1:].astype(jnp.float32)
+        ce = (losses * m).sum() / jnp.maximum(m.sum(), 1.0)
+    else:
+        ce = losses.mean()
+    return ce + module.config.aux_loss_weight * aux

@@ -355,8 +355,11 @@ class Backend:
         consume a retry; with ``checkpoint_dir`` configured the retried run resumes
         from the last step checkpoint.
         """
+        interval = float(os.environ.get("UNIONML_TPU_HEARTBEAT_S", "5"))
         if heartbeat_timeout is None:
-            heartbeat_timeout = 6 * float(os.environ.get("UNIONML_TPU_HEARTBEAT_S", "5"))
+            heartbeat_timeout = 6 * interval
+        # a timeout below the beat interval would kill healthy workers between stamps
+        heartbeat_timeout = max(heartbeat_timeout, 2 * interval)
         deadline = time.monotonic() + timeout
         while True:
             while not execution.is_done:
@@ -364,10 +367,15 @@ class Backend:
                 if execution.proc is not None and execution.proc.poll() is not None and not execution.is_done:
                     # worker died without writing a terminal status (interpreter-level failure)
                     failure = "FAILED"
-                elif execution.status == "RUNNING" and execution.proc is None:
+                elif execution.status == "RUNNING":
+                    # stale heartbeat = lost slice; applies to live-proc executions too
+                    # (a wedged worker whose beat thread stopped must be killed+retried)
                     age = execution.heartbeat_age()
                     if age is not None and age > heartbeat_timeout:
                         failure = "LOST"
+                        if execution.proc is not None and execution.proc.poll() is None:
+                            execution.proc.kill()
+                            execution.proc.wait()
                 if failure is not None:
                     (Path(execution.path) / "status").write_text(failure)
                     break
