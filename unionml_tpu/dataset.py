@@ -268,6 +268,46 @@ class Dataset:
         (reference unionml/dataset.py:342-351)."""
         return self._feature_transformer(self._feature_loader(features))
 
+    def get_features_from_bytes(self, payload: bytes, allow_trailing: bool = False) -> Optional[Any]:
+        """Native fast path: raw JSON record bytes -> feature DataFrame without the
+        json -> list-of-dicts -> DataFrame detour (serving hot loop).
+
+        Only engages when the feature pipeline is the default (a custom
+        ``@dataset.feature_loader``/``feature_transformer`` must see the raw
+        records) and the dataset type is a DataFrame. Returns ``(features,
+        bytes_consumed)`` or ``None`` — callers fall back to :meth:`get_features`.
+        """
+        # bound-method comparison must use == (never `is`)
+        if self._feature_loader != self._default_feature_loader:
+            return None
+        if self._feature_transformer != self._default_feature_transformer:
+            return None
+        [(_, data_type)] = self.dataset_datatype.items()
+        if data_type is not pd.DataFrame:
+            return None
+        from unionml_tpu.native import parse_records
+
+        parsed = parse_records(payload, allow_trailing=allow_trailing)
+        if parsed is None:
+            return None
+        matrix, columns, consumed = parsed
+        frame = pd.DataFrame(matrix, columns=columns, copy=False)
+        feature_names = self._feature_column_names(frame)
+        if feature_names:
+            if any(name not in frame.columns for name in feature_names):
+                return None  # missing feature columns: let the Python path raise its error
+            frame = frame[feature_names]
+        return frame, consumed
+
+    def _feature_column_names(self, frame: "pd.DataFrame") -> "Optional[List[str]]":
+        """Feature columns for a frame: explicit ``features`` list, else everything
+        minus the targets. Single source of truth for both the Python default
+        feature loader and the native fast path."""
+        feature_names = self._features
+        if not feature_names and self._targets is not None:
+            feature_names = [col for col in frame.columns if col not in self._targets]
+        return feature_names
+
     def iterator(
         self,
         data: Any,
@@ -464,24 +504,32 @@ class Dataset:
         """Load features from a JSON file path / records / dict into the dataset datatype
         (reference dataset.py:495-509)."""
         if isinstance(features, Path):
-            features = json.loads(features.read_text())
+            # Path contents are always parsed as JSON, never re-resolved as a path
+            payload = features.read_text().strip()
         elif isinstance(features, str):
             payload = features.strip()
-            if payload[:1] in ("[", "{"):  # inline JSON, not a path
-                features = json.loads(payload)
-            else:
+            if payload[:1] not in ("[", "{"):  # maybe a path, not inline JSON
                 try:
                     is_file = Path(payload).exists()
                 except OSError:
                     is_file = False
-                features = json.loads(Path(payload).read_text()) if is_file else json.loads(payload)
+                if is_file:
+                    payload = Path(payload).read_text().strip()
+        else:
+            payload = None
+        if payload is not None:
+            if payload[:1] == "[":
+                # native fast path for record arrays (no-op unless defaults apply —
+                # we ARE the default loader here, so only the dtype gate matters)
+                fast = self.get_features_from_bytes(payload.encode())
+                if fast is not None:
+                    return fast[0]
+            features = json.loads(payload)
 
         [(_, data_type)] = self.dataset_datatype.items()
         if data_type is pd.DataFrame:
             frame = pd.DataFrame(features)
-            feature_names = self._features
-            if not feature_names and self._targets is not None:
-                feature_names = [col for col in frame.columns if col not in self._targets]
+            feature_names = self._feature_column_names(frame)
             return frame[feature_names] if feature_names else frame
         return features
 

@@ -369,11 +369,15 @@ class Backend:
                     failure = "FAILED"
                 elif execution.status == "RUNNING":
                     # stale heartbeat = lost slice; applies to live-proc executions too
-                    # (a wedged worker whose beat thread stopped must be killed+retried)
+                    # (a wedged worker whose beat thread stopped must be killed+retried).
+                    # A live process gets 3x the margin: the beat thread can be starved
+                    # by one long GIL-holding call in an otherwise-healthy worker.
                     age = execution.heartbeat_age()
-                    if age is not None and age > heartbeat_timeout:
+                    live = execution.proc is not None and execution.proc.poll() is None
+                    threshold = 3 * heartbeat_timeout if live else heartbeat_timeout
+                    if age is not None and age > threshold:
                         failure = "LOST"
-                        if execution.proc is not None and execution.proc.poll() is None:
+                        if live:
                             execution.proc.kill()
                             execution.proc.wait()
                 if failure is not None:

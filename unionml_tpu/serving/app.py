@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 from http import HTTPStatus
 from typing import Any, Optional
 
@@ -110,6 +111,28 @@ class ServingApp:
             except Exception as exc:  # warmup is best-effort
                 logger.warning(f"predictor warmup failed for bucket {bucket}: {exc}")
 
+    _FEATURES_ENVELOPE = re.compile(rb'\A\s*\{\s*"features"\s*:\s*(?=\[)')
+
+    def _predict_features_fast(self, body: bytes) -> Any:
+        """Parse a pure-features envelope via the native records parser; None = use
+        the Python path (custom feature pipeline, inputs present, non-flat records,
+        or no native toolchain). Requires a loaded artifact like the slow path."""
+        if self.model.artifact is None:
+            return None
+        match = self._FEATURES_ENVELOPE.match(body)
+        if match is None:
+            return None
+        try:
+            parsed = self.model._dataset.get_features_from_bytes(body[match.end():], allow_trailing=True)
+        except Exception:
+            return None
+        if parsed is None:
+            return None
+        features, consumed = parsed
+        if body[match.end() + consumed:].strip() != b"}":
+            return None  # envelope has other keys (e.g. inputs) -> slow path
+        return features
+
     def _predict_features_sync(self, features: Any) -> Any:
         # features arriving here are already model-ready (the handler ran
         # dataset.get_features before enqueueing) — go straight to the
@@ -129,6 +152,19 @@ class ServingApp:
         return 200, {"message": HTTPStatus.OK.phrase, "status": int(HTTPStatus.OK)}, "application/json"
 
     async def _predict(self, body: bytes):
+        # native fast path: a {"features": [flat numeric records]} envelope is parsed
+        # straight from the wire bytes into a float32 DataFrame by the C++ records
+        # parser — json.loads and its dict-of-PyObjects intermediate never run
+        fast = self._predict_features_fast(body)
+        if fast is not None:
+            try:
+                if self.batcher is not None:
+                    return 200, _to_jsonable(await self.batcher.submit(fast)), "application/json"
+                return 200, _to_jsonable(self._predict_features_sync(fast)), "application/json"
+            except HTTPError:
+                raise
+            except Exception as exc:
+                raise HTTPError(500, f"prediction failed: {type(exc).__name__}: {exc}")
         try:
             payload = json.loads(body.decode() or "{}")
         except json.JSONDecodeError as exc:
