@@ -174,3 +174,28 @@ def test_feature_transformer():
 
     features = dataset.get_features([{"x": 1.0}])
     assert features["x"].iloc[0] == 2.0
+
+
+def test_from_sqlalchemy_query_gate():
+    """SQLAlchemy integration: functional when installed, informative gate when not."""
+    try:
+        import sqlalchemy  # noqa: F401
+    except ImportError:
+        with pytest.raises(ImportError, match="requires sqlalchemy"):
+            Dataset.from_sqlalchemy_query("sqlite:///x.db", "SELECT 1", name="sa_dataset")
+        return
+    import sqlite3
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        db = f"{tmp}/points.db"
+        conn = sqlite3.connect(db)
+        conn.execute("CREATE TABLE points (x1 REAL, x2 REAL, y INTEGER)")
+        conn.executemany("INSERT INTO points VALUES (?, ?, ?)", [(i, -i, i % 2) for i in range(20)])
+        conn.commit()
+        conn.close()
+        dataset = Dataset.from_sqlalchemy_query(
+            f"sqlite:///{db}", "SELECT * FROM points", name="sa_dataset", targets=["y"]
+        )
+        frame = dataset._reader()
+        assert list(frame.columns) == ["x1", "x2", "y"] and len(frame) == 20

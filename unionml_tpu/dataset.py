@@ -436,6 +436,46 @@ class Dataset:
         return dataset
 
     @classmethod
+    def from_sqlalchemy_query(cls, connect_url: str, query: str, *args: Any, **kwargs: Any) -> "Dataset":
+        """Create a Dataset whose reader executes a SQL query over a SQLAlchemy URL.
+
+        Replaces the reference's flytekit ``SQLAlchemyTask`` integration
+        (unionml/dataset.py:446-459). Requires ``sqlalchemy`` (optional dependency);
+        ``{placeholder}``-style query params become typed reader kwargs like
+        :meth:`from_sqlite_query`.
+        """
+        import re
+
+        try:
+            import sqlalchemy  # noqa: F401
+        except ImportError as exc:  # pragma: no cover - import gate
+            raise ImportError(
+                "Dataset.from_sqlalchemy_query requires sqlalchemy; pip install sqlalchemy "
+                "or use Dataset.from_sqlite_query for sqlite databases"
+            ) from exc
+
+        dataset = cls(*args, **kwargs)
+        placeholders = list(dict.fromkeys(re.findall(r"{(\w+)}", query)))
+
+        def reader(**query_kwargs: Any) -> pd.DataFrame:
+            from sqlalchemy import create_engine
+
+            engine = create_engine(connect_url)
+            try:
+                return pd.read_sql_query(query.format(**query_kwargs) if query_kwargs else query, engine)
+            finally:
+                engine.dispose()
+
+        reader.__name__ = "sqlalchemy_reader"
+        reader.__annotations__ = {"return": pd.DataFrame}
+        reader.__signature__ = Signature(  # type: ignore[attr-defined]
+            parameters=[Parameter(name, Parameter.KEYWORD_ONLY, annotation=Any) for name in placeholders],
+            return_annotation=pd.DataFrame,
+        )
+        dataset.reader(reader)
+        return dataset
+
+    @classmethod
     def from_torch_dataset(cls, torch_dataset: Any, *args: Any, **kwargs: Any) -> "Dataset":
         """Create a Dataset reading a ``torch.utils.data.Dataset`` into host numpy arrays."""
         dataset = cls(*args, **kwargs)
