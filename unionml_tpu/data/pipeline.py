@@ -178,7 +178,9 @@ class PrefetchIterator:
                 self.sharding.shard_shape(leaf.shape)  # raises when indivisible
             except Exception:
                 return jax.device_put(leaf)
-            return jax.device_put(leaf, self.sharding)
+            from unionml_tpu.parallel.sharding import place_global_array
+
+            return place_global_array(leaf, self.sharding)
 
         return jax.tree_util.tree_map(place_leaf, host_batch)
 

@@ -404,6 +404,30 @@ class Dataset:
         return dataset
 
     @classmethod
+    def _from_query(
+        cls, query: str, execute: Callable[[str], "pd.DataFrame"], reader_name: str, *args: Any, **kwargs: Any
+    ) -> "Dataset":
+        """Shared scaffolding for SQL-backed datasets: each ``{placeholder}`` in the
+        query becomes a typed keyword parameter of the synthesized reader (a typed
+        workflow input — Stage drops bare ``**kwargs`` from its interface)."""
+        import re
+
+        dataset = cls(*args, **kwargs)
+        placeholders = list(dict.fromkeys(re.findall(r"{(\w+)}", query)))
+
+        def reader(**query_kwargs: Any) -> pd.DataFrame:
+            return execute(query.format(**query_kwargs) if query_kwargs else query)
+
+        reader.__name__ = reader_name
+        reader.__annotations__ = {"return": pd.DataFrame}
+        reader.__signature__ = Signature(  # type: ignore[attr-defined]
+            parameters=[Parameter(name, Parameter.KEYWORD_ONLY, annotation=Any) for name in placeholders],
+            return_annotation=pd.DataFrame,
+        )
+        dataset.reader(reader)
+        return dataset
+
+    @classmethod
     def from_sqlite_query(cls, db_path: str, query: str, *args: Any, **kwargs: Any) -> "Dataset":
         """Create a Dataset whose reader executes a SQLite query into a DataFrame.
 
@@ -411,29 +435,16 @@ class Dataset:
         (unionml/dataset.py:431-444) with a direct ``sqlite3`` reader. The query may
         contain ``{limit}``-style placeholders filled from reader kwargs.
         """
-        import re
 
-        dataset = cls(*args, **kwargs)
-        placeholders = list(dict.fromkeys(re.findall(r"{(\w+)}", query)))
-
-        def reader(**query_kwargs: Any) -> pd.DataFrame:
+        def execute(sql: str) -> pd.DataFrame:
             import contextlib
             import sqlite3
 
             # sqlite3's context manager only commits; closing() actually releases the handle
             with contextlib.closing(sqlite3.connect(db_path)) as conn:
-                return pd.read_sql_query(query.format(**query_kwargs) if query_kwargs else query, conn)
+                return pd.read_sql_query(sql, conn)
 
-        reader.__name__ = "sqlite_reader"
-        reader.__annotations__ = {"return": pd.DataFrame}
-        # surface each {placeholder} as a named keyword parameter so it becomes a typed
-        # workflow input (Stage drops bare **kwargs from its interface)
-        reader.__signature__ = Signature(  # type: ignore[attr-defined]
-            parameters=[Parameter(name, Parameter.KEYWORD_ONLY, annotation=Any) for name in placeholders],
-            return_annotation=pd.DataFrame,
-        )
-        dataset.reader(reader)
-        return dataset
+        return cls._from_query(query, execute, "sqlite_reader", *args, **kwargs)
 
     @classmethod
     def from_sqlalchemy_query(cls, connect_url: str, query: str, *args: Any, **kwargs: Any) -> "Dataset":
@@ -444,8 +455,6 @@ class Dataset:
         ``{placeholder}``-style query params become typed reader kwargs like
         :meth:`from_sqlite_query`.
         """
-        import re
-
         try:
             import sqlalchemy  # noqa: F401
         except ImportError as exc:  # pragma: no cover - import gate
@@ -454,26 +463,16 @@ class Dataset:
                 "or use Dataset.from_sqlite_query for sqlite databases"
             ) from exc
 
-        dataset = cls(*args, **kwargs)
-        placeholders = list(dict.fromkeys(re.findall(r"{(\w+)}", query)))
-
-        def reader(**query_kwargs: Any) -> pd.DataFrame:
+        def execute(sql: str) -> pd.DataFrame:
             from sqlalchemy import create_engine
 
             engine = create_engine(connect_url)
             try:
-                return pd.read_sql_query(query.format(**query_kwargs) if query_kwargs else query, engine)
+                return pd.read_sql_query(sql, engine)
             finally:
                 engine.dispose()
 
-        reader.__name__ = "sqlalchemy_reader"
-        reader.__annotations__ = {"return": pd.DataFrame}
-        reader.__signature__ = Signature(  # type: ignore[attr-defined]
-            parameters=[Parameter(name, Parameter.KEYWORD_ONLY, annotation=Any) for name in placeholders],
-            return_annotation=pd.DataFrame,
-        )
-        dataset.reader(reader)
-        return dataset
+        return cls._from_query(query, execute, "sqlalchemy_reader", *args, **kwargs)
 
     @classmethod
     def from_torch_dataset(cls, torch_dataset: Any, *args: Any, **kwargs: Any) -> "Dataset":

@@ -74,6 +74,10 @@ def _maybe_init_distributed() -> None:
         return
     import jax
 
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # emulated multi-host lane: a TPU plugin on the path would win over the env
+        # var, so pin the platform before the backend initializes
+        jax.config.update("jax_platforms", "cpu")
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=int(os.environ.get("UNIONML_TPU_NUM_PROCESSES", "1")),
@@ -139,12 +143,22 @@ def run_job(execution_dir: str) -> None:
 
         if _current_attempt(exec_path) != my_attempt:
             os._exit(43)  # fenced just before commit: a newer attempt owns the outputs
-        status.write_text("SUCCEEDED")
+        # only process 0 commits SUCCEEDED: a fast non-primary worker must not mark
+        # the execution done while the primary is still writing outputs
+        if int(os.environ.get("UNIONML_TPU_PROCESS_ID", "0")) == 0:
+            status.write_text("SUCCEEDED")
     except Exception:
         traceback.print_exc()
         if _current_attempt(exec_path) != my_attempt:
             os._exit(43)  # fenced: don't clobber the replacement attempt's status
-        status.write_text("FAILED")
+        try:
+            committed = status.read_text().strip() == "SUCCEEDED"
+        except OSError:
+            committed = False
+        if not committed:
+            # don't clobber a SUCCEEDED the primary already committed (a late
+            # non-primary failure after the outputs are complete is not a job failure)
+            status.write_text("FAILED")
         sys.exit(1)
     finally:
         stop_heartbeat.set()

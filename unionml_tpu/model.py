@@ -579,10 +579,13 @@ class Model:
         domain: Optional[str] = None,
         backend_store: Optional[str] = None,
         accelerator: Optional[str] = None,
+        n_workers: int = 1,
     ) -> None:
         """Configure the remote backend (reference model.py:625-654 keeps docker/Flyte
-        knobs; our substrate adds ``backend_store`` — the job/artifact store root — and
-        ``accelerator`` — the TPU slice topology to schedule training onto)."""
+        knobs; our substrate adds ``backend_store`` — the job/artifact store root —
+        ``accelerator`` — the TPU slice topology to schedule training onto — and
+        ``n_workers`` — worker processes per execution, which join one
+        ``jax.distributed`` runtime (the multi-host slice analog)."""
         from unionml_tpu.remote import BackendConfig
 
         self._backend_config = BackendConfig(
@@ -595,6 +598,7 @@ class Model:
             domain=domain or "development",
             store=backend_store,
             accelerator=accelerator,
+            n_workers=n_workers,
         )
         self.__backend__ = None
 

@@ -132,9 +132,27 @@ def infer_fsdp_sharding(
     return jax.tree_util.tree_map(leaf_sharding, pytree)
 
 
+def place_global_array(leaf: Any, sharding: NamedSharding) -> Any:
+    """Place a host array that every process holds in full onto a (possibly
+    multi-process) sharding.
+
+    Single-process: plain ``device_put``. Multi-process: ``device_put`` of numpy
+    data onto a non-replicated sharding is not allowed (jax requires explicit
+    intent about which host rows are whose); ``make_array_from_callback`` is the
+    supported pattern when the full value is available on every host — each
+    process materializes only its addressable shards.
+    """
+    if jax.process_count() > 1 and not getattr(sharding, "is_fully_replicated", False):
+        import numpy as _np
+
+        host = _np.asarray(leaf)
+        return jax.make_array_from_callback(host.shape, sharding, lambda idx: host[idx])
+    return jax.device_put(leaf, sharding)
+
+
 def shard_pytree(pytree: Any, shardings: Any) -> Any:
     """Place a host/device pytree according to a sharding pytree."""
-    return jax.tree_util.tree_map(lambda leaf, s: jax.device_put(leaf, s), pytree, shardings)
+    return jax.tree_util.tree_map(lambda leaf, s: place_global_array(leaf, s), pytree, shardings)
 
 
 def combine_fsdp_tp(

@@ -66,6 +66,11 @@ class BackendConfig:
     domain: str = "development"
     store: Optional[str] = None
     accelerator: Optional[str] = None
+    #: worker processes per execution. 1 = single-host. >1 = the local analog of a
+    #: multi-host slice: N job_runner processes join one jax.distributed runtime
+    #: (UNIONML_TPU_COORDINATOR/.._NUM_PROCESSES/.._PROCESS_ID) and pjit-compiled
+    #: stages span the global mesh; process 0 is the single writer of outputs.
+    n_workers: int = 1
 
     def store_path(self) -> Path:
         root = self.store or os.environ.get("UNIONML_TPU_STORE") or os.path.join(Path.home(), ".unionml_tpu")
@@ -81,6 +86,8 @@ class Execution:
     path: str
     #: process handle when launched by this client (local executor only, not serialized)
     proc: Optional[Any] = dataclasses.field(default=None, repr=False, compare=False)
+    #: all worker process handles (multi-worker executions; procs[0] is proc)
+    procs: List[Any] = dataclasses.field(default_factory=list, repr=False, compare=False)
 
     @property
     def status(self) -> str:
@@ -229,28 +236,51 @@ class Backend:
     def _launch(self, model_name: str, execution: Execution, app_version: str) -> None:
         """Spawn the worker process(es) for an execution.
 
-        Single-host local executor today; the multi-host seam is: launch this same
-        command on every host of the slice with ``UNIONML_TPU_COORDINATOR`` /
-        ``UNIONML_TPU_NUM_PROCESSES`` / ``UNIONML_TPU_PROCESS_ID`` set, and
-        ``job_runner`` joins them via ``jax.distributed.initialize``.
+        With ``n_workers > 1`` this is the local analog of a multi-host TPU slice:
+        every worker runs the same ``job_runner`` command with
+        ``UNIONML_TPU_COORDINATOR`` / ``UNIONML_TPU_NUM_PROCESSES`` /
+        ``UNIONML_TPU_PROCESS_ID`` set and joins one ``jax.distributed`` runtime,
+        so pjit-compiled stages span the global mesh. A cluster scheduler plugs in
+        at exactly this seam by launching the same command once per host.
         """
         bundle = self._app_dir(model_name, app_version) / "bundle"
         framework_root = Path(__file__).resolve().parent.parent  # unionml_tpu's parent dir
-        env = dict(os.environ)
-        env["PYTHONPATH"] = os.pathsep.join(
-            filter(None, [str(bundle), str(framework_root), env.get("PYTHONPATH", "")])
+        base_env = dict(os.environ)
+        base_env["PYTHONPATH"] = os.pathsep.join(
+            filter(None, [str(bundle), str(framework_root), base_env.get("PYTHONPATH", "")])
         )
         attempt_file = Path(execution.path) / "attempt"
         attempt = int(attempt_file.read_text().strip()) + 1 if attempt_file.exists() else 0
         attempt_file.write_text(str(attempt))
         mode = "w" if attempt == 0 else "a"
-        with open(Path(execution.path) / "logs.txt", mode) as log_file:
-            execution.proc = subprocess.Popen(
-                [sys.executable, "-m", "unionml_tpu.job_runner", execution.path],
-                env=env,
-                stdout=log_file,
-                stderr=subprocess.STDOUT,
-            )
+
+        n_workers = max(1, self.config.n_workers)
+        if n_workers > 1 and "UNIONML_TPU_COORDINATOR" not in base_env:
+            import socket
+
+            with socket.socket() as probe:
+                probe.bind(("127.0.0.1", 0))
+                port = probe.getsockname()[1]
+            base_env["UNIONML_TPU_COORDINATOR"] = f"127.0.0.1:{port}"
+        if n_workers > 1:
+            base_env["UNIONML_TPU_NUM_PROCESSES"] = str(n_workers)
+
+        execution.procs = []
+        for worker in range(n_workers):
+            env = dict(base_env)
+            if n_workers > 1:
+                env["UNIONML_TPU_PROCESS_ID"] = str(worker)
+            log_name = "logs.txt" if worker == 0 else f"logs.{worker}.txt"
+            with open(Path(execution.path) / log_name, mode) as log_file:
+                execution.procs.append(
+                    subprocess.Popen(
+                        [sys.executable, "-m", "unionml_tpu.job_runner", execution.path],
+                        env=env,
+                        stdout=log_file,
+                        stderr=subprocess.STDOUT,
+                    )
+                )
+        execution.proc = execution.procs[0]
 
     def resubmit(self, execution: Execution) -> Execution:
         """Relaunch a failed/lost execution in place (slice-failure recovery).
@@ -364,37 +394,55 @@ class Backend:
         while True:
             while not execution.is_done:
                 failure: Optional[str] = None
-                if execution.proc is not None and execution.proc.poll() is not None and not execution.is_done:
-                    # worker died without writing a terminal status (interpreter-level failure)
+                procs = execution.procs or ([execution.proc] if execution.proc is not None else [])
+                exited = [p for p in procs if p.poll() is not None]
+                if procs and not execution.is_done and (
+                    any(p.returncode != 0 for p in exited) or len(exited) == len(procs)
+                ):
+                    # a worker died without a terminal status (interpreter-level
+                    # failure / killed host), or every worker exited without one
                     failure = "FAILED"
                 elif execution.status == "RUNNING":
                     # stale heartbeat = lost slice; applies to live-proc executions too
                     # (a wedged worker whose beat thread stopped must be killed+retried).
-                    # A live process gets 3x the margin: the beat thread can be starved
+                    # Live processes get 3x the margin: the beat thread can be starved
                     # by one long GIL-holding call in an otherwise-healthy worker.
                     age = execution.heartbeat_age()
-                    live = execution.proc is not None and execution.proc.poll() is None
-                    threshold = 3 * heartbeat_timeout if live else heartbeat_timeout
+                    any_live = any(p.poll() is None for p in procs)
+                    threshold = 3 * heartbeat_timeout if any_live else heartbeat_timeout
                     if age is not None and age > threshold:
                         failure = "LOST"
-                        if live:
-                            execution.proc.kill()
-                            execution.proc.wait()
                 if failure is not None:
+                    self._kill_workers(execution)
                     (Path(execution.path) / "status").write_text(failure)
                     break
                 if time.monotonic() > deadline:
                     raise TimeoutError(f"execution {execution.id} did not finish within {timeout}s")
                 time.sleep(poll_interval)
+            if execution.status in ("FAILED", "LOST"):
+                # a worker may have written the terminal status itself while its
+                # peers are blocked in a collective — reap them before retry/raise
+                self._kill_workers(execution)
             if execution.status in ("FAILED", "LOST") and execution.attempt < retries:
                 self.resubmit(execution)
                 continue
             break
         if execution.status in ("FAILED", "LOST"):
-            log = Path(execution.path) / "logs.txt"
-            tail = log.read_text()[-2000:] if log.exists() else "<no logs>"
+            tails = []
+            for log in sorted(Path(execution.path).glob("logs*.txt")):
+                if log.exists():
+                    tails.append(f"--- {log.name} ---\n{log.read_text()[-2000:]}")
+            tail = "\n".join(tails) or "<no logs>"
             raise RuntimeError(f"execution {execution.id} {execution.status}; log tail:\n{tail}")
         return execution
+
+    @staticmethod
+    def _kill_workers(execution: Execution) -> None:
+        procs = execution.procs or ([execution.proc] if execution.proc is not None else [])
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
 
     def fetch_artifact(self, model: Any, execution: Execution) -> ModelArtifact:
         """Load the ModelArtifact from a SUCCEEDED training execution

@@ -250,8 +250,10 @@ def fit(
                 skip_batches=start_step,
             )
             host_tree = jax.tree_util.tree_unflatten(source._treedef, source._leaves)
+            from unionml_tpu.parallel.sharding import place_global_array
+
             try:
-                data_dev = jax.device_put(host_tree, batch_sh)
+                data_dev = jax.tree_util.tree_map(lambda leaf: place_global_array(leaf, batch_sh), host_tree)
             except Exception:
                 data_dev = jax.device_put(host_tree)
             _sync_fence(data_dev)  # keep the (possibly multi-second) H2D out of the timed loop
