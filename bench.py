@@ -40,8 +40,13 @@ def _synthetic(n: int = BATCH * 300):  # divisible by steps_per_call: no trailin
     return X, y
 
 
-def bench_jax() -> float:
+def bench_jax(platform: str | None = None) -> float:
     import jax
+
+    if platform:
+        # A platform plugin may override jax_platforms at import time; pin the
+        # requested platform after import, before backend init.
+        jax.config.update("jax_platforms", platform)
     import jax.numpy as jnp
     import optax
 
@@ -107,8 +112,58 @@ def bench_torch_cpu() -> float:
     return sps
 
 
+_RESULT_TAG = "BENCH_RESULT_SAMPLES_PER_SEC"
+
+
+def _run_jax_worker(platform: str | None, timeout_s: float) -> "tuple[float, str] | str":
+    """Run bench_jax in a clean subprocess (the TPU plugin's backend init can hang
+    or crash this whole process — isolate it). Returns (samples/sec/chip, platform
+    the worker actually ran on), or "timeout" (retry-worthy: the backend wedged) /
+    "failed" (deterministic: don't waste retries)."""
+    import os
+    import subprocess
+
+    args = [sys.executable, os.path.abspath(__file__), "--jax-worker"]
+    if platform:
+        args.append(platform)
+    try:
+        proc = subprocess.run(args, stdout=subprocess.PIPE, timeout=timeout_s, text=True)
+    except subprocess.TimeoutExpired:
+        _log(f"jax worker (platform={platform or 'default'}) timed out after {timeout_s:.0f}s")
+        return "timeout"
+    if proc.returncode != 0:
+        _log(f"jax worker (platform={platform or 'default'}) exited rc={proc.returncode}")
+        return "failed"
+    for line in reversed(proc.stdout.splitlines()):
+        if line.startswith(_RESULT_TAG):
+            _, value, ran_on = line.split()
+            return float(value), ran_on
+    _log("jax worker produced no result line")
+    return "failed"
+
+
 def main() -> None:
-    value = bench_jax()
+    attempts, backoff_s, timeout_s = 3, 60.0, 420.0
+    result: "tuple[float, str] | str" = "timeout"
+    for attempt in range(attempts):
+        result = _run_jax_worker(None, timeout_s)  # default platform = TPU when healthy
+        if result == "failed":
+            break  # deterministic failure: retrying identically is wasted wall-clock
+        if not isinstance(result, str):
+            break
+        if attempt < attempts - 1:
+            _log(f"retrying TPU bench in {backoff_s:.0f}s (attempt {attempt + 2}/{attempts})")
+            time.sleep(backoff_s)
+    if isinstance(result, str):
+        _log("TPU backend unavailable after retries; falling back to CPU so the bench still reports")
+        result = _run_jax_worker("cpu", 900.0)
+    if isinstance(result, str):
+        _log("FATAL: bench failed on every backend")
+        sys.exit(1)
+    value, ran_on = result
+    # a CPU-backed number must never masquerade as the TPU headline metric
+    metric = "mlp_train_throughput" if ran_on not in ("cpu",) else "mlp_train_throughput_cpu_fallback"
+    _log(f"bench ran on platform={ran_on}")
     try:
         baseline = bench_torch_cpu()
         vs_baseline = value / baseline if baseline > 0 else 0.0
@@ -118,7 +173,7 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": "mlp_train_throughput",
+                "metric": metric,
                 "value": round(value, 1),
                 "unit": "samples/sec/chip",
                 "vs_baseline": round(vs_baseline, 3),
@@ -128,4 +183,10 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 2 and sys.argv[1] == "--jax-worker":
+        result = bench_jax(sys.argv[2] if len(sys.argv) >= 3 else None)
+        import jax
+
+        print(f"{_RESULT_TAG} {result} {jax.devices()[0].platform}", flush=True)
+    else:
+        main()

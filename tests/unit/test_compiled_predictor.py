@@ -1,0 +1,160 @@
+"""Jitted bucketed predictor tests (SURVEY.md §7 hard part 4).
+
+The contract under test: across requests of varied batch sizes, the number of XLA
+traces (== compiles) stays at len(config.buckets()) because every request is padded
+to a bucket shape before dispatch; non-jittable predictors fall back to eager with
+identical results.
+"""
+
+from typing import Any, Dict, List
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from unionml_tpu import Dataset, Model
+from unionml_tpu.serving import CompiledPredictor, ServingConfig, serving_app
+
+
+def _linear_params():
+    return {"w": np.arange(3, dtype=np.float32), "b": np.float32(1.0)}
+
+
+def _linear_predict(params, feats):
+    return feats @ params["w"] + params["b"]
+
+
+def test_compile_count_stays_at_bucket_count():
+    cp = CompiledPredictor(_linear_predict, ServingConfig(bucket_sizes=[4, 8]))
+    params = _linear_params()
+    for n in (1, 2, 3, 4, 5, 7, 8, 3, 6, 1):
+        out = np.asarray(cp(params, np.ones((n, 3), np.float32)))
+        assert out.shape == (n,)
+    assert cp.traces == 2  # one compile per bucket, none per request size
+
+
+def test_padded_results_match_unpadded():
+    cp = CompiledPredictor(_linear_predict, ServingConfig(bucket_sizes=[8]))
+    params = _linear_params()
+    feats = np.random.default_rng(0).normal(size=(5, 3)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(cp(params, feats)), _linear_predict(params, feats), rtol=1e-6)
+
+
+def test_oversized_request_chunks_through_largest_bucket():
+    cp = CompiledPredictor(_linear_predict, ServingConfig(bucket_sizes=[4, 8]))
+    params = _linear_params()
+    feats = np.random.default_rng(1).normal(size=(21, 3)).astype(np.float32)
+    out = np.asarray(cp(params, feats))
+    assert out.shape == (21,)
+    np.testing.assert_allclose(out, _linear_predict(params, feats), rtol=1e-6)
+    assert cp.traces == 1  # every chunk (incl. the 5-row remainder) pads to the 8-bucket
+
+
+def test_warmup_precompiles_all_buckets():
+    cfg = ServingConfig(bucket_sizes=[2, 4], feature_shape=(3,))
+    cp = CompiledPredictor(_linear_predict, cfg)
+    params = _linear_params()
+    for bucket in cfg.buckets():
+        assert cp.warmup(params, bucket)
+    assert cp.traces == 2
+    cp(params, np.ones((3, 3), np.float32))
+    assert cp.traces == 2  # request-path call hits the warm cache
+
+
+def test_warmup_without_feature_shape_is_skipped():
+    cp = CompiledPredictor(_linear_predict, ServingConfig(bucket_sizes=[4]))
+    assert cp.warmup(_linear_params(), 4) is False
+    assert cp.traces == 0
+
+
+def test_eager_fallback_for_unjittable_features():
+    def predict(params, feats):
+        # sklearn-style body: requires a real DataFrame, not a tracer
+        return [str(v) for v in feats["label"]]
+
+    cp = CompiledPredictor(predict, ServingConfig(bucket_sizes=[4]))
+    feats = pd.DataFrame({"label": ["a", "b"]})
+    assert cp(None, feats) == ["a", "b"]
+    assert cp._eager
+    assert cp.traces == 0
+
+
+def test_eager_fallback_for_untraceable_predictor():
+    def predict(params, feats):
+        return [float(x) for x in np.asarray(feats).sum(axis=1)]  # float() breaks tracing
+
+    cp = CompiledPredictor(predict, ServingConfig(bucket_sizes=[4]))
+    feats = np.ones((2, 3), np.float32)
+    assert cp(None, feats) == [3.0, 3.0]
+    assert cp._eager
+    # subsequent calls stay eager and keep working
+    assert cp(None, feats) == [3.0, 3.0]
+
+
+def test_mesh_placement_rounds_buckets_to_data_axis():
+    from unionml_tpu.parallel.mesh import MeshSpec
+
+    cfg = ServingConfig(bucket_sizes=[3, 6], mesh=MeshSpec(data=4, model=-1))
+    cp = CompiledPredictor(_linear_predict, cfg)
+    assert cp._buckets() == (4, 8)  # rounded up to multiples of the data axis
+    params = _linear_params()
+    out = np.asarray(cp(params, np.ones((3, 3), np.float32)))
+    assert out.shape == (3,)
+    assert cp.traces == 1
+
+
+@pytest.fixture
+def jax_serving_model() -> Model:
+    dataset = Dataset(name="lin_data", targets=["y"], test_size=0.2)
+
+    @dataset.reader
+    def reader(n: int = 32) -> pd.DataFrame:
+        rng = np.random.default_rng(3)
+        frame = pd.DataFrame({"x1": rng.normal(size=n), "x2": rng.normal(size=n)})
+        frame["y"] = frame["x1"] + frame["x2"]
+        return frame
+
+    def init(hyperparameters: Any = None) -> Dict[str, Any]:
+        return {"w": np.zeros(2, np.float32)}
+
+    model = Model(name="lin_model", init=init, dataset=dataset)
+
+    @model.trainer
+    def trainer(params: Dict[str, Any], features: pd.DataFrame, target: pd.DataFrame) -> Dict[str, Any]:
+        w, *_ = np.linalg.lstsq(features.to_numpy(), target.to_numpy().ravel(), rcond=None)
+        return {"w": w.astype(np.float32)}
+
+    @model.predictor(config=ServingConfig(bucket_sizes=[4], feature_shape=(2,), max_wait_ms=1.0))
+    def predictor(params: Dict[str, Any], features: pd.DataFrame) -> List[float]:
+        return features @ params["w"]
+
+    @model.evaluator
+    def evaluator(params: Dict[str, Any], features: pd.DataFrame, target: pd.DataFrame) -> float:
+        pred = np.asarray(features.to_numpy() @ params["w"])
+        return float(np.mean((pred - target.to_numpy().ravel()) ** 2))
+
+    return model
+
+
+def test_model_routes_predict_through_compiled_path(jax_serving_model):
+    jax_serving_model.train()
+    cp = jax_serving_model._compiled_predictor
+    assert cp is not None
+    preds = jax_serving_model.predict(features=pd.DataFrame({"x1": [1.0, 2.0], "x2": [0.5, 0.25]}))
+    assert np.asarray(preds).shape == (2,)
+    assert cp.traces == 1 and not cp._eager
+
+
+def test_serving_startup_warms_all_buckets(jax_serving_model):
+    import asyncio
+    import json
+
+    jax_serving_model.train()
+    app = serving_app(jax_serving_model)
+    asyncio.run(app.dispatch("GET", "/health"))  # triggers startup + warmup
+    cp = jax_serving_model._compiled_predictor
+    assert cp.traces == len(ServingConfig(bucket_sizes=[4]).buckets())
+    body = json.dumps({"features": [{"x1": 1.0, "x2": 1.0}]}).encode()
+    status, payload, _ = asyncio.run(app.dispatch("POST", "/predict", body))
+    assert status == 200 and len(payload) == 1
+    assert cp.traces == 1  # request hit the warmed executable

@@ -105,8 +105,9 @@ def test_micro_batcher_coalesces_requests():
 
     results = asyncio.run(scenario())
     assert sorted(r[0] for r in results) == [0, 2, 4, 6, 8, 10]
-    assert sum(calls) == 6
     assert len(calls) < 6  # at least some requests shared a dispatch
+    buckets = ServingConfig(max_batch_size=8).buckets()
+    assert all(n in buckets for n in calls)  # dispatches are padded to bucket shapes
 
 
 def test_micro_batcher_propagates_errors():

@@ -84,6 +84,7 @@ class Model:
         self._evaluator_mode: str = "eager"
         self._evaluator_config: Optional[Any] = None
         self._predictor_config: Optional[Any] = None
+        self._compiled_predictor: Optional[Any] = None
         self.last_fit_result: Optional[Any] = None
 
         # stage caches + per-stage exec kwargs
@@ -249,10 +250,30 @@ class Model:
         type_guards.guard_predictor(fn, self.model_type, self._dataset.feature_type)
         self._predictor = fn
         self._predictor_config = config
+        self._compiled_predictor = None
+        if config is not None and getattr(config, "jit", False):
+            from unionml_tpu.serving.compile import CompiledPredictor
+
+            self._compiled_predictor = CompiledPredictor(fn, config)
         self._predict_stage_kwargs = {"resources": DEFAULT_RESOURCES, **stage_kwargs}
         self._predict_stage = None
         self._predict_from_features_stage = None
         return fn
+
+    def _call_predictor(self, model_object: Any, features: Any) -> Any:
+        """Route a predictor call through the jitted bucketed path when configured
+        (SURVEY.md §7 hard part 4), else call the user fn eagerly (reference
+        model.py:498-499 semantics)."""
+        if self._compiled_predictor is not None:
+            return self._compiled_predictor(model_object, features)
+        return self._predictor(model_object, features)
+
+    def _predictor_warmup(self, batch_size: int) -> None:
+        """AOT-compile the predictor for one bucket — called per configured bucket by
+        :meth:`unionml_tpu.serving.app.ServingApp.startup` after the artifact loads."""
+        if self._compiled_predictor is None or self.artifact is None:
+            return
+        self._compiled_predictor.warmup(self.artifact.model_object, batch_size)
 
     def saver(self, fn: Callable) -> Callable:
         """Register a custom model-object serializer (reference model.py:273-276)."""
@@ -373,7 +394,7 @@ class Model:
         def predict_task(**kwargs: Any):
             parsed = self._dataset._parser(kwargs[data_arg_name], **self._dataset.parser_kwargs)
             features = self._dataset._feature_transformer(parsed[self._dataset._parser_feature_key])
-            return self._predictor(kwargs["model_object"], features)
+            return self._call_predictor(kwargs["model_object"], features)
 
         self._predict_stage = Stage(
             predict_task,
@@ -403,7 +424,7 @@ class Model:
         )
 
         def predict_from_features_task(**kwargs: Any):
-            return self._predictor(kwargs["model_object"], kwargs["features"])
+            return self._call_predictor(kwargs["model_object"], kwargs["features"])
 
         self._predict_from_features_stage = Stage(
             predict_from_features_task,

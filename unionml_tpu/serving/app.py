@@ -63,7 +63,12 @@ class ServingApp:
         if batcher is not None:
             self.batcher: Optional[MicroBatcher] = batcher
         elif isinstance(config, ServingConfig):
-            self.batcher = MicroBatcher(self._predict_features_sync, config)
+            # while the compiled predictor pads to bucket itself, skip the batcher's
+            # pandas-level padding; if it falls back to eager, batcher padding
+            # resumes honoring config.pad_to_bucket
+            compiled = getattr(model, "_compiled_predictor", None)
+            pad = None if compiled is None else (lambda: config.pad_to_bucket and compiled._eager)
+            self.batcher = MicroBatcher(self._predict_features_sync, config, pad_to_bucket=pad)
         else:
             self.batcher = None
 
@@ -157,6 +162,8 @@ class ServingApp:
         # parser — json.loads and its dict-of-PyObjects intermediate never run
         fast = self._predict_features_fast(body)
         if fast is not None:
+            if len(fast) == 0:
+                return 200, [], "application/json"  # no rows -> no predictions
             try:
                 if self.batcher is not None:
                     return 200, _to_jsonable(await self.batcher.submit(fast)), "application/json"
@@ -176,6 +183,8 @@ class ServingApp:
         features = payload.get("features")
         if inputs is None and features is None:
             raise HTTPError(500, "inputs or features must be supplied.")
+        if inputs is None and isinstance(features, (list, tuple)) and len(features) == 0:
+            return 200, [], "application/json"  # no rows -> no predictions
         if self.model.artifact is None:
             raise HTTPError(500, "Model artifact not found.")
 
@@ -249,4 +258,10 @@ def serving_app(
     """
     if isinstance(app, ServingApp):
         return app
+    if app is not None:
+        logger.warning(
+            f"serving_app received an app of type {type(app).__name__}; unlike the reference "
+            "(which mutates a FastAPI instance in place), unionml-tpu builds its own ServingApp — "
+            "the passed object is ignored. Use the returned ServingApp."
+        )
     return ServingApp(model, remote=remote, app_version=app_version, model_version=model_version, batcher=batcher)

@@ -30,6 +30,10 @@ class ServingConfig:
 
     ``bucket_sizes`` are the padded batch sizes the predictor is compiled for at
     startup (AOT warmup), avoiding cold-compiles on the request path.
+
+    With ``jit=True`` (the default) a jax-traceable predictor receives array
+    features and returns arrays — not DataFrames/Series; untraceable predictors
+    fall back to eager serving automatically with unchanged semantics.
     """
 
     max_batch_size: int = 32
@@ -37,6 +41,16 @@ class ServingConfig:
     bucket_sizes: Optional[Sequence[int]] = None
     mesh: Optional[MeshSpec] = None
     warmup: bool = True
+    #: jit-compile the predictor with pad-to-bucket shapes (falls back to eager
+    #: automatically if the predictor body is not jax-traceable)
+    jit: bool = True
+    #: pad coalesced micro-batches up to the next bucket before dispatch so the
+    #: predictor sees only bucket shapes even on the non-jitted path
+    pad_to_bucket: bool = True
+    #: per-row feature shape (e.g. ``(784,)``) used to synthesize warmup batches;
+    #: without it, warmup is skipped and buckets compile lazily on first use
+    feature_shape: Optional[Sequence[int]] = None
+    feature_dtype: str = "float32"
 
     def buckets(self) -> List[int]:
         if self.bucket_sizes:
@@ -87,11 +101,25 @@ def _split(result: Any, sizes: List[int]) -> List[Any]:
 class MicroBatcher:
     """Coalesce concurrent predict calls into single batched predictor dispatches."""
 
-    def __init__(self, predict_fn: Callable[[Any], Any], config: Optional[ServingConfig] = None):
+    def __init__(
+        self,
+        predict_fn: Callable[[Any], Any],
+        config: Optional[ServingConfig] = None,
+        pad_to_bucket: "Optional[bool | Callable[[], bool]]" = None,
+    ):
         self._predict_fn = predict_fn
         self.config = config or ServingConfig()
+        # the serving app passes a callable that disables batcher-level padding
+        # while a CompiledPredictor is actively padding downstream (on numpy, not
+        # pandas) — but re-enables it if that predictor falls back to eager
+        self._pad_to_bucket = self.config.pad_to_bucket if pad_to_bucket is None else pad_to_bucket
         self._queue: "asyncio.Queue[Tuple[Any, int, asyncio.Future]]" = asyncio.Queue()
         self._worker: Optional[asyncio.Task] = None
+
+    def _padding_active(self) -> bool:
+        if callable(self._pad_to_bucket):
+            return bool(self._pad_to_bucket())
+        return bool(self._pad_to_bucket)
 
     def start(self) -> None:
         if self._worker is None or self._worker.done():
@@ -135,6 +163,15 @@ class MicroBatcher:
             futures = [b[2] for b in batch]
             try:
                 combined = _concat(parts)
+                if self._padding_active() and total > 0:
+                    # above the largest bucket we leave the batch unpadded: inventing
+                    # k*largest shapes would defeat the bounded-shape goal, and a
+                    # downstream CompiledPredictor chunks oversized batches itself
+                    bucket = next((b for b in self.config.buckets() if b >= total), None)
+                    if bucket is not None:
+                        from unionml_tpu.serving.compile import pad_rows
+
+                        combined = pad_rows(combined, bucket)
                 # run the (potentially blocking) TPU dispatch off the event loop
                 result = await asyncio.get_event_loop().run_in_executor(None, self._predict_fn, combined)
                 for fut, piece in zip(futures, _split(result, sizes)):

@@ -1,0 +1,269 @@
+"""Jitted, bucketed predictor compilation for TPU serving.
+
+The reference's predict path calls the user predictor eagerly per request
+(unionml/fastapi.py:50-64) — fine for sklearn on CPU, but on TPU an un-jitted
+predictor pays Python dispatch per call and a fresh XLA compile per batch shape.
+:class:`CompiledPredictor` fixes both (SURVEY.md §7 hard part 4):
+
+1. incoming features are padded along the batch dim to the nearest configured
+   bucket, so the set of shapes XLA ever sees is exactly ``config.buckets()``;
+2. the user predictor is wrapped in one ``jax.jit`` whose shape-keyed cache
+   holds one executable per bucket, AOT-populated at server startup by
+   :meth:`warmup`;
+3. with ``config.mesh`` set, the padded batch is placed sharded over the mesh's
+   ``data`` axis and the model params are placed replicated, so multi-chip
+   serving runs without per-call host transfers;
+4. requests larger than the largest bucket are chunked through the largest
+   bucket instead of minting new shapes.
+
+Predictors that are not jax-traceable (e.g. sklearn ``model.predict`` bodies, or
+DataFrame features with object/string columns) permanently fall back to the
+eager path on first failure — same results, no serving outage.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import numpy as np
+
+from unionml_tpu._logging import logger
+
+__all__ = ["CompiledPredictor"]
+
+
+class _Unjittable(Exception):
+    """Features cannot be represented as fixed-shape arrays; use the eager path."""
+
+
+def _as_batched_arrays(features: Any) -> Any:
+    """Convert features into a pytree of numpy arrays with a leading batch dim."""
+    try:
+        import pandas as pd
+
+        if isinstance(features, (pd.DataFrame, pd.Series)):
+            arr = features.to_numpy()
+            if arr.dtype == object:
+                raise _Unjittable("DataFrame has object-dtype columns")
+            return arr
+    except ImportError:  # pragma: no cover
+        pass
+    if isinstance(features, (list, tuple)) and not isinstance(features, np.ndarray):
+        arr = np.asarray(features)
+        if arr.dtype == object:
+            raise _Unjittable("ragged or non-numeric feature rows")
+        return arr
+    if isinstance(features, dict):
+        return {k: _as_batched_arrays(v) for k, v in features.items()}
+    arr = np.asarray(features)
+    if arr.dtype == object:
+        raise _Unjittable(f"features of type {type(features)} are not array-convertible")
+    return arr
+
+
+def _leaves(tree: Any):
+    import jax
+
+    return jax.tree_util.tree_leaves(tree)
+
+
+def _tree_map(fn: Callable, tree: Any) -> Any:
+    import jax
+
+    return jax.tree_util.tree_map(fn, tree)
+
+
+def _num_rows(tree: Any) -> int:
+    leaves = _leaves(tree)
+    if not leaves:
+        raise _Unjittable("empty feature pytree")
+    n = int(np.shape(leaves[0])[0]) if np.ndim(leaves[0]) else None
+    if n is None:
+        raise _Unjittable("feature leaves have no batch dimension")
+    return n
+
+
+def pad_rows(features: Any, target: int) -> Any:
+    """Pad a batch to ``target`` rows by repeating the last row.
+
+    The one padding implementation for both serving layers: handles the
+    batcher's request containers (DataFrame, list-of-rows) and the compiled
+    path's array pytrees. No-op when the batch already has >= ``target`` rows
+    or is empty (nothing to repeat).
+    """
+    try:
+        import pandas as pd
+
+        if isinstance(features, pd.DataFrame):
+            n = len(features)
+            if n >= target or n == 0:
+                return features
+            reps = features.iloc[[-1] * (target - n)]
+            return pd.concat([features, reps], ignore_index=True)
+    except ImportError:  # pragma: no cover
+        pass
+    if isinstance(features, list):  # a list is rows, not a pytree, at this layer
+        n = len(features)
+        if n >= target or n == 0:
+            return features
+        return features + [features[-1]] * (target - n)
+
+    def pad(a: np.ndarray) -> np.ndarray:
+        a = np.asarray(a)
+        n = a.shape[0]
+        if n >= target or n == 0:
+            return a
+        reps = np.repeat(a[-1:], target - n, axis=0)
+        return np.concatenate([a, reps], axis=0)
+
+    return _tree_map(pad, features)
+
+
+class CompiledPredictor:
+    """Pad-to-bucket + per-bucket-jit + mesh-placement wrapper for a predictor fn.
+
+    ``traces`` counts *attempted* XLA traces (== compiles when tracing succeeds;
+    a failed trace also counts once before the eager fallback engages); tests
+    assert it stays at ``len(config.buckets())`` across varied request sizes.
+
+    Note the compiled path returns jax/numpy arrays — a predictor body written
+    against DataFrames (e.g. returning a pd.Series) only keeps its container type
+    on the eager path.
+    """
+
+    def __init__(self, predict_fn: Callable[[Any, Any], Any], config: Any):
+        import jax
+
+        self._fn = predict_fn
+        self.config = config
+        self.traces = 0
+        self._eager = False
+        # mesh build touches jax.devices() (backend init) — defer to first dispatch
+        # so registering a predictor never initializes a backend at import time
+        self._mesh_built = False
+        self._mesh = None
+        self._data_axis = 1
+
+        def traced(model_object: Any, features: Any) -> Any:
+            self.traces += 1  # python body runs once per XLA trace/compile
+            return predict_fn(model_object, features)
+
+        self._jitted = jax.jit(traced)
+        self._placed_src: Any = None  # strong ref keeps identity check valid
+        self._placed_params: Any = None
+
+    def _ensure_mesh(self) -> None:
+        if self._mesh_built:
+            return
+        self._mesh_built = True
+        if getattr(self.config, "mesh", None) is not None:
+            self._mesh = self.config.mesh.build()
+            self._data_axis = int(self._mesh.shape.get("data", 1))
+
+    # ------------------------------------------------------------------ buckets
+
+    def _buckets(self) -> Tuple[int, ...]:
+        self._ensure_mesh()
+        sizes = [max(1, -(-b // self._data_axis) * self._data_axis) for b in self.config.buckets()]
+        return tuple(sorted(set(sizes)))
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self._buckets():
+            if b >= n:
+                return b
+        return self._buckets()[-1]
+
+    # ------------------------------------------------------------------ placement
+
+    def _place(self, batch: Any, model_object: Any) -> Tuple[Any, Any]:
+        if self._mesh is None:
+            return batch, model_object
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def batch_spec(a: Any) -> NamedSharding:
+            return NamedSharding(self._mesh, P("data", *([None] * (np.ndim(a) - 1))))
+
+        batch = jax.tree_util.tree_map(lambda a: jax.device_put(a, batch_spec(a)), batch)
+        if self._placed_src is not model_object:
+            replicated = NamedSharding(self._mesh, P())
+            self._placed_params = jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, replicated), model_object
+            )
+            self._placed_src = model_object  # strong ref: old placement freed on swap
+        return batch, self._placed_params
+
+    # ------------------------------------------------------------------ dispatch
+
+    def _dispatch(self, model_object: Any, batch: Any, n: int) -> Any:
+        """Pad one ≤-largest-bucket chunk and run the jitted predictor."""
+        bucket = self._bucket_for(n)
+        padded = pad_rows(batch, bucket)
+        placed, params = self._place(padded, model_object)
+        out = self._jitted(params, placed)
+        return _tree_map(lambda a: a[:n], out)
+
+    def __call__(self, model_object: Any, features: Any) -> Any:
+        if self._eager:
+            return self._fn(model_object, features)
+        try:
+            batch = _as_batched_arrays(features)
+            n = _num_rows(batch)
+        except _Unjittable as exc:
+            logger.info(f"predictor features not jittable ({exc}); serving eagerly")
+            self._eager = True
+            return self._fn(model_object, features)
+        if n == 0:
+            return self._fn(model_object, features)  # nothing to pad; eager returns empty
+        try:
+            self._ensure_mesh()
+            largest = self._buckets()[-1]
+            if n <= largest:
+                return self._dispatch(model_object, batch, n)
+            # oversized request: chunk through the largest bucket, no new shapes
+            outs = []
+            for lo in range(0, n, largest):
+                hi = min(lo + largest, n)
+                chunk = _tree_map(lambda a: a[lo:hi], batch)
+                outs.append(self._dispatch(model_object, chunk, hi - lo))
+            import jax
+
+            return jax.tree_util.tree_map(lambda *parts: np.concatenate(parts, axis=0), *outs)
+        except Exception as exc:
+            import jax
+
+            # TypeError/AttributeError cover untraceable predictor bodies (sklearn
+            # .predict, DataFrame-method calls on what is now an ndarray tracer);
+            # JAXTypeError covers concretization errors. Anything else (e.g. an
+            # XlaRuntimeError from a preempted device) is treated as transient.
+            permanent = isinstance(exc, (TypeError, AttributeError, jax.errors.JAXTypeError))
+            if permanent:
+                # the predictor body is not traceable — no point retrying
+                logger.warning(
+                    f"predictor is not jit-compatible ({type(exc).__name__}: {exc}); "
+                    "falling back to eager serving permanently"
+                )
+                self._eager = True
+            else:
+                # transient device/runtime error: serve this request eagerly but
+                # keep the jitted path for the next one
+                logger.warning(
+                    f"jitted predictor dispatch failed ({type(exc).__name__}: {exc}); "
+                    "serving this request eagerly"
+                )
+            return self._fn(model_object, features)
+
+    # ------------------------------------------------------------------ warmup
+
+    def warmup(self, model_object: Any, batch_size: int) -> bool:
+        """AOT-compile the bucket that ``batch_size`` maps to. Needs
+        ``config.feature_shape`` (per-row shape) to synthesize a template batch;
+        returns False when no template is configured (lazy compile on first
+        request still keeps the shape set bounded)."""
+        shape = getattr(self.config, "feature_shape", None)
+        if shape is None or self._eager:
+            return False
+        dtype = getattr(self.config, "feature_dtype", "float32")
+        template = np.zeros((batch_size, *tuple(shape)), dtype=dtype)
+        self(model_object, template)
+        return not self._eager
