@@ -82,9 +82,18 @@ def main() -> None:
         f"{result.samples_per_sec:.1f} samples/s total, {sps_chip:.1f}/chip, "
         f"final loss {result.history[-1]['loss']:.3f}"
     )
-    # MFU: fwd+bwd ~ 6 * params * tokens FLOPs (matmul-dominated; embeds excluded is
-    # noise at seq 128)
-    flops_per_sample = 6 * n_params * SEQ_LEN
+    # MFU: fwd+bwd ~ 6 * matmul-params * tokens FLOPs. Embedding gathers are not
+    # FLOPs (BASELINE.md convention, same as bench_llama_lora), so the ~24M
+    # tok/pos/type embedding params are excluded from the accounting.
+    embed_params = sum(
+        int(np.prod(p.shape))
+        for name, sub in params.items()
+        if name in ("tok_embed", "pos_embed", "type_embed")
+        for p in jax.tree_util.tree_leaves(sub)
+    )
+    matmul_params = n_params - embed_params
+    log(f"matmul params: {matmul_params/1e6:.1f}M (embeddings {embed_params/1e6:.1f}M excluded)")
+    flops_per_sample = 6 * matmul_params * SEQ_LEN
     mfu = sps_chip * flops_per_sample / V5E_PEAK_BF16_FLOPS
 
     emit(

@@ -159,6 +159,46 @@ def test_pipeline_param_specs_matches_sequential():
     )
 
 
+def test_pipeline_param_specs_two_axis_dim_matches_sequential():
+    """A dim sharded over a TUPLE of axes (P('pipe', None, ('fsdp','model'))) must
+    reconstruct with the PartitionSpec's major-axis-first interleave: the body
+    all-gathers minor axis first. Oracle: sequential apply on replicated params."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n_stages, n_microbatches = 2, 2
+    mesh = MeshSpec(data=1, fsdp=2, pipe=n_stages, model=2).build()
+    stage = ToyStage(dim=16)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 16))
+    params = init_stage_params(stage, jax.random.PRNGKey(0), x[:1], n_stages)
+    stage_fn = lambda p, h: stage.apply({"params": p}, h)  # noqa: E731
+
+    # kernels: output dim sharded over BOTH fsdp and model (4-way on a 16/32-wide dim)
+    def spec_of(leaf):
+        return P("pipe", None, ("fsdp", "model")) if leaf.ndim == 3 else P("pipe")
+
+    specs = jax.tree_util.tree_map(spec_of, params)
+    sharded = jax.tree_util.tree_map(
+        lambda leaf, s: jax.device_put(leaf, NamedSharding(mesh, s)), params, specs
+    )
+
+    def loss_pipe(p):
+        out = pipeline_apply(
+            stage_fn, p, x, mesh, n_microbatches=n_microbatches, param_specs=specs
+        )
+        return jnp.mean(out**2), out
+
+    def loss_seq(p):
+        out = sequential_stage_apply(stage_fn, p, x)
+        return jnp.mean(out**2), out
+
+    (_, out), g_pipe = jax.jit(jax.value_and_grad(loss_pipe, has_aux=True))(sharded)
+    (_, ref), g_seq = jax.jit(jax.value_and_grad(loss_seq, has_aux=True))(params)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5), g_pipe, g_seq
+    )
+
+
 def test_pipeline_param_specs_rejects_unsharded_stage_dim():
     from jax.sharding import PartitionSpec as P
 

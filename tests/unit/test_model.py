@@ -109,6 +109,50 @@ def test_load_from_env(sklearn_model: Model, tmp_path, monkeypatch):
     assert obj is sklearn_model.artifact.model_object
 
 
+def test_keras_branch_dispatch_and_guard(tmp_path):
+    """The keras saver branch dispatches on module sniffing without importing
+    tensorflow, and loading without tensorflow raises a clear guidance error
+    (reference treats keras as first-class: unionml/model.py:957-984)."""
+    from unionml_tpu.artifact import load_model_object, save_model_object
+    from unionml_tpu.utils import is_keras_model
+
+    saved = {}
+
+    class FakeKerasModel:
+        pass
+
+    FakeKerasModel.__module__ = "keras.engine.training"
+    assert is_keras_model(FakeKerasModel)
+
+    FakeKerasModel.save = lambda self, file, *a, **k: saved.setdefault("file", file)
+    obj = FakeKerasModel()
+    out = tmp_path / "keras_model"
+    save_model_object(obj, {}, str(out))
+    assert saved["file"] == str(out)  # dispatched to the keras branch, not pickle
+
+    try:
+        import tensorflow  # noqa: F401
+    except ImportError:
+        with pytest.raises(RuntimeError, match="requires tensorflow"):
+            load_model_object(str(out), FakeKerasModel)
+
+
+def test_keras_save_load_roundtrip(tmp_path):
+    """Real keras model through the default saver/loader branch (reference
+    unionml/model.py:957-984): weights survive the round trip."""
+    keras = pytest.importorskip("tensorflow.keras")
+    import numpy as np
+
+    from unionml_tpu.artifact import load_model_object, save_model_object
+
+    model = keras.Sequential([keras.layers.Input((4,)), keras.layers.Dense(3)])
+    path = tmp_path / "model.keras"
+    save_model_object(model, {}, str(path))
+    loaded = load_model_object(str(path), type(model))
+    x = np.ones((2, 4), dtype="float32")
+    np.testing.assert_allclose(loaded.predict(x, verbose=0), model.predict(x, verbose=0))
+
+
 def test_custom_saver_loader(sklearn_model: Model, tmp_path):
     import joblib
 

@@ -42,6 +42,7 @@ def test_parse_records_empty_and_whitespace():
         b'[{"a": "string"}]',      # strings unsupported
         b'[{"a": [1]}]',           # nesting unsupported
         b'[{"a": 1}, {"b": 1}]',   # ragged keys
+        b'[{"a": 1, "a": 2}]',     # duplicate keys: json.loads does last-wins
         b'[{"a": 1}, {"a": 1, "b": 2}]',  # column count mismatch
         b'{"a": 1}',               # not an array
         b'[{"a": 1}] trailing',    # trailing garbage in strict mode

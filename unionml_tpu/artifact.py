@@ -131,9 +131,16 @@ def load_model_object(
         return model
 
     if is_keras_model(model_type):
-        from tensorflow import keras  # pragma: no cover - tf not in image
+        try:
+            from tensorflow import keras  # pragma: no cover - tf not in image
+        except ImportError as exc:
+            raise RuntimeError(
+                "Loading a keras model artifact requires tensorflow, which is not "
+                "installed. Install tensorflow or register a custom @model.loader "
+                "(reference keras branch: unionml/model.py:957-984)."
+            ) from exc
 
-        return keras.models.load_model(file)
+        return keras.models.load_model(file)  # pragma: no cover - tf not in image
 
     blob = file.read() if hasattr(file, "read") else Path(file).read_bytes()
     payload = pickle.loads(blob)

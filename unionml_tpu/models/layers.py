@@ -90,7 +90,8 @@ class Attention(nn.Module):
     beats XLA's fused attention on its benchmark; see
     :func:`unionml_tpu.ops.attention.multihead_attention`), ``"xla"``, ``"flash"``, or
     ``"ring"`` (sequence-parallel exact attention; requires running inside shard_map
-    with a ``sequence`` axis).
+    with a ``sequence`` axis), or ``"ulysses"`` (all-to-all sequence parallelism —
+    same shard_map requirement, cheaper collectives when heads divide the axis).
     """
 
     n_heads: int
@@ -130,12 +131,13 @@ class Attention(nn.Module):
             q = rotary_embedding(q, positions, self.rope_theta)
             k = rotary_embedding(k, positions, self.rope_theta)
 
-        if self.impl == "ring":
+        if self.impl in ("ring", "ulysses"):
             if mask is not None:
-                raise NotImplementedError("ring attention does not support arbitrary masks")
-            from unionml_tpu.ops.ring_attention import ring_attention
+                raise NotImplementedError("sequence-parallel attention does not support arbitrary masks")
+            from unionml_tpu.ops.ring_attention import ring_attention, ulysses_attention
 
-            out = ring_attention(q, k, v, causal=self.causal)
+            sp_attention = ring_attention if self.impl == "ring" else ulysses_attention
+            out = sp_attention(q, k, v, causal=self.causal)
         else:
             out = multihead_attention(q, k, v, causal=self.causal, mask=mask, impl=self.impl)
 

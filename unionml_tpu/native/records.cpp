@@ -165,6 +165,11 @@ int urt_parse_records(const char* buf, long len, long* out_rows, long* out_cols,
         double value;
         if (!parse_value(cur, &value)) return 4;
         if (rows == 0) {
+          // duplicate keys within a record: json.loads does last-wins (one
+          // column); decline rather than silently produce two columns
+          for (const std::string& existing : columns) {
+            if (existing == key) return 8;
+          }
           columns.push_back(key);
         } else {
           // every record must repeat the first record's key order (the layout

@@ -1,4 +1,8 @@
 """TPU compute ops: attention kernels (XLA reference, pallas flash, ring/SP)."""
 
 from unionml_tpu.ops.attention import dot_product_attention, multihead_attention  # noqa: F401
-from unionml_tpu.ops.ring_attention import ring_attention  # noqa: F401
+from unionml_tpu.ops.ring_attention import (  # noqa: F401
+    ring_attention,
+    sequence_sharded_attention,
+    ulysses_attention,
+)

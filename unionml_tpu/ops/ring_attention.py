@@ -22,6 +22,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from unionml_tpu.parallel.collectives import all_to_all, ring_permute
+
 
 def ring_attention(
     q: jax.Array,
@@ -77,9 +79,7 @@ def ring_attention(
         # rotate first, then accumulate: the loop runs steps 1..ring_size-1, so only
         # ring_size-1 ppermutes happen — no discarded final K/V transfer
         m, l, acc, k_blk, v_blk = carry
-        perm = [(i, (i + 1) % ring_size) for i in range(ring_size)]
-        k_blk = lax.ppermute(k_blk, axis_name=axis, perm=perm)
-        v_blk = lax.ppermute(v_blk, axis_name=axis, perm=perm)
+        k_blk, v_blk = ring_permute((k_blk, v_blk), axis)
         m, l, acc = attend(step, m, l, acc, k_blk, v_blk)
         return m, l, acc, k_blk, v_blk
 
@@ -88,6 +88,47 @@ def ring_attention(
     denom = jnp.where(l == 0.0, 1.0, l)
     out = (acc / denom).astype(q.dtype)  # [B, H, Lq, D]
     return out.transpose(0, 2, 1, 3)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis: str = "sequence",
+    causal: bool = False,
+    softmax_scale: Optional[float] = None,
+) -> jax.Array:
+    """DeepSpeed-Ulysses-style sequence parallelism: all-to-all instead of a ring.
+
+    Two resharding all-to-alls per call: ``[B, L/s, H, D] -> [B, L, H/s, D]``
+    (each device gets the FULL sequence for a head subset, dense attention runs
+    locally with no per-step communication), then back. Cheaper in collective
+    volume than ring attention when heads divide evenly over the axis and the
+    full-sequence scores fit in HBM; ring attention remains the O(L/s)-memory
+    option for extreme context lengths. Call inside ``shard_map``.
+    """
+    size = lax.axis_size(axis)
+    n_heads, n_kv = q.shape[2], k.shape[2]
+    if n_kv != n_heads:  # GQA: expand KV so the head dim reshards evenly
+        k = jnp.repeat(k, n_heads // n_kv, axis=2)
+        v = jnp.repeat(v, n_heads // n_kv, axis=2)
+    if n_heads % size:
+        raise ValueError(f"ulysses needs heads ({n_heads}) divisible by axis size ({size})")
+
+    # [B, L/s, H, D] -> [B, L, H/s, D]: head-sharded, sequence-complete
+    q_full, k_full, v_full = (all_to_all(t, axis, split_axis=2, concat_axis=1) for t in (q, k, v))
+
+    scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q_full.astype(jnp.float32) * scale, k_full.astype(jnp.float32))
+    if causal:
+        l_full = q_full.shape[1]
+        mask = jnp.arange(l_full)[:, None] >= jnp.arange(l_full)[None, :]
+        scores = jnp.where(mask[None, None], scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v_full.astype(jnp.float32)).astype(q.dtype)
+    # [B, L, H/s, D] -> [B, L/s, H, D]
+    return all_to_all(out, axis, split_axis=1, concat_axis=2)
 
 
 def sequence_sharded_attention(
@@ -99,9 +140,11 @@ def sequence_sharded_attention(
     causal: bool = False,
     batch_axes=("data", "fsdp"),
     sequence_axis: str = "sequence",
+    impl: str = "ring",
 ) -> jax.Array:
-    """Jit-level ring attention: shards sequence over ``sequence_axis``, batch over
-    ``batch_axes``, runs :func:`ring_attention` under ``shard_map``."""
+    """Jit-level sequence-parallel attention: shards sequence over ``sequence_axis``,
+    batch over ``batch_axes``, runs :func:`ring_attention` (``impl="ring"``) or
+    :func:`ulysses_attention` (``impl="ulysses"``) under ``shard_map``."""
     try:
         from jax import shard_map
     except ImportError:  # pragma: no cover - older jax
@@ -110,7 +153,8 @@ def sequence_sharded_attention(
     present_batch = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
     spec = P(present_batch, sequence_axis, None, None)
 
-    fn = functools.partial(ring_attention, axis=sequence_axis, causal=causal)
+    sp_attention = {"ring": ring_attention, "ulysses": ulysses_attention}[impl]
+    fn = functools.partial(sp_attention, axis=sequence_axis, causal=causal)
     try:
         wrapped = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
     except TypeError:  # older API spells the replication-check flag differently

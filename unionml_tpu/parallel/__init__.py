@@ -7,6 +7,14 @@ collectives (all-reduce / reduce-scatter / all-gather over ICI/DCN) implied by t
 sharding annotations.
 """
 
+from unionml_tpu.parallel.collectives import (  # noqa: F401
+    all_gather,
+    all_to_all,
+    allreduce_mean,
+    allreduce_sum,
+    reduce_scatter,
+    ring_permute,
+)
 from unionml_tpu.parallel.mesh import MeshSpec  # noqa: F401
 from unionml_tpu.parallel.pipeline import (  # noqa: F401
     init_stage_params,

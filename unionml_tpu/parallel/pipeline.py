@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from unionml_tpu.parallel.collectives import ring_permute
 from unionml_tpu.parallel.mesh import BATCH_AXES
 
 
@@ -140,7 +141,12 @@ def pipeline_apply(
                 for dim, entry in enumerate(spec[1:]):  # entry i+1 -> dim i after squeeze
                     if entry is None:
                         continue
-                    for name in entry if isinstance(entry, tuple) else (entry,):
+                    # PartitionSpec tuple sharding is major-axis-first: a dim sharded
+                    # P(('fsdp','model')) places shard f*M+m on device (f, m). A tiled
+                    # all_gather reconstructs contiguous segments only if the MINOR
+                    # axis is gathered first (each device then holds its major-axis
+                    # block contiguously), so gather in reversed spec order.
+                    for name in reversed(entry if isinstance(entry, tuple) else (entry,)):
                         leaf = lax.all_gather(leaf, name, axis=dim, tiled=True)
                 gathered.append(leaf)
             params = jax.tree_util.tree_unflatten(treedef, gathered)
@@ -148,7 +154,6 @@ def pipeline_apply(
         mb = batch // n_microbatches
         inputs = h.reshape((n_microbatches, mb) + h.shape[1:])
         ticks = n_microbatches + n_stages - 1
-        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
         def tick(carry, t):
             cur, outputs = carry
@@ -163,7 +168,7 @@ def pipeline_apply(
             idx = jnp.clip(out_idx, 0, n_microbatches - 1)
             prev = lax.dynamic_index_in_dim(outputs, idx, 0, keepdims=False)
             outputs = lax.dynamic_update_index_in_dim(outputs, jnp.where(write, y, prev), idx, 0)
-            cur = lax.ppermute(y, axis_name=axis, perm=perm)
+            cur = ring_permute(y, axis)
             return (cur, outputs), None
 
         cur0 = jnp.zeros(inputs.shape[1:], dtype=inputs.dtype)

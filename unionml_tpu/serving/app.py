@@ -158,8 +158,12 @@ class ServingApp:
 
     async def _predict(self, body: bytes):
         # native fast path: a {"features": [flat numeric records]} envelope is parsed
-        # straight from the wire bytes into a float32 DataFrame by the C++ records
-        # parser — json.loads and its dict-of-PyObjects intermediate never run
+        # straight from the wire bytes into a float64 DataFrame by the C++ records
+        # parser — json.loads and its dict-of-PyObjects intermediate never run.
+        # Dtype caveat: the fast path coerces every numeric column to float64,
+        # while the Python path preserves int64/bool dtypes from
+        # pd.DataFrame(records); values are identical, but a dtype-sensitive
+        # custom predictor may behave differently between the two paths.
         fast = self._predict_features_fast(body)
         if fast is not None:
             if len(fast) == 0:
