@@ -134,11 +134,25 @@ def main() -> None:
         stop_at = time.perf_counter() + DURATION_S
 
         def client() -> None:
+            # persistent HTTP/1.1 connection: the server's keep-alive support means
+            # each client pays the TCP handshake once, not per request
+            import http.client
+
+            body = json.dumps(payload)
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
             local = []
-            while time.perf_counter() < stop_at:
-                start = time.perf_counter()
-                post(base + "/predict", payload)
-                local.append(time.perf_counter() - start)
+            try:
+                while time.perf_counter() < stop_at:
+                    start = time.perf_counter()
+                    conn.request("POST", "/predict", body=body, headers={"Content-Type": "application/json"})
+                    resp = conn.getresponse()
+                    resp.read()
+                    local.append(time.perf_counter() - start)
+                    if resp.will_close:  # server opted out; reconnect
+                        conn.close()
+                        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+            finally:
+                conn.close()
             with lock:
                 latencies.extend(local)
 

@@ -65,7 +65,7 @@ APP = textwrap.dedent(
         return optax.softmax_cross_entropy_with_integer_labels(logits, y.reshape(-1)).mean()
 
     # the global mesh spans every device of every process in the slice
-    @model.trainer(config=TrainerConfig(epochs=3, batch_size=128, mesh=MeshSpec(data=-1)))
+    @model.trainer(config=TrainerConfig(epochs=3, batch_size=128, mesh=MeshSpec(data=-1), {trainer_config_extra}))
     def train_step(state, batch):
         return make_train_step(loss_fn)(state, batch)
 
@@ -82,10 +82,10 @@ APP = textwrap.dedent(
 )
 
 
-def test_two_worker_slice_trains_over_global_mesh(tmp_path, monkeypatch):
+def _run_two_worker_slice(tmp_path, monkeypatch, trainer_config_extra: str, app_version: str):
     app_dir = tmp_path / "appsrc"
     app_dir.mkdir()
-    (app_dir / "mh_app.py").write_text(APP)
+    (app_dir / "mh_app.py").write_text(APP.replace("{trainer_config_extra}", trainer_config_extra))
     monkeypatch.syspath_prepend(str(app_dir))
     monkeypatch.chdir(app_dir)
     # each worker emulates a 4-device host; the slice mesh is 2 x 4 = 8 devices
@@ -100,11 +100,16 @@ def test_two_worker_slice_trains_over_global_mesh(tmp_path, monkeypatch):
     model = mh_app.model
     model.remote(backend_store=str(tmp_path / "store"), n_workers=2)
 
-    model.remote_deploy(app_version="mh-v1")
+    model.remote_deploy(app_version=app_version)
     execution = model.remote_train(wait=False, hyperparameters={"learning_rate": 0.05})
     assert len(execution.procs) == 2
     model._backend.wait(execution, timeout=600)
-    assert execution.status == "SUCCEEDED"
+    assert execution.status == "SUCCEEDED", (Path(execution.path) / "logs.txt").read_text()[-2000:]
+    return model, execution
+
+
+def test_two_worker_slice_trains_over_global_mesh(tmp_path, monkeypatch):
+    model, execution = _run_two_worker_slice(tmp_path, monkeypatch, "", "mh-v1")
 
     # the workers really formed one 8-device runtime: process 0's log shows the
     # global mesh; Gloo connections only exist cross-process
@@ -116,3 +121,17 @@ def test_two_worker_slice_trains_over_global_mesh(tmp_path, monkeypatch):
 
     meta = json.loads((Path(execution.path) / "outputs" / "artifact.json").read_text())
     assert meta["metrics"]["test"] > 0.8
+
+
+def test_two_worker_device_data_steps_per_call(tmp_path, monkeypatch):
+    """device_data over a 2-process global mesh: the dataset is globally sharded
+    (each process's HBM holds only its row-shards via place_global_array) and the
+    multi-step scan dispatch (steps_per_call>1) runs SPMD across both workers."""
+    model, execution = _run_two_worker_slice(
+        tmp_path, monkeypatch, "device_data=True, steps_per_call=2", "mh-dd-v1"
+    )
+    log0 = (Path(execution.path) / "logs.txt").read_text()
+    assert "device_data over 2 processes" in log0
+
+    model.remote_load(execution)
+    assert model.artifact.metrics["train"] > 0.9, model.artifact.metrics

@@ -121,3 +121,69 @@ def test_micro_batcher_propagates_errors():
         await batcher.stop()
 
     asyncio.run(scenario())
+
+
+def test_metrics_endpoint_reports_latency_percentiles(trained_app):
+    for _ in range(5):
+        body = json.dumps({"features": [{"x1": 1.0, "x2": 1.0}]}).encode()
+        status, _, _ = _dispatch(trained_app, "POST", "/predict", body)
+        assert status == 200
+    _dispatch(trained_app, "POST", "/predict", b"not json")  # counted as an error
+
+    status, snapshot, _ = _dispatch(trained_app, "GET", "/metrics")
+    assert status == 200
+    assert snapshot["requests_total"] >= 6
+    assert snapshot["errors_total"] >= 1
+    predict = snapshot["routes"]["POST /predict"]
+    assert predict["requests"] >= 6 and predict["errors"] >= 1
+    assert predict["p50_ms"] > 0 and predict["p99_ms"] >= predict["p50_ms"]
+
+
+def test_http_keep_alive_serves_multiple_requests_per_connection(trained_app):
+    import socket
+    import threading
+    import time as _time
+
+    host = "127.0.0.1"
+    with socket.socket() as probe_sock:  # ephemeral port: parallel runs can't collide
+        probe_sock.bind((host, 0))
+        port = probe_sock.getsockname()[1]
+    # daemon thread: asyncio.run(serve_forever) has no cross-thread stop; it dies
+    # with the test process, and nothing else in the session targets this port
+    thread = threading.Thread(target=lambda: trained_app.run(host=host, port=port), daemon=True)
+    thread.start()
+    for _ in range(100):
+        try:
+            probe = socket.create_connection((host, port), timeout=1)
+            probe.close()
+            break
+        except OSError:
+            _time.sleep(0.05)
+
+    def http_get(sock, path):
+        sock.sendall(f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+        head = b""
+        while b"\r\n\r\n" not in head:
+            head += sock.recv(4096)
+        headers, _, rest = head.partition(b"\r\n\r\n")
+        length = int([line for line in headers.split(b"\r\n") if b"content-length" in line.lower()][0].split(b":")[1])
+        while len(rest) < length:
+            rest += sock.recv(4096)
+        return headers, rest
+
+    # two requests down ONE connection: the first response must be keep-alive
+    with socket.create_connection((host, port), timeout=5) as sock:
+        headers1, _ = http_get(sock, "/health")
+        assert b"Connection: keep-alive" in headers1
+        headers2, body2 = http_get(sock, "/metrics")
+        assert b"200 OK" in headers2.split(b"\r\n")[0]
+        # the /health request down this same connection was recorded (the /metrics
+        # request itself records only after its own snapshot)
+        assert json.loads(body2)["routes"]["GET /health"]["requests"] >= 1
+
+    # Connection: close is honored
+    with socket.create_connection((host, port), timeout=5) as sock:
+        sock.sendall(b"GET /health HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n")
+        data = sock.recv(65536)
+        assert b"Connection: close" in data
+        assert sock.recv(4096) == b""  # server closed the socket

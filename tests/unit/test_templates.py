@@ -37,9 +37,22 @@ def test_template_app_imports(render, template):
 def test_basic_template_trains_and_predicts(render):
     render("basic")
     module = importlib.import_module("app")
-    from sklearn.datasets import load_digits
 
-    model_object, metrics = module.model.train(hyperparameters={"max_iter": 10000})
-    assert metrics["train"] > 0.9
-    sample = load_digits(as_frame=True).frame.sample(5, random_state=42)
-    assert len(module.model.predict(features=sample)) == 5
+    model_object, metrics = module.model.train(
+        hyperparameters={"n_estimators": 50, "random_state": 0}
+    )
+    assert metrics["train"] > 0.9  # macro-F1
+    sample = module.reader().drop(columns=[module.TARGET]).sample(5, random_state=42)
+    predictions = module.model.predict(features=sample)
+    assert len(predictions) == 5 and all(p in (0, 1, 2) for p in predictions)
+
+
+def test_serverless_template_trains_and_scores(render):
+    render("basic-serverless")
+    module = importlib.import_module("app")
+
+    _, metrics = module.model.train(hyperparameters={"alpha": 1e-4, "max_iter": 2000})
+    assert metrics["test"] > 0.95  # ROC-AUC
+    sample = module.reader(limit=4).drop(columns=["diagnosis"])
+    probabilities = module.model.predict(features=sample)
+    assert len(probabilities) == 4 and all(0.0 <= p <= 1.0 for p in probabilities)

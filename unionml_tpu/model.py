@@ -368,6 +368,8 @@ class Model:
                     data,
                     batch_size=getattr(cfg, "batch_size", 128),
                     mesh=getattr(cfg, "mesh", None),
+                    partition_rules=getattr(cfg, "partition_rules", None),
+                    fsdp_min_weight_size=getattr(cfg, "fsdp_min_weight_size", 2**14),
                 )
                 for split, data in training_data.items()
             }

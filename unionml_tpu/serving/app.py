@@ -29,6 +29,7 @@ from unionml_tpu.artifact import ModelArtifact
 from unionml_tpu.defaults import MODEL_PATH_ENV_VAR
 from unionml_tpu.serving.batcher import MicroBatcher, ServingConfig
 from unionml_tpu.serving.http import HTTPError, HTTPServer
+from unionml_tpu.serving.metrics import ServingMetrics
 
 _BANNER = """
 <html>
@@ -72,8 +73,11 @@ class ServingApp:
         else:
             self.batcher = None
 
+        self.metrics = ServingMetrics()
+        self.server.metrics = self.metrics
         self.server.route("GET", "/", self._root)
         self.server.route("GET", "/health", self._health)
+        self.server.route("GET", "/metrics", self._metrics)
         self.server.route("POST", "/predict", self._predict)
 
     # ------------------------------------------------------------------ lifecycle
@@ -155,6 +159,12 @@ class ServingApp:
         if self.model.artifact is None:
             raise HTTPError(500, "Model artifact not found.")
         return 200, {"message": HTTPStatus.OK.phrase, "status": int(HTTPStatus.OK)}, "application/json"
+
+    async def _metrics(self, body: bytes):
+        """Request counters and latency percentiles per route (SURVEY.md §5.5 —
+        p50/p99 are the BASELINE serving metric, measured in-server, not just by
+        the external benchmark client)."""
+        return 200, self.metrics.snapshot(), "application/json"
 
     async def _predict(self, body: bytes):
         # native fast path: a {"features": [flat numeric records]} envelope is parsed

@@ -2,7 +2,9 @@
 
 Replaces the reference's FastAPI/uvicorn dependency (unionml/fastapi.py) with a
 self-contained server: request-line + header parsing, Content-Length bodies, JSON
-responses, graceful shutdown. Deliberately small — the serving surface is three
+responses, HTTP/1.1 keep-alive (persistent connections with an idle timeout — a
+benchmark client reusing one connection pays the TCP/loopback handshake once, not
+per request), graceful shutdown. Deliberately small — the serving surface is four
 routes — and dependency-free so the serving container stays lean on TPU VMs.
 """
 
@@ -10,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import time
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
 from unionml_tpu._logging import logger
@@ -25,6 +28,7 @@ _STATUS_PHRASES = {
 }
 
 MAX_BODY_BYTES = 64 * 1024 * 1024
+KEEPALIVE_IDLE_S = 75.0
 
 
 class HTTPServer:
@@ -33,73 +37,108 @@ class HTTPServer:
     def __init__(self) -> None:
         self._routes: Dict[Tuple[str, str], Handler] = {}
         self._server: Optional[asyncio.AbstractServer] = None
+        #: optional sink with a ``record(route, status, latency_s)`` method
+        #: (:class:`unionml_tpu.serving.metrics.ServingMetrics`)
+        self.metrics: Any = None
 
     def route(self, method: str, path: str, handler: Handler) -> None:
         self._routes[(method.upper(), path)] = handler
 
-    async def _read_request(self, reader: asyncio.StreamReader) -> Optional[Tuple[str, str, bytes]]:
+    async def _read_request(self, reader: asyncio.StreamReader) -> Optional[Tuple[str, str, bytes, bool]]:
         request_line = await reader.readline()
         if not request_line:
             return None
         try:
-            method, target, _version = request_line.decode("latin1").split(" ", 2)
+            method, target, version = request_line.decode("latin1").split(" ", 2)
         except ValueError:
             raise ValueError("malformed request line")
         path = target.split("?", 1)[0]
 
         content_length = 0
+        # HTTP/1.1 defaults to persistent connections; 1.0 must opt in
+        keep_alive = "1.0" not in version
+        wants_close = False
         while True:
             header_line = await reader.readline()
             if header_line in (b"\r\n", b"\n", b""):
                 break
             name, _, value = header_line.decode("latin1").partition(":")
-            if name.strip().lower() == "content-length":
+            name = name.strip().lower()
+            if name == "content-length":
                 content_length = int(value.strip())
+            elif name == "connection":
+                # the value is a comma-separated token list ("close, TE"); an
+                # explicit close wins over everything, including later headers
+                tokens = {t.strip().lower() for t in value.split(",")}
+                if "close" in tokens:
+                    keep_alive = False
+                    wants_close = True
+                elif "keep-alive" in tokens and not wants_close:
+                    keep_alive = True
         if content_length > MAX_BODY_BYTES:
             raise ValueError("request body too large")
         body = await reader.readexactly(content_length) if content_length else b""
-        return method.upper(), path, body
+        return method.upper(), path, body, keep_alive
 
     @staticmethod
-    def _encode_response(status: int, payload: Any, content_type: str = "application/json") -> bytes:
+    def _encode_response(
+        status: int, payload: Any, content_type: str = "application/json", *, keep_alive: bool = False
+    ) -> bytes:
         if content_type == "application/json":
             body = json.dumps(payload, default=str).encode()
         elif isinstance(payload, bytes):
             body = payload
         else:
             body = str(payload).encode()
+        connection = "keep-alive" if keep_alive else "close"
         head = (
             f"HTTP/1.1 {status} {_STATUS_PHRASES.get(status, 'Unknown')}\r\n"
             f"Content-Type: {content_type}\r\n"
             f"Content-Length: {len(body)}\r\n"
-            "Connection: close\r\n\r\n"
+            f"Connection: {connection}\r\n\r\n"
         )
         return head.encode("latin1") + body
 
     async def dispatch(self, method: str, path: str, body: bytes) -> Tuple[int, Any, str]:
         """Route a request; usable directly by tests (in-process 'test client')."""
+        start = time.perf_counter()
         handler = self._routes.get((method, path))
+        metrics_route = f"{method} {path}"
         if handler is None:
             if any(p == path for (_, p) in self._routes):
-                return 405, {"detail": f"method {method} not allowed for {path}"}, "application/json"
-            return 404, {"detail": f"no route for {path}"}, "application/json"
-        try:
-            return await handler(body)
-        except HTTPError as exc:
-            return exc.status, {"detail": exc.detail}, "application/json"
-        except Exception as exc:  # pragma: no cover - defensive
-            logger.exception("handler error")
-            return 500, {"detail": f"{type(exc).__name__}: {exc}"}, "application/json"
+                result = 405, {"detail": f"method {method} not allowed for {path}"}, "application/json"
+            else:
+                # unmatched paths share one metrics label — per-path labels would let
+                # a scanner grow the route table (and snapshot) without bound
+                metrics_route = "<unmatched>"
+                result = 404, {"detail": f"no route for {path}"}, "application/json"
+        else:
+            try:
+                result = await handler(body)
+            except HTTPError as exc:
+                result = exc.status, {"detail": exc.detail}, "application/json"
+            except Exception as exc:  # pragma: no cover - defensive
+                logger.exception("handler error")
+                result = 500, {"detail": f"{type(exc).__name__}: {exc}"}, "application/json"
+        if self.metrics is not None:
+            self.metrics.record(metrics_route, result[0], time.perf_counter() - start)
+        return result
 
     async def _on_connection(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
         try:
-            request = await self._read_request(reader)
-            if request is None:
-                return
-            method, path, body = request
-            status, payload, content_type = await self.dispatch(method, path, body)
-            writer.write(self._encode_response(status, payload, content_type))
-            await writer.drain()
+            while True:
+                try:
+                    request = await asyncio.wait_for(self._read_request(reader), KEEPALIVE_IDLE_S)
+                except asyncio.TimeoutError:
+                    break  # idle keep-alive connection: close quietly
+                if request is None:
+                    break
+                method, path, body, keep_alive = request
+                status, payload, content_type = await self.dispatch(method, path, body)
+                writer.write(self._encode_response(status, payload, content_type, keep_alive=keep_alive))
+                await writer.drain()
+                if not keep_alive:
+                    break
         except (ValueError, asyncio.IncompleteReadError) as exc:
             try:
                 writer.write(self._encode_response(400, {"detail": str(exc)}))

@@ -1,0 +1,70 @@
+"""Serving-side observability: request counters + latency percentiles.
+
+The reference's only serving probe is ``GET /health`` (unionml/fastapi.py:66-70) —
+no counters, no latency distribution (SURVEY.md §5.5). Here every dispatched
+request is recorded into a bounded reservoir per route, and ``GET /metrics``
+exposes counts and exact p50/p95/p99 over the most recent window. The reservoir
+(a ``deque(maxlen=...)``) bounds memory and keeps percentiles representative of
+*current* behavior rather than the process's whole lifetime.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Dict
+
+_WINDOW = 10_000  # most recent samples per route
+
+
+class ServingMetrics:
+    """Thread-safe request counters and a sliding-window latency reservoir."""
+
+    def __init__(self, window: int = _WINDOW):
+        self._window = window
+        self._lock = threading.Lock()
+        self._requests: Dict[str, int] = {}
+        self._errors: Dict[str, int] = {}
+        self._latencies: "Dict[str, deque]" = {}
+
+    def record(self, route: str, status: int, latency_s: float) -> None:
+        with self._lock:
+            self._requests[route] = self._requests.get(route, 0) + 1
+            if status >= 400:
+                self._errors[route] = self._errors.get(route, 0) + 1
+            bucket = self._latencies.setdefault(route, deque(maxlen=self._window))
+            bucket.append(latency_s)
+
+    @staticmethod
+    def _percentile(ordered: "list[float]", q: float) -> float:
+        # nearest-rank on the sorted window; ordered is non-empty
+        rank = min(len(ordered) - 1, max(0, round(q * (len(ordered) - 1))))
+        return ordered[rank]
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Counts + latency percentiles (milliseconds) per route."""
+        with self._lock:
+            routes = {r: list(lat) for r, lat in self._latencies.items()}
+            requests = dict(self._requests)
+            errors = dict(self._errors)
+        out: Dict[str, Any] = {
+            "requests_total": sum(requests.values()),
+            "errors_total": sum(errors.values()),
+            "routes": {},
+        }
+        for route, latencies in routes.items():
+            ordered = sorted(latencies)
+            entry: Dict[str, Any] = {
+                "requests": requests.get(route, 0),
+                "errors": errors.get(route, 0),
+            }
+            if ordered:
+                entry.update(
+                    window=len(ordered),
+                    mean_ms=round(sum(ordered) / len(ordered) * 1e3, 3),
+                    p50_ms=round(self._percentile(ordered, 0.50) * 1e3, 3),
+                    p95_ms=round(self._percentile(ordered, 0.95) * 1e3, 3),
+                    p99_ms=round(self._percentile(ordered, 0.99) * 1e3, 3),
+                )
+            out["routes"][route] = entry
+        return out

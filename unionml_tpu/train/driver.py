@@ -154,7 +154,14 @@ def _sync_fence(tree: Any) -> None:
         return
     leaf = leaves[0]
     try:
-        np.asarray(leaf if getattr(leaf, "ndim", 0) == 0 else leaf.ravel()[0])
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards:
+            # a multi-process global array is not fully addressable — fetch from
+            # a local shard instead of the (possibly remote) global index 0
+            local = shards[0].data
+            np.asarray(local if getattr(local, "ndim", 0) == 0 else local.ravel()[0])
+        else:
+            np.asarray(leaf if getattr(leaf, "ndim", 0) == 0 else leaf.ravel()[0])
     except Exception:
         jax.block_until_ready(leaf)
 
@@ -226,11 +233,19 @@ def fit(
                 logger.info(f"resumed train state from checkpoint step {latest}")
 
         if config.device_data:
-            if config.shard_batch_by_process and jax.process_count() > 1:
-                raise ValueError(
-                    "device_data=True does not support shard_batch_by_process yet: every "
-                    "process would hold and train the full global batch. Use the host "
-                    "batching path (device_data=False) for multi-process input sharding."
+            if jax.process_count() > 1:
+                # Multi-process device_data: every process computes the same host
+                # data (seeded readers — the multi-host contract), and
+                # place_global_array materializes only this process's addressable
+                # row-shards, so per-process HBM holds 1/process_count of the
+                # dataset. The epoch permute and dynamic_slice batch selection run
+                # inside jit over the global array — SPMD, XLA inserts the
+                # resharding collectives. shard_batch_by_process is therefore
+                # implied (the global array IS process-sharded); the flag only
+                # changes the host-batching path.
+                logger.info(
+                    f"device_data over {jax.process_count()} processes: dataset "
+                    "globally sharded, per-process HBM holds its row-shards only"
                 )
             if not config.drop_remainder:
                 logger.info(
@@ -439,14 +454,25 @@ def evaluate(
     *,
     batch_size: int = 128,
     mesh: Optional[MeshSpec] = None,
+    partition_rules: Optional[PartitionRules] = None,
+    fsdp_min_weight_size: int = 2**14,
 ) -> Dict[str, float]:
-    """Run a jitted eval step over a split and average the metrics."""
+    """Run a jitted eval step over a split and average the metrics.
+
+    The eval step is compiled with the same state shardings the train driver
+    resolves (explicit TP rules + inferred FSDP), so an FSDP/TP-sharded state is
+    consumed in place instead of being resharded per eval split.
+    """
     from unionml_tpu.data.pipeline import PrefetchIterator
 
     built = (mesh or MeshSpec()).build()
     with built:
+        state_shardings = _tree_device_shardings(state, built, partition_rules, fsdp_min_weight_size)
+        state = shard_pytree(state, state_shardings)
         batch_sh = batch_sharding(built)
-        compiled = jax.jit(eval_step)
+        # batch in_sharding stays unconstrained: the final partial batch arrives
+        # replicated when its size does not divide the data axis
+        compiled = jax.jit(eval_step, in_shardings=(state_shardings, None))
         totals: Dict[str, float] = {}
         count = 0
         for batch in PrefetchIterator(data, batch_size=batch_size, sharding=batch_sh, drop_remainder=False):
