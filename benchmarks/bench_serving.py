@@ -141,20 +141,34 @@ def main() -> None:
             body = json.dumps(payload)
             conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
             local = []
+            failures = 0
             try:
                 while time.perf_counter() < stop_at:
                     start = time.perf_counter()
-                    conn.request("POST", "/predict", body=body, headers={"Content-Type": "application/json"})
-                    resp = conn.getresponse()
-                    resp.read()
+                    try:
+                        conn.request("POST", "/predict", body=body, headers={"Content-Type": "application/json"})
+                        resp = conn.getresponse()
+                        resp.read()
+                        if resp.status != 200:
+                            raise RuntimeError(f"HTTP {resp.status}")
+                    except Exception as exc:
+                        # transient failure (keep-alive race, restart): reconnect and
+                        # keep driving load instead of silently dying with the samples
+                        failures += 1
+                        log(f"client request failed ({type(exc).__name__}: {exc}); reconnecting")
+                        conn.close()
+                        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+                        if failures > 50:
+                            raise
+                        continue
                     local.append(time.perf_counter() - start)
                     if resp.will_close:  # server opted out; reconnect
                         conn.close()
                         conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
             finally:
                 conn.close()
-            with lock:
-                latencies.extend(local)
+                with lock:
+                    latencies.extend(local)
 
         threads = [threading.Thread(target=client) for _ in range(CLIENTS)]
         with Timer() as t:

@@ -1,0 +1,241 @@
+"""Dependency-free docs site builder: docs/*.md -> docs/_site/*.html.
+
+The reference ships a Sphinx/MyST site (/root/reference/docs/source); this image
+has no sphinx/mkdocs and installs are off-limits, so the site generator is ~200
+lines of stdlib: a CommonMark-subset renderer (headings, fenced code, lists,
+tables, blockquotes, links, emphasis, inline code) plus a nav shell derived from
+index.md's Documentation list. Usage::
+
+    python docs/build.py [--out docs/_site]
+"""
+
+from __future__ import annotations
+
+import argparse
+import html
+import re
+from pathlib import Path
+from typing import List
+
+DOCS_DIR = Path(__file__).resolve().parent
+
+_PAGE = """<!doctype html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<meta name="viewport" content="width=device-width, initial-scale=1">
+<title>{title} — unionml-tpu</title>
+<style>
+:root {{ --fg: #1a1a1a; --muted: #666; --line: #e2e2e2; --accent: #0b57d0; --code-bg: #f6f8fa; }}
+* {{ box-sizing: border-box; }}
+body {{ margin: 0; color: var(--fg); font: 16px/1.6 system-ui, -apple-system, "Segoe UI", sans-serif; }}
+.layout {{ display: flex; min-height: 100vh; }}
+nav {{ width: 230px; flex-shrink: 0; border-right: 1px solid var(--line); padding: 24px 16px; }}
+nav h1 {{ font-size: 18px; margin: 0 0 12px; }}
+nav a {{ display: block; color: var(--muted); text-decoration: none; padding: 4px 8px; border-radius: 6px; font-size: 14px; }}
+nav a:hover {{ background: #f0f0f0; }}
+nav a.active {{ color: var(--accent); font-weight: 600; }}
+main {{ max-width: 860px; padding: 32px 40px 80px; overflow-x: auto; }}
+h1, h2, h3 {{ line-height: 1.25; }}
+h2 {{ border-bottom: 1px solid var(--line); padding-bottom: 6px; margin-top: 2em; }}
+a {{ color: var(--accent); }}
+code {{ background: var(--code-bg); padding: 2px 5px; border-radius: 4px; font-size: 87%; }}
+pre {{ background: var(--code-bg); border: 1px solid var(--line); border-radius: 8px; padding: 14px 16px; overflow-x: auto; }}
+pre code {{ background: none; padding: 0; }}
+table {{ border-collapse: collapse; margin: 1em 0; }}
+th, td {{ border: 1px solid var(--line); padding: 6px 12px; text-align: left; }}
+th {{ background: var(--code-bg); }}
+blockquote {{ border-left: 3px solid var(--line); margin-left: 0; padding-left: 16px; color: var(--muted); }}
+</style>
+</head>
+<body>
+<div class="layout">
+<nav>
+<h1><a href="index.html" style="color:inherit">unionml-tpu</a></h1>
+{nav}
+</nav>
+<main>
+{body}
+</main>
+</div>
+</body>
+</html>
+"""
+
+def _link_target(url: str) -> str:
+    return re.sub(r"\.md(?=$|#)", ".html", url)
+
+
+_INLINE_RULES = [
+    (re.compile(r"`([^`]+)`"), lambda m: f"<code>{html.escape(m.group(1))}</code>"),
+    (re.compile(r"\*\*([^*]+)\*\*"), lambda m: f"<strong>{m.group(1)}</strong>"),
+    (re.compile(r"(?<![\w*])\*([^*\s][^*]*)\*(?![\w*])"), lambda m: f"<em>{m.group(1)}</em>"),
+    (
+        re.compile(r"\[([^\]]+)\]\(([^)\s]+)\)"),
+        lambda m: f'<a href="{_link_target(m.group(2))}">{m.group(1)}</a>',
+    ),
+]
+
+
+def _inline(text: str) -> str:
+    # protect code spans from emphasis/link rewriting by rendering them first
+    out = []
+    pos = 0
+    for match in re.finditer(r"`[^`]+`", text):
+        out.append(_inline_nocode(text[pos : match.start()]))
+        out.append(f"<code>{html.escape(match.group(0)[1:-1])}</code>")
+        pos = match.end()
+    out.append(_inline_nocode(text[pos:]))
+    return "".join(out)
+
+
+def _inline_nocode(text: str) -> str:
+    text = html.escape(text, quote=False)
+    for pattern, repl in _INLINE_RULES[1:]:
+        text = pattern.sub(repl, text)
+    return text
+
+
+def render_markdown(source: str) -> str:
+    """Markdown -> HTML body (headings, fences, lists, tables, quotes, paragraphs)."""
+    lines = source.splitlines()
+    out: List[str] = []
+    i = 0
+    paragraph: List[str] = []
+    list_stack: List[str] = []
+
+    def flush_paragraph() -> None:
+        if paragraph:
+            out.append(f"<p>{_inline(' '.join(paragraph))}</p>")
+            paragraph.clear()
+
+    def close_lists() -> None:
+        while list_stack:
+            out.append(f"</{list_stack.pop()}>")
+
+    while i < len(lines):
+        line = lines[i]
+        stripped = line.strip()
+
+        if stripped.startswith("```"):
+            flush_paragraph()
+            close_lists()
+            language = stripped[3:].strip()
+            block: List[str] = []
+            i += 1
+            while i < len(lines) and not lines[i].strip().startswith("```"):
+                block.append(lines[i])
+                i += 1
+            cls = f' class="language-{language}"' if language else ""
+            out.append(f"<pre><code{cls}>{html.escape(chr(10).join(block))}</code></pre>")
+            i += 1
+            continue
+
+        heading = re.match(r"^(#{1,6})\s+(.*)$", stripped)
+        if heading:
+            flush_paragraph()
+            close_lists()
+            level = len(heading.group(1))
+            out.append(f"<h{level}>{_inline(heading.group(2))}</h{level}>")
+            i += 1
+            continue
+
+        if stripped.startswith("|") and i + 1 < len(lines) and re.match(r"^\|[\s:|-]+\|$", lines[i + 1].strip()):
+            flush_paragraph()
+            close_lists()
+            header_cells = [c.strip() for c in stripped.strip("|").split("|")]
+            out.append("<table><thead><tr>" + "".join(f"<th>{_inline(c)}</th>" for c in header_cells) + "</tr></thead><tbody>")
+            i += 2
+            while i < len(lines) and lines[i].strip().startswith("|"):
+                cells = [c.strip() for c in lines[i].strip().strip("|").split("|")]
+                out.append("<tr>" + "".join(f"<td>{_inline(c)}</td>" for c in cells) + "</tr>")
+                i += 1
+            out.append("</tbody></table>")
+            continue
+
+        bullet = re.match(r"^\s*[-*]\s+(.*)$", line)
+        numbered = re.match(r"^\s*\d+\.\s+(.*)$", line)
+        if bullet or numbered:
+            flush_paragraph()
+            tag = "ul" if bullet else "ol"
+            if not list_stack or list_stack[-1] != tag:
+                close_lists()
+                out.append(f"<{tag}>")
+                list_stack.append(tag)
+            item = (bullet or numbered).group(1)
+            # continuation lines (indented, non-list) belong to this item
+            parts = [item]
+            while (
+                i + 1 < len(lines)
+                and lines[i + 1].startswith("  ")
+                and not re.match(r"^\s*([-*]|\d+\.)\s", lines[i + 1])
+                and lines[i + 1].strip()
+            ):
+                parts.append(lines[i + 1].strip())
+                i += 1
+            out.append(f"<li>{_inline(' '.join(parts))}</li>")
+            i += 1
+            continue
+
+        if stripped.startswith(">"):
+            flush_paragraph()
+            close_lists()
+            quote: List[str] = []
+            while i < len(lines) and lines[i].strip().startswith(">"):
+                quote.append(lines[i].strip().lstrip("> "))
+                i += 1
+            out.append(f"<blockquote><p>{_inline(' '.join(quote))}</p></blockquote>")
+            continue
+
+        if not stripped:
+            flush_paragraph()
+            close_lists()
+            i += 1
+            continue
+
+        paragraph.append(stripped)
+        i += 1
+
+    flush_paragraph()
+    close_lists()
+    return "\n".join(out)
+
+
+def _page_title(source: str, fallback: str) -> str:
+    match = re.search(r"^#\s+(.+)$", source, re.MULTILINE)
+    return match.group(1).strip() if match else fallback
+
+
+def build_site(out_dir: Path) -> List[Path]:
+    pages = sorted(DOCS_DIR.glob("*.md")) + sorted((DOCS_DIR / "tutorials").glob("*.md"))
+    nav_order = ["index", "quickstart", "tpu-training", "parallelism", "serving", "remote", "benchmarks"]
+    pages.sort(key=lambda p: nav_order.index(p.stem) if p.stem in nav_order else len(nav_order))
+
+    nav_links = []
+    for page in pages:
+        name = page.stem
+        label = _page_title(page.read_text(), name)
+        href = f"{name}.html"
+        nav_links.append((href, label))
+
+    out_dir.mkdir(parents=True, exist_ok=True)
+    written = []
+    for page in pages:
+        source = page.read_text()
+        body = render_markdown(source)
+        nav = "\n".join(
+            f'<a href="{href}"{" class=\"active\"" if href == page.stem + ".html" else ""}>{html.escape(label)}</a>'
+            for href, label in nav_links
+        )
+        target = out_dir / f"{page.stem}.html"
+        target.write_text(_PAGE.format(title=html.escape(_page_title(source, page.stem)), nav=nav, body=body))
+        written.append(target)
+    return written
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--out", default=str(DOCS_DIR / "_site"))
+    args = parser.parse_args()
+    for page in build_site(Path(args.out)):
+        print(page)

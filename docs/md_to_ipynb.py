@@ -1,0 +1,70 @@
+"""Convert a tutorial markdown file into a runnable Jupyter notebook.
+
+The analog of the reference's ``scripts/myst_to_ipynb.py`` (myst/jupytext ->
+Colab notebooks with deterministic cell ids, :1-40): prose becomes markdown
+cells, ``python`` fences become code cells, every other fence stays markdown.
+Cell ids are deterministic (sha256 of path + index) so regenerating an unchanged
+tutorial produces a byte-identical notebook — diffs stay reviewable. Usage::
+
+    python docs/md_to_ipynb.py docs/tutorials/quickstart_tutorial.md [-o out.ipynb]
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import re
+from pathlib import Path
+from typing import Any, Dict, List
+
+
+def _cell_id(seed: str, index: int) -> str:
+    return hashlib.sha256(f"{seed}:{index}".encode()).hexdigest()[:12]
+
+
+def markdown_to_cells(source: str, seed: str) -> List[Dict[str, Any]]:
+    cells: List[Dict[str, Any]] = []
+    chunks = re.split(r"(```[^\n]*\n.*?\n```)", source, flags=re.DOTALL)
+    for chunk in chunks:
+        chunk = chunk.strip("\n")
+        if not chunk.strip():
+            continue
+        fence = re.match(r"```([^\n]*)\n(.*)\n```$", chunk, flags=re.DOTALL)
+        if fence and fence.group(1).strip() == "python":
+            cells.append(
+                {
+                    "cell_type": "code",
+                    "execution_count": None,
+                    "metadata": {},
+                    "outputs": [],
+                    "source": fence.group(2).splitlines(keepends=True),
+                }
+            )
+        else:
+            cells.append({"cell_type": "markdown", "metadata": {}, "source": chunk.splitlines(keepends=True)})
+    for index, cell in enumerate(cells):
+        cell["id"] = _cell_id(seed, index)
+    return cells
+
+
+def convert(path: Path) -> Dict[str, Any]:
+    return {
+        "nbformat": 4,
+        "nbformat_minor": 5,
+        "metadata": {
+            "kernelspec": {"display_name": "Python 3", "language": "python", "name": "python3"},
+            "language_info": {"name": "python"},
+        },
+        "cells": markdown_to_cells(path.read_text(), seed=path.name),
+    }
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("source", type=Path)
+    parser.add_argument("-o", "--out", type=Path, default=None)
+    args = parser.parse_args()
+    out = args.out or args.source.with_suffix(".ipynb")
+    out.write_text(json.dumps(convert(args.source), indent=1) + "\n")
+    print(out)

@@ -179,3 +179,156 @@ def test_stale_heartbeat_marks_lost_and_resubmits(remote_app, monkeypatch):
     assert detached.attempt >= 1
     spec = _json.loads((exec_dir / "spec.json").read_text())
     assert spec["model_name"] == model.name
+
+
+# ---------------------------------------------------------------- launcher seam
+
+
+def test_slice_hosts_topology_table():
+    from unionml_tpu.launcher import slice_hosts
+
+    assert slice_hosts("v5e-8") == 1    # one v5e host carries 8 chips
+    assert slice_hosts("v5e-16") == 2
+    assert slice_hosts("v5litepod-32") == 4
+    assert slice_hosts("v4-8") == 1     # v4 counts TensorCores: 8 cores = 4 chips
+    assert slice_hosts("v4-32") == 4
+    assert slice_hosts("v5p-16") == 2
+    with pytest.raises(ValueError, match="unknown TPU generation"):
+        slice_hosts("h100-8")
+    with pytest.raises(ValueError, match="cannot parse"):
+        slice_hosts("v5e")
+
+
+def test_tpu_vm_launcher_provisions_through_interface(tmp_path, monkeypatch):
+    """accelerator="v5e-8" provisions a slice through the Launcher interface: the
+    injected provisioner sees the accelerator, the injected transport runs one
+    worker per slice host — here executing the job_runner command locally, so the
+    execution really trains end-to-end through the TPUVMLauncher path."""
+    from unionml_tpu.launcher import TPUVMLauncher
+
+    app_dir = tmp_path / "appsrc"
+    app_dir.mkdir()
+    (app_dir / "remote_app.py").write_text(APP_SOURCE)
+    monkeypatch.syspath_prepend(str(app_dir))
+    monkeypatch.chdir(app_dir)
+    import importlib
+
+    import remote_app
+
+    importlib.reload(remote_app)
+    model = remote_app.model
+
+    provisioned = []
+    transported = []
+
+    def fake_provision(accelerator, execution_path):
+        provisioned.append((accelerator, execution_path))
+        return f"fake-node-{len(provisioned)}"
+
+    def fake_transport(node, worker, command, env, log_path, log_mode):
+        transported.append((node, worker))
+        with open(log_path, log_mode) as log_file:
+            return subprocess.Popen(command, env=env, stdout=log_file, stderr=subprocess.STDOUT)
+
+    launcher = TPUVMLauncher(provisioner=fake_provision, transport=fake_transport)
+    model.remote(
+        backend_store=str(tmp_path / "store"), accelerator="v5e-8", launcher=launcher
+    )
+    model.remote_deploy(app_version="launcher-v1")
+    artifact = model.remote_train(hyperparameters={"max_iter": 200}, wait=True)
+
+    assert provisioned == [("v5e-8", provisioned[0][1])]
+    assert "launcher-v1" not in provisioned[0][1]  # provisioner got the EXECUTION path
+    assert transported == [("fake-node-1", 0)]  # v5e-8 = one host = one worker
+    assert artifact.metrics["train"] > 0.8
+
+
+def test_tpu_vm_launcher_sizes_workers_to_slice(tmp_path, monkeypatch):
+    """With accelerator="v5e-16" (2 hosts) and default n_workers, the backend sizes
+    the worker set to the slice topology and wires the jax.distributed env."""
+    from unionml_tpu.launcher import LaunchSpec, TPUVMLauncher
+    from unionml_tpu.remote import Backend, BackendConfig
+
+    specs = []
+
+    class Recorder(TPUVMLauncher):
+        def launch(self, spec: LaunchSpec):
+            specs.append(spec)
+
+            class Done:
+                returncode = 0
+
+                def poll(self):
+                    return 0
+
+                def kill(self):
+                    pass
+
+                def wait(self):
+                    return 0
+
+            return [Done() for _ in spec.worker_envs]
+
+    app_dir = tmp_path / "appsrc"
+    app_dir.mkdir()
+    (app_dir / "remote_app.py").write_text(APP_SOURCE)
+    monkeypatch.syspath_prepend(str(app_dir))
+    monkeypatch.chdir(app_dir)
+    import importlib
+
+    import remote_app
+
+    importlib.reload(remote_app)
+    model = remote_app.model
+    model.remote(
+        backend_store=str(tmp_path / "store"), accelerator="v5e-16", launcher=Recorder()
+    )
+    model.remote_deploy(app_version="sizing-v1")
+    model.remote_train(wait=False)
+
+    [spec] = specs
+    assert spec.accelerator == "v5e-16"
+    assert spec.n_workers == 2
+    envs = spec.worker_envs
+    assert envs[0]["UNIONML_TPU_PROCESS_ID"] == "0" and envs[1]["UNIONML_TPU_PROCESS_ID"] == "1"
+    assert envs[0]["UNIONML_TPU_NUM_PROCESSES"] == "2"
+    assert envs[0]["UNIONML_TPU_COORDINATOR"] == envs[1]["UNIONML_TPU_COORDINATOR"]
+
+
+def test_tpu_vm_launcher_reuses_node_on_resubmit(tmp_path):
+    """The watchdog's resubmit path relaunches the same execution; the launcher
+    must reuse the provisioned slice, not try to create the node again."""
+    from unionml_tpu.launcher import LaunchSpec, TPUVMLauncher
+
+    provisions = []
+
+    class Handle:
+        returncode = 0
+
+        def poll(self):
+            return 0
+
+        def kill(self):
+            pass
+
+        def wait(self):
+            return 0
+
+    launcher = TPUVMLauncher(
+        provisioner=lambda acc, path: (provisions.append(acc), f"node-{len(provisions)}")[1],
+        transport=lambda *a, **k: Handle(),
+    )
+    log = tmp_path / "logs.txt"
+    spec = LaunchSpec(
+        command=["echo", "hi"],
+        worker_envs=[{}],
+        log_paths=[log],
+        log_mode="w",
+        execution_path=str(tmp_path),
+        accelerator="v5e-8",
+    )
+    launcher.launch(spec)
+    launcher.launch(spec)  # resubmit
+    assert provisions == ["v5e-8"]  # provisioned exactly once
+    launcher.teardown(str(tmp_path))
+    assert launcher._nodes == {}

@@ -9,6 +9,7 @@ layer schedules app bundles onto TPU VM slices.
 """
 
 from unionml_tpu.dataset import Dataset  # noqa: F401
+from unionml_tpu.launcher import Launcher, LocalProcessLauncher, TPUVMLauncher  # noqa: F401
 from unionml_tpu.model import BaseHyperparameters, Model, ModelArtifact  # noqa: F401
 from unionml_tpu.parallel.mesh import MeshSpec  # noqa: F401
 from unionml_tpu.parallel.sharding import PartitionRules  # noqa: F401
@@ -22,11 +23,14 @@ __all__ = [
     "BaseHyperparameters",
     "Dataset",
     "ExecutionGraph",
+    "Launcher",
+    "LocalProcessLauncher",
     "MeshSpec",
     "Model",
     "ModelArtifact",
     "PartitionRules",
     "Stage",
+    "TPUVMLauncher",
     "TrainerConfig",
     "make_train_step",
     "stage",

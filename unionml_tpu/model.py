@@ -603,14 +603,19 @@ class Model:
         backend_store: Optional[str] = None,
         accelerator: Optional[str] = None,
         n_workers: int = 1,
+        launcher: Optional[Any] = None,
     ) -> None:
         """Configure the remote backend (reference model.py:625-654 keeps docker/Flyte
         knobs; our substrate adds ``backend_store`` — the job/artifact store root —
-        ``accelerator`` — the TPU slice topology to schedule training onto — and
+        ``accelerator`` — the TPU slice topology to schedule training onto —
         ``n_workers`` — worker processes per execution, which join one
-        ``jax.distributed`` runtime (the multi-host slice analog)."""
+        ``jax.distributed`` runtime (the multi-host slice analog) — and
+        ``launcher`` — a :class:`unionml_tpu.launcher.Launcher` deciding where the
+        workers run (default: local subprocesses; pass a
+        :class:`~unionml_tpu.launcher.TPUVMLauncher` to provision real slices)."""
         from unionml_tpu.remote import BackendConfig
 
+        self._launcher = launcher
         self._backend_config = BackendConfig(
             registry=registry,
             image_name=image_name,
@@ -632,7 +637,7 @@ class Model:
         from unionml_tpu.remote import Backend, BackendConfig
 
         config = self._backend_config or BackendConfig()
-        self.__backend__ = Backend(config)
+        self.__backend__ = Backend(config, launcher=getattr(self, "_launcher", None))
         return self.__backend__
 
     def remote_deploy(

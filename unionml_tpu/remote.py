@@ -141,11 +141,22 @@ _BUNDLE_IGNORE = shutil.ignore_patterns(
 
 
 class Backend:
-    """Filesystem-store backend with a local-subprocess TPU worker launcher."""
+    """Filesystem-store backend with a pluggable worker launcher.
 
-    def __init__(self, config: BackendConfig):
+    The launcher seam (:mod:`unionml_tpu.launcher`) is where a cluster control
+    plane plugs in: the backend builds per-worker commands + env, the launcher
+    decides where they run. Default: local subprocesses (``accelerator`` is then
+    recorded in the manifest but does not provision hardware). Pass a
+    :class:`~unionml_tpu.launcher.TPUVMLauncher` to provision real slices — with
+    a non-local launcher the worker set is sized to the accelerator's host count.
+    """
+
+    def __init__(self, config: BackendConfig, launcher: Optional[Any] = None):
+        from unionml_tpu.launcher import LocalProcessLauncher
+
         self.config = config
         self.root = config.store_path()
+        self.launcher = launcher if launcher is not None else LocalProcessLauncher()
 
     # ------------------------------------------------------------------ deploy
 
@@ -234,15 +245,18 @@ class Backend:
         return Execution(id=exec_id, workflow=workflow, path=str(exec_dir))
 
     def _launch(self, model_name: str, execution: Execution, app_version: str) -> None:
-        """Spawn the worker process(es) for an execution.
+        """Build the per-worker commands/env for an execution and hand them to the
+        configured launcher.
 
-        With ``n_workers > 1`` this is the local analog of a multi-host TPU slice:
-        every worker runs the same ``job_runner`` command with
-        ``UNIONML_TPU_COORDINATOR`` / ``UNIONML_TPU_NUM_PROCESSES`` /
+        With ``n_workers > 1`` every worker runs the same ``job_runner`` command
+        with ``UNIONML_TPU_COORDINATOR`` / ``UNIONML_TPU_NUM_PROCESSES`` /
         ``UNIONML_TPU_PROCESS_ID`` set and joins one ``jax.distributed`` runtime,
-        so pjit-compiled stages span the global mesh. A cluster scheduler plugs in
-        at exactly this seam by launching the same command once per host.
+        so pjit-compiled stages span the global mesh — locally that is the
+        multi-host slice analog; through :class:`~unionml_tpu.launcher.TPUVMLauncher`
+        it is the real thing, one worker per slice host.
         """
+        from unionml_tpu.launcher import LaunchSpec, slice_hosts
+
         bundle = self._app_dir(model_name, app_version) / "bundle"
         framework_root = Path(__file__).resolve().parent.parent  # unionml_tpu's parent dir
         base_env = dict(os.environ)
@@ -252,9 +266,11 @@ class Backend:
         attempt_file = Path(execution.path) / "attempt"
         attempt = int(attempt_file.read_text().strip()) + 1 if attempt_file.exists() else 0
         attempt_file.write_text(str(attempt))
-        mode = "w" if attempt == 0 else "a"
 
         n_workers = max(1, self.config.n_workers)
+        if n_workers == 1 and self.config.accelerator and not _is_local_launcher(self.launcher):
+            # a non-local launcher sizes the worker set to the slice topology
+            n_workers = slice_hosts(self.config.accelerator)
         if n_workers > 1 and "UNIONML_TPU_COORDINATOR" not in base_env:
             import socket
 
@@ -265,21 +281,23 @@ class Backend:
         if n_workers > 1:
             base_env["UNIONML_TPU_NUM_PROCESSES"] = str(n_workers)
 
-        execution.procs = []
+        worker_envs, log_paths = [], []
         for worker in range(n_workers):
             env = dict(base_env)
             if n_workers > 1:
                 env["UNIONML_TPU_PROCESS_ID"] = str(worker)
-            log_name = "logs.txt" if worker == 0 else f"logs.{worker}.txt"
-            with open(Path(execution.path) / log_name, mode) as log_file:
-                execution.procs.append(
-                    subprocess.Popen(
-                        [sys.executable, "-m", "unionml_tpu.job_runner", execution.path],
-                        env=env,
-                        stdout=log_file,
-                        stderr=subprocess.STDOUT,
-                    )
-                )
+            worker_envs.append(env)
+            log_paths.append(Path(execution.path) / ("logs.txt" if worker == 0 else f"logs.{worker}.txt"))
+
+        spec = LaunchSpec(
+            command=[sys.executable, "-m", "unionml_tpu.job_runner", execution.path],
+            worker_envs=worker_envs,
+            log_paths=log_paths,
+            log_mode="w" if attempt == 0 else "a",
+            execution_path=execution.path,
+            accelerator=self.config.accelerator,
+        )
+        execution.procs = list(self.launcher.launch(spec))
         execution.proc = execution.procs[0]
 
     def resubmit(self, execution: Execution) -> Execution:
@@ -493,6 +511,12 @@ class Backend:
 
     def list_model_versions(self, model: Any, app_version: Optional[str] = None, limit: int = 10) -> List[str]:
         return [e.id for e in self._successful_train_executions(model)[:limit]]
+
+
+def _is_local_launcher(launcher: Any) -> bool:
+    from unionml_tpu.launcher import LocalProcessLauncher
+
+    return isinstance(launcher, LocalProcessLauncher)
 
 
 def _infer_app_module(model: Any) -> str:

@@ -44,8 +44,11 @@ class HTTPServer:
     def route(self, method: str, path: str, handler: Handler) -> None:
         self._routes[(method.upper(), path)] = handler
 
-    async def _read_request(self, reader: asyncio.StreamReader) -> Optional[Tuple[str, str, bytes, bool]]:
-        request_line = await reader.readline()
+    async def _read_request(
+        self, reader: asyncio.StreamReader, request_line: Optional[bytes] = None
+    ) -> Optional[Tuple[str, str, bytes, bool]]:
+        if request_line is None:
+            request_line = await reader.readline()
         if not request_line:
             return None
         try:
@@ -106,6 +109,9 @@ class HTTPServer:
         metrics_route = f"{method} {path}"
         if handler is None:
             if any(p == path for (_, p) in self._routes):
+                # bound the label set: arbitrary method tokens must not mint routes
+                if method not in ("GET", "POST", "PUT", "DELETE", "HEAD", "OPTIONS", "PATCH"):
+                    metrics_route = "<unmatched>"
                 result = 405, {"detail": f"method {method} not allowed for {path}"}, "application/json"
             else:
                 # unmatched paths share one metrics label — per-path labels would let
@@ -128,9 +134,14 @@ class HTTPServer:
         try:
             while True:
                 try:
-                    request = await asyncio.wait_for(self._read_request(reader), KEEPALIVE_IDLE_S)
+                    # idle timeout applies only to waiting for the NEXT request line;
+                    # an in-flight slow body read is never cancelled mid-request
+                    request_line = await asyncio.wait_for(reader.readline(), KEEPALIVE_IDLE_S)
                 except asyncio.TimeoutError:
                     break  # idle keep-alive connection: close quietly
+                if not request_line:
+                    break
+                request = await self._read_request(reader, request_line)
                 if request is None:
                     break
                 method, path, body, keep_alive = request
