@@ -143,3 +143,65 @@ def test_serve_requires_existing_model_path(cli_project):
     result = CliRunner().invoke(app, ["serve", "cli_app:model", "--model-path", "/does/not/exist"])
     assert result.exit_code != 0
     assert "does not exist" in result.output
+
+
+def test_serve_workers_flag_boots_multiprocess_server(cli_project, tmp_path):
+    """--workers 2: the port is shared via SO_REUSEPORT and requests succeed
+    (reference serve clones uvicorn's full CLI incl. --workers, cli.py:172-205)."""
+    import json as _json
+    import socket
+    import time
+    import urllib.request
+
+    import cli_app
+
+    cli_app.model.train(hyperparameters={"max_iter": 500})
+    model_file = cli_project / "model.joblib"
+    cli_app.model.save(str(model_file))
+
+    with socket.socket() as probe:
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+
+    env = dict(os.environ)
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "unionml_tpu.cli", "serve", "cli_app:model",
+            "--model-path", str(model_file), "--port", str(port),
+            "--workers", "2", "--log-level", "info",
+        ],
+        cwd=cli_project,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+    )
+    try:
+        base = f"http://127.0.0.1:{port}"
+        for _ in range(150):
+            try:
+                with urllib.request.urlopen(base + "/health", timeout=1):
+                    break
+            except Exception:
+                time.sleep(0.2)
+        else:
+            raise AssertionError("server did not come up")
+        body = _json.dumps({"features": [{"x0": 1.0, "x1": 2.0}]}).encode()
+        for _ in range(4):  # several requests; kernel may spread them over workers
+            req = urllib.request.Request(
+                base + "/predict", data=body, headers={"Content-Type": "application/json"}
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert resp.status == 200
+                assert len(_json.loads(resp.read())) == 1
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_app_source_files_snapshot(cli_project):
+    from unionml_tpu.cli import _app_source_files
+
+    files = _app_source_files("cli_app:model")
+    assert any(p.name == "cli_app.py" for p in files)
+    (cli_project / "cli_app.py").write_text((cli_project / "cli_app.py").read_text() + "\n# touched\n")
+    assert _app_source_files("cli_app:model") != files

@@ -187,6 +187,14 @@ def fetch_model(
 @click.option("--remote", is_flag=True, default=False, help="load the model from the remote backend registry")
 @click.option("--app-version", default=None, help="app version for --remote model loading")
 @click.option("--model-version", default="latest", show_default=True, help="model version for --remote loading")
+@click.option("--workers", default=1, show_default=True, type=int, help="server processes sharing the port (SO_REUSEPORT)")
+@click.option("--reload", "reload_", is_flag=True, default=False, help="restart the server when app source changes (development)")
+@click.option(
+    "--log-level",
+    default=None,
+    type=click.Choice(["debug", "info", "warning", "error"]),
+    help="unionml-tpu logger level",
+)
 def serve(
     app_ref: str,
     model_path: Optional[Path],
@@ -195,13 +203,26 @@ def serve(
     remote: bool,
     app_version: Optional[str],
     model_version: str,
+    workers: int,
+    reload_: bool,
+    log_level: Optional[str],
 ) -> None:
     """Start the HTTP prediction service (reference cli.py:172-205).
 
-    The reference clones uvicorn's CLI and injects ``--model-path`` via the
-    ``UNIONML_MODEL_PATH`` env var, refusing to run when the variable is pre-set
-    (cli.py:187-202); identical semantics here, on our own server.
+    The reference clones uvicorn's CLI (workers/reload/log config included) and
+    injects ``--model-path`` via the ``UNIONML_MODEL_PATH`` env var, refusing to
+    run when the variable is pre-set (cli.py:187-202); identical semantics here,
+    on our own server. ``--workers N`` forks N processes sharing the port via
+    SO_REUSEPORT — right for host-side (sklearn) predictors; a TPU predictor
+    should stay at 1 worker and scale through micro-batching, since the chip is
+    a single shared resource. ``--reload`` watches the app module's directory
+    and restarts on change.
     """
+    if log_level is not None:
+        from unionml_tpu._logging import logger as package_logger
+
+        package_logger.setLevel(log_level.upper())
+        os.environ["UNIONML_TPU_LOGLEVEL"] = log_level.upper()  # reload/fork children inherit it
     if model_path is not None:
         if os.getenv(MODEL_PATH_ENV_VAR) is not None:
             raise click.ClickException(
@@ -212,6 +233,10 @@ def serve(
             raise click.ClickException(f"model path {model_path} does not exist")
         os.environ[MODEL_PATH_ENV_VAR] = str(model_path)
 
+    if reload_:
+        _serve_with_reload(app_ref)
+        return
+
     target = _locate_model(app_ref)
     from unionml_tpu.serving import ServingApp
 
@@ -219,7 +244,122 @@ def serve(
         serving = target
     else:
         serving = target.serve(remote=remote, app_version=app_version, model_version=model_version)
-    serving.run(host=host, port=port)
+
+    if workers > 1:
+        import signal
+
+        # load the artifact once, then fork: children inherit it copy-on-write and
+        # the kernel balances accepted connections across the shared port
+        serving.startup()
+        children: "list[int]" = []
+        for _ in range(workers - 1):
+            pid = os.fork()
+            if pid == 0:
+                serving.run(host=host, port=port, reuse_port=True)
+                os._exit(0)
+            children.append(pid)
+
+        def stop_children(signum=None, frame=None):
+            # killing the parent must not orphan workers holding the port
+            for child_pid in children:
+                try:
+                    os.kill(child_pid, signal.SIGTERM)
+                except ProcessLookupError:
+                    pass
+            for child_pid in children:
+                try:
+                    os.waitpid(child_pid, 0)
+                except ChildProcessError:
+                    pass
+            if signum is not None:
+                raise SystemExit(0)
+
+        signal.signal(signal.SIGTERM, stop_children)
+        try:
+            serving.run(host=host, port=port, reuse_port=True)
+        finally:
+            stop_children()
+    else:
+        serving.run(host=host, port=port)
+
+
+def _app_source_files(app_ref: str) -> "dict[Path, float]":
+    """Snapshot mtimes of every .py under the app module's directory."""
+    module_name = app_ref.split(":", 1)[0]
+    import importlib.util
+
+    spec = importlib.util.find_spec(module_name)
+    root = Path(spec.origin).parent if spec and spec.origin else Path.cwd()
+    return {p: p.stat().st_mtime for p in root.rglob("*.py") if ".git" not in p.parts}
+
+
+def _serve_with_reload(app_ref: str, poll_interval: float = 0.5) -> None:
+    """Run the server as a child process; restart it when app source changes."""
+    import signal
+    import subprocess
+    import time
+
+    # re-exec through the interpreter: argv[0] may be a module path (python -m)
+    # that is not itself executable. --model-path is dropped from the child argv:
+    # the parent already validated it and exported UNIONML_MODEL_PATH, which
+    # the child inherits (passing both would trip the env-var guard).
+    argv = [sys.executable]
+    skip_next = False
+    for arg in sys.argv:
+        if skip_next:
+            skip_next = False
+            continue
+        if arg == "--reload":
+            continue
+        if arg == "--model-path":
+            skip_next = True
+            continue
+        if arg.startswith("--model-path="):
+            continue
+        argv.append(arg)
+    current: "list[Any]" = [None]
+
+    def forward_term(signum, frame):  # terminating the watcher must stop the server
+        if current[0] is not None and current[0].poll() is None:
+            current[0].terminate()
+        raise SystemExit(0)
+
+    signal.signal(signal.SIGTERM, forward_term)
+
+    def stop_child(child) -> None:
+        child.send_signal(signal.SIGTERM)
+        try:
+            child.wait(timeout=10)
+        except subprocess.TimeoutExpired:  # slow drain / ignored SIGTERM
+            child.kill()
+            child.wait()
+
+    while True:
+        snapshot = _app_source_files(app_ref)
+        child = subprocess.Popen(argv, env=os.environ)
+        current[0] = child
+        try:
+            while child.poll() is None:
+                time.sleep(poll_interval)
+                if _app_source_files(app_ref) != snapshot:
+                    click.echo("source change detected; restarting server", err=True)
+                    stop_child(child)
+                    break
+            else:
+                if child.returncode == 0:
+                    sys.exit(0)  # clean self-exit
+                # crashed (e.g. a transient syntax error was saved): keep watching
+                # and respawn on the NEXT source change, like uvicorn's reloader
+                click.echo(
+                    f"server exited with code {child.returncode}; waiting for a source change",
+                    err=True,
+                )
+                while _app_source_files(app_ref) == snapshot:
+                    time.sleep(poll_interval)
+                click.echo("source change detected; restarting server", err=True)
+        except KeyboardInterrupt:  # pragma: no cover
+            stop_child(child)
+            raise
 
 
 def main() -> None:  # console-script entry point (reference setup.py:34)

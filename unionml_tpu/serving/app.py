@@ -217,10 +217,10 @@ class ServingApp:
 
     # ------------------------------------------------------------------ entry points
 
-    def run(self, host: str = "127.0.0.1", port: int = 8000) -> None:
+    def run(self, host: str = "127.0.0.1", port: int = 8000, *, reuse_port: bool = False) -> None:
         """Blocking server loop (used by the ``serve`` CLI command)."""
         self.startup()
-        self.server.run(host, port)
+        self.server.run(host, port, reuse_port=reuse_port)
 
     async def dispatch(self, method: str, path: str, body: bytes = b""):
         """In-process request dispatch — the test-client surface."""

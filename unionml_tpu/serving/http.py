@@ -163,15 +163,17 @@ class HTTPServer:
             except Exception:
                 pass
 
-    async def serve(self, host: str = "127.0.0.1", port: int = 8000) -> None:
-        self._server = await asyncio.start_server(self._on_connection, host, port)
+    async def serve(self, host: str = "127.0.0.1", port: int = 8000, *, reuse_port: bool = False) -> None:
+        # reuse_port lets N worker processes share one listening port (the kernel
+        # load-balances accepts) — the `serve --workers N` multi-process mode
+        self._server = await asyncio.start_server(self._on_connection, host, port, reuse_port=reuse_port or None)
         logger.info(f"serving on http://{host}:{port}")
         async with self._server:
             await self._server.serve_forever()
 
-    def run(self, host: str = "127.0.0.1", port: int = 8000) -> None:
+    def run(self, host: str = "127.0.0.1", port: int = 8000, *, reuse_port: bool = False) -> None:
         try:
-            asyncio.run(self.serve(host, port))
+            asyncio.run(self.serve(host, port, reuse_port=reuse_port))
         except KeyboardInterrupt:  # pragma: no cover
             logger.info("server stopped")
 
