@@ -82,15 +82,24 @@ APP = textwrap.dedent(
 )
 
 
-def _run_two_worker_slice(tmp_path, monkeypatch, trainer_config_extra: str, app_version: str):
+def _run_worker_slice(
+    tmp_path,
+    monkeypatch,
+    trainer_config_extra: str,
+    app_version: str,
+    *,
+    n_workers: int = 2,
+    devices_per_worker: int = 4,
+    wait_kwargs: "dict | None" = None,
+):
     app_dir = tmp_path / "appsrc"
     app_dir.mkdir()
     (app_dir / "mh_app.py").write_text(APP.replace("{trainer_config_extra}", trainer_config_extra))
     monkeypatch.syspath_prepend(str(app_dir))
     monkeypatch.chdir(app_dir)
-    # each worker emulates a 4-device host; the slice mesh is 2 x 4 = 8 devices
+    # each worker emulates a host with devices_per_worker CPU devices
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")
-    monkeypatch.setenv("XLA_FLAGS", "--xla_force_host_platform_device_count=4")
+    monkeypatch.setenv("XLA_FLAGS", f"--xla_force_host_platform_device_count={devices_per_worker}")
 
     import importlib
 
@@ -98,14 +107,18 @@ def _run_two_worker_slice(tmp_path, monkeypatch, trainer_config_extra: str, app_
 
     importlib.reload(mh_app)
     model = mh_app.model
-    model.remote(backend_store=str(tmp_path / "store"), n_workers=2)
+    model.remote(backend_store=str(tmp_path / "store"), n_workers=n_workers)
 
     model.remote_deploy(app_version=app_version)
     execution = model.remote_train(wait=False, hyperparameters={"learning_rate": 0.05})
-    assert len(execution.procs) == 2
-    model._backend.wait(execution, timeout=600)
+    assert len(execution.procs) == n_workers
+    model._backend.wait(execution, timeout=600, **(wait_kwargs or {}))
     assert execution.status == "SUCCEEDED", (Path(execution.path) / "logs.txt").read_text()[-2000:]
     return model, execution
+
+
+def _run_two_worker_slice(tmp_path, monkeypatch, trainer_config_extra: str, app_version: str):
+    return _run_worker_slice(tmp_path, monkeypatch, trainer_config_extra, app_version)
 
 
 def test_two_worker_slice_trains_over_global_mesh(tmp_path, monkeypatch):
@@ -133,5 +146,32 @@ def test_two_worker_device_data_steps_per_call(tmp_path, monkeypatch):
     log0 = (Path(execution.path) / "logs.txt").read_text()
     assert "device_data over 2 processes" in log0
 
+    model.remote_load(execution)
+    assert model.artifact.metrics["train"] > 0.9, model.artifact.metrics
+
+
+def test_four_worker_slice_trains_over_global_mesh(tmp_path, monkeypatch):
+    """Beyond 2 workers: a 4-process x 2-device slice forms one 8-device runtime."""
+    model, execution = _run_worker_slice(
+        tmp_path, monkeypatch, "", "mh-4w-v1", n_workers=4, devices_per_worker=2
+    )
+    model.remote_load(execution)
+    assert model.artifact.metrics["train"] > 0.9, model.artifact.metrics
+
+
+def test_multi_worker_single_host_loss_recovers(tmp_path, monkeypatch):
+    """Losing ONE worker of a 2-worker slice mid-run: the watchdog detects the dead
+    process, reaps the peer blocked in jax.distributed setup/collectives, and the
+    resubmitted attempt (with a fresh coordinator) succeeds."""
+    monkeypatch.setenv("UNIONML_TPU_FAULT_INJECT", "1")          # attempt 0 dies...
+    monkeypatch.setenv("UNIONML_TPU_FAULT_INJECT_PROCESS", "1")  # ...worker 1 only
+    model, execution = _run_worker_slice(
+        tmp_path,
+        monkeypatch,
+        "",
+        "mh-fault-v1",
+        wait_kwargs={"retries": 1},
+    )
+    assert execution.attempt == 1  # exactly one recovery
     model.remote_load(execution)
     assert model.artifact.metrics["train"] > 0.9, model.artifact.metrics

@@ -13,7 +13,9 @@ to Flyte): a daemon thread stamps ``<execution_dir>/heartbeat`` every
 (:meth:`unionml_tpu.remote.Backend.wait`) treats a RUNNING execution with a stale
 heartbeat as a lost slice and resubmits it; a trainer configured with
 ``checkpoint_dir`` resumes from its last orbax step checkpoint. Fault injection for
-tests: ``UNIONML_TPU_FAULT_INJECT=N`` hard-kills attempts ``< N`` mid-run.
+tests: ``UNIONML_TPU_FAULT_INJECT=N`` hard-kills attempts ``< N`` mid-run
+(``UNIONML_TPU_FAULT_INJECT_PROCESS=i`` narrows the kill to worker ``i`` — the
+lost-single-host scenario on a multi-worker slice).
 """
 
 from __future__ import annotations
@@ -62,10 +64,20 @@ def _current_attempt(exec_path: Path) -> int:
 
 
 def _maybe_inject_fault(exec_path: Path) -> None:
-    """Simulated slice failure: die without writing a terminal status."""
+    """Simulated slice failure: die without writing a terminal status.
+
+    ``UNIONML_TPU_FAULT_INJECT=N`` kills attempts ``< N``. With
+    ``UNIONML_TPU_FAULT_INJECT_PROCESS=i`` set, only worker ``i`` dies — the
+    lost-single-host scenario on a multi-worker slice (its peers block in the
+    first collective until the watchdog reaps them).
+    """
     inject_below = int(os.environ.get("UNIONML_TPU_FAULT_INJECT", "0"))
-    if _current_attempt(exec_path) < inject_below:
-        os._exit(42)
+    if _current_attempt(exec_path) >= inject_below:
+        return
+    target = os.environ.get("UNIONML_TPU_FAULT_INJECT_PROCESS")
+    if target is not None and os.environ.get("UNIONML_TPU_PROCESS_ID", "0") != target:
+        return
+    os._exit(42)
 
 
 def _maybe_init_distributed() -> None:
