@@ -13,18 +13,16 @@ from __future__ import annotations
 
 import json
 import os
-import socket
 import subprocess
 import sys
 import textwrap
-import threading
 import time
 import urllib.request
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-from benchmarks.common import Timer, emit, log
+from benchmarks.common import Timer, emit, free_port, log, run_closed_loop_clients, wait_for_health
 
 CLIENTS = 16
 DURATION_S = 10.0
@@ -64,12 +62,6 @@ SERVE = textwrap.dedent(
     app.model.serve().run(port=int(sys.argv[2]))
     """
 )
-
-
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
 
 
 def post(url: str, payload: dict) -> dict:
@@ -117,65 +109,15 @@ def main() -> None:
     )
     try:
         base = f"http://127.0.0.1:{port}"
-        for _ in range(100):  # poll /health
-            try:
-                with urllib.request.urlopen(base + "/health", timeout=1):
-                    break
-            except Exception:
-                time.sleep(0.2)
-        else:
-            raise RuntimeError("server did not come up")
+        wait_for_health(base)
 
         payload = {"features": records}
         post(base + "/predict", payload)  # warm
 
-        latencies: list = []
-        lock = threading.Lock()
-        stop_at = time.perf_counter() + DURATION_S
-
-        def client() -> None:
-            # persistent HTTP/1.1 connection: the server's keep-alive support means
-            # each client pays the TCP handshake once, not per request
-            import http.client
-
-            body = json.dumps(payload)
-            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
-            local = []
-            failures = 0
-            try:
-                while time.perf_counter() < stop_at:
-                    start = time.perf_counter()
-                    try:
-                        conn.request("POST", "/predict", body=body, headers={"Content-Type": "application/json"})
-                        resp = conn.getresponse()
-                        resp.read()
-                        if resp.status != 200:
-                            raise RuntimeError(f"HTTP {resp.status}")
-                    except Exception as exc:
-                        # transient failure (keep-alive race, restart): reconnect and
-                        # keep driving load instead of silently dying with the samples
-                        failures += 1
-                        log(f"client request failed ({type(exc).__name__}: {exc}); reconnecting")
-                        conn.close()
-                        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
-                        if failures > 50:
-                            raise
-                        continue
-                    local.append(time.perf_counter() - start)
-                    if resp.will_close:  # server opted out; reconnect
-                        conn.close()
-                        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
-            finally:
-                conn.close()
-                with lock:
-                    latencies.extend(local)
-
-        threads = [threading.Thread(target=client) for _ in range(CLIENTS)]
         with Timer() as t:
-            for th in threads:
-                th.start()
-            for th in threads:
-                th.join()
+            latencies = run_closed_loop_clients(
+                port, json.dumps(payload), clients=CLIENTS, duration_s=DURATION_S
+            )
         n = len(latencies)
         rps = n / t.elapsed
         latencies.sort()

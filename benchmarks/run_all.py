@@ -21,6 +21,7 @@ SCRIPTS = {
     "llama_lora": "bench_llama_lora.py",
     "vit": "bench_vit.py",
     "serving": "bench_serving.py",
+    "serving_jit": "bench_serving_jit.py",
 }
 
 

@@ -164,7 +164,13 @@ class ServingApp:
         """Request counters and latency percentiles per route (SURVEY.md §5.5 —
         p50/p99 are the BASELINE serving metric, measured in-server, not just by
         the external benchmark client)."""
-        return 200, self.metrics.snapshot(), "application/json"
+        snapshot = self.metrics.snapshot()
+        compiled = getattr(self.model, "_compiled_predictor", None)
+        if compiled is not None:
+            # makes the bounded-compile guarantee observable: traces must stay at
+            # len(buckets) no matter how many request shapes arrive
+            snapshot["predictor"] = {"traces": compiled.traces, "eager_fallback": compiled._eager}
+        return 200, snapshot, "application/json"
 
     async def _predict(self, body: bytes):
         # native fast path: a {"features": [flat numeric records]} envelope is parsed
