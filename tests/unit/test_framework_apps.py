@@ -1,0 +1,182 @@
+"""Per-framework app e2e: torch and keras through the full Model protocol.
+
+The reference treats sklearn/pytorch/keras as co-equal first-class trainers
+(tests/integration/{pytorch,keras}_app/quickstart.py run through serving in
+test_fastapi.py; default saver/loader branches unionml/model.py:931-988). The
+sklearn ring lives in test_model.py/test_serving.py; this module covers the
+other two: train -> predict -> save -> load -> identical predictions -> serve.
+"""
+
+import asyncio
+import json
+from typing import List
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from unionml_tpu import Dataset, Model
+
+N, DIM = 120, 4
+
+
+def _frame(seed: int = 0) -> pd.DataFrame:
+    rng = np.random.default_rng(seed)
+    weights = rng.normal(size=DIM)
+    X = rng.normal(size=(N, DIM)).astype("float32")
+    frame = pd.DataFrame(X, columns=[f"x{i}" for i in range(DIM)])
+    frame["y"] = (X @ weights > 0).astype("int64")
+    return frame
+
+
+def _roundtrip_and_serve(model: Model, dataset: Dataset, tmp_path, hyperparameters=None):
+    """Shared drive: train, predict, save/load round trip, HTTP dispatch."""
+    _, metrics = model.train(hyperparameters=hyperparameters)
+    assert metrics["train"] > 0.8, metrics
+
+    records = _frame().drop(columns=["y"]).head(5).to_dict("records")
+    before = model.predict(features=records)
+    assert len(before) == 5
+
+    path = tmp_path / "artifact.bin"
+    model.save(str(path))
+    model.artifact = None
+    model.load(str(path))
+    assert model.predict(features=records) == before
+
+    app = model.serve()
+    status, preds, _ = asyncio.run(
+        app.dispatch("POST", "/predict", json.dumps({"features": records}).encode())
+    )
+    assert status == 200 and preds == before
+    return metrics
+
+
+def test_torch_app_end_to_end(tmp_path):
+    torch = pytest.importorskip("torch")
+
+    dataset = Dataset(name="torch_ds", targets=["y"], test_size=0.25)
+
+    class Net(torch.nn.Module):
+        def __init__(self, hidden: int = 16):
+            super().__init__()
+            self.hidden = hidden
+            self.layers = torch.nn.Sequential(
+                torch.nn.Linear(DIM, hidden), torch.nn.ReLU(), torch.nn.Linear(hidden, 2)
+            )
+
+        def forward(self, x):
+            return self.layers(x)
+
+    def init(hidden: int = 16) -> Net:
+        torch.manual_seed(0)
+        return Net(hidden)
+
+    model = Model(name="torch_app", init=init, dataset=dataset)
+
+    @dataset.reader
+    def reader() -> pd.DataFrame:
+        return _frame()
+
+    @model.trainer
+    def trainer(net: Net, features: pd.DataFrame, target: pd.DataFrame) -> Net:
+        X = torch.from_numpy(features.to_numpy(dtype="float32"))
+        y = torch.from_numpy(target.to_numpy().ravel())
+        opt = torch.optim.Adam(net.parameters(), lr=5e-2)
+        loss_fn = torch.nn.CrossEntropyLoss()
+        for _ in range(60):
+            opt.zero_grad()
+            loss = loss_fn(net(X), y)
+            loss.backward()
+            opt.step()
+        return net
+
+    @model.predictor
+    def predictor(net: Net, features: pd.DataFrame) -> List[int]:
+        with torch.no_grad():
+            logits = net(torch.from_numpy(features.to_numpy(dtype="float32")))
+        return [int(i) for i in logits.argmax(dim=-1)]
+
+    @model.evaluator
+    def evaluator(net: Net, features: pd.DataFrame, target: pd.DataFrame) -> float:
+        preds = np.array(predictor(net, features))
+        return float((preds == target.to_numpy().ravel()).mean())
+
+    _roundtrip_and_serve(model, dataset, tmp_path, hyperparameters={"hidden": 16})
+
+
+def test_torch_default_loader_reconstructs_from_hyperparameters(tmp_path):
+    """The torch artifact branch stores state_dict + hyperparameters; load must
+    rebuild via init(hyperparameters) then load_state_dict (reference
+    unionml/model.py:970-980)."""
+    torch = pytest.importorskip("torch")
+
+    from unionml_tpu.artifact import load_model_object, save_model_object
+
+    net = torch.nn.Linear(3, 2)
+    path = tmp_path / "net.pt"
+    save_model_object(net, {"out_features": 2}, str(path))
+
+    rebuilt = load_model_object(
+        str(path), type(net), init=lambda hp: torch.nn.Linear(3, hp["out_features"])
+    )
+    for a, b in zip(net.parameters(), rebuilt.parameters()):
+        assert torch.equal(a, b)
+
+
+def test_keras_app_end_to_end(tmp_path):
+    keras = pytest.importorskip("tensorflow.keras")
+
+    dataset = Dataset(name="keras_ds", targets=["y"], test_size=0.25)
+
+    def init(hidden: int = 16) -> keras.Model:
+        keras.utils.set_random_seed(0)
+        net = keras.Sequential(
+            [
+                keras.layers.Input((DIM,)),
+                keras.layers.Dense(hidden, activation="relu"),
+                keras.layers.Dense(2, activation="softmax"),
+            ]
+        )
+        net.compile(optimizer=keras.optimizers.Adam(5e-2), loss="sparse_categorical_crossentropy")
+        return net
+
+    model = Model(name="keras_app", init=init, dataset=dataset)
+
+    @dataset.reader
+    def reader() -> pd.DataFrame:
+        return _frame()
+
+    @model.trainer
+    def trainer(net: keras.Model, features: pd.DataFrame, target: pd.DataFrame) -> keras.Model:
+        net.fit(features.to_numpy(), target.to_numpy().ravel(), epochs=30, verbose=0)
+        return net
+
+    @model.predictor
+    def predictor(net: keras.Model, features: pd.DataFrame) -> List[int]:
+        probs = net.predict(features.to_numpy(), verbose=0)
+        return [int(i) for i in probs.argmax(axis=-1)]
+
+    @model.evaluator
+    def evaluator(net: keras.Model, features: pd.DataFrame, target: pd.DataFrame) -> float:
+        preds = np.array(predictor(net, features))
+        return float((preds == target.to_numpy().ravel()).mean())
+
+    # keras SavedModel/.keras writes need a real suffixed path
+    _, metrics = model.train(hyperparameters={"hidden": 16})
+    assert metrics["train"] > 0.8, metrics
+
+    records = _frame().drop(columns=["y"]).head(5).to_dict("records")
+    before = model.predict(features=records)
+
+    path = tmp_path / "artifact.keras"
+    model.save(str(path))
+    model.artifact = None
+    model.load(str(path))
+    assert model.predict(features=records) == before
+
+    app = model.serve()
+    status, preds, _ = asyncio.run(
+        app.dispatch("POST", "/predict", json.dumps({"features": records}).encode())
+    )
+    assert status == 200 and preds == before
