@@ -239,3 +239,66 @@ def test_device_data_log_trigger_with_stride(tmp_path):
         TrainerConfig(epochs=2, batch_size=128, device_data=True, steps_per_call=3, log_every_steps=5),
     )
     assert len(result.history) >= 3  # crossing semantics: logs fire despite stride 3
+
+
+def test_fit_with_flax_logical_partitioning_metadata():
+    """A module annotated with nn.with_partitioning carries its layout in the
+    params tree; fit() maps the logical names to mesh axes via
+    logical_axis_rules, unboxes, and trains with those placements (SURVEY.md
+    §7 hard part 3 — no regex tables needed)."""
+
+    class AnnotatedMLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Dense(
+                256,
+                kernel_init=nn.with_partitioning(nn.initializers.lecun_normal(), ("inp", "hidden")),
+            )(x)
+            x = nn.relu(x)
+            return nn.Dense(
+                2,
+                kernel_init=nn.with_partitioning(nn.initializers.lecun_normal(), ("hidden", None)),
+            )(x)
+
+    module = AnnotatedMLP()
+    variables = module.init(jax.random.PRNGKey(0), jnp.zeros((1, 8)))
+    params = variables["params"]
+    # metadata boxes really are in the tree
+    assert isinstance(params["Dense_0"]["kernel"], nn.Partitioned)
+
+    state = train_state.TrainState.create(
+        apply_fn=module.apply, params=params, tx=optax.adam(1e-2)
+    )
+
+    result = fit(
+        state,
+        make_train_step(_loss(module)),
+        _make_data(),
+        TrainerConfig(
+            epochs=2,
+            batch_size=128,
+            mesh=MeshSpec(data=2, fsdp=2, model=2),
+            logical_axis_rules=[("hidden", "model"), ("inp", "fsdp")],
+        ),
+    )
+    kernel0 = result.state.params["Dense_0"]["kernel"]
+    assert not isinstance(kernel0, nn.Partitioned)  # unboxed for training
+    assert str(kernel0.sharding.spec) == "PartitionSpec('fsdp', 'model')"
+    # optimizer state inherited the same placement through the boxed tree
+    mu0 = result.state.opt_state[0].mu["Dense_0"]["kernel"]
+    assert str(mu0.sharding.spec) == "PartitionSpec('fsdp', 'model')"
+    assert result.history[-1]["loss"] < 0.5
+
+
+def test_logical_metadata_names_used_as_mesh_axes_without_rules():
+    """Without logical_axis_rules, Partitioned names are mesh axis names directly;
+    names not present in the mesh replicate their dim."""
+    from unionml_tpu.parallel import combine_fsdp_tp, unbox_partitioned
+
+    mesh = MeshSpec(data=4, model=2).build()
+    kernel = nn.Partitioned(jnp.zeros((8, 16)), names=("missing_axis", "model"))
+    tree = {"layer": {"kernel": kernel, "bias": jnp.zeros((16,))}}
+    shardings = combine_fsdp_tp(tree, mesh, None, logical_rules=None)
+    assert str(shardings["layer"]["kernel"].spec) == "PartitionSpec(None, 'model')"
+    unboxed = unbox_partitioned(tree)
+    assert unboxed["layer"]["kernel"].shape == (8, 16)

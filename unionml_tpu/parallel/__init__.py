@@ -25,7 +25,9 @@ from unionml_tpu.parallel.pipeline import (  # noqa: F401
 from unionml_tpu.parallel.sharding import (  # noqa: F401
     PartitionRules,
     batch_sharding,
+    combine_fsdp_tp,
     infer_fsdp_sharding,
     named_sharding,
     shard_pytree,
+    unbox_partitioned,
 )

@@ -1,14 +1,20 @@
 """Sharding-rule resolution for parameter/optimizer pytrees.
 
 The reference never looks inside a model (SURVEY.md §5.7); here the framework owns
-parameter layout. Two mechanisms, composable:
+parameter layout. Three mechanisms, composable (SURVEY.md §7 hard part 3):
 
-1. :class:`PartitionRules` — an ordered table of ``(path-regex, PartitionSpec)`` pairs
+1. **flax logical-axis metadata** — modules annotated with
+   ``nn.with_partitioning(init, ("embed", "hidden"))`` carry their layout in the
+   params tree (``nn.Partitioned`` boxes); :func:`combine_fsdp_tp` maps the logical
+   names to mesh axes through t5x-style ``logical_axis_rules`` (or uses the names
+   as mesh axes directly when no rules are given) and :func:`unbox_partitioned`
+   strips the boxes for training.
+2. :class:`PartitionRules` — an ordered table of ``(path-regex, PartitionSpec)`` pairs
    applied to flattened pytree paths (the idiomatic t5x/maxtext pattern). First match
-   wins; unmatched leaves replicate.
-2. :func:`infer_fsdp_sharding` — automatic ZeRO-3-style layout: each large parameter's
+   wins; unmatched leaves fall through to 3.
+3. :func:`infer_fsdp_sharding` — automatic ZeRO-3-style layout: each large parameter's
    largest divisible axis is sharded over the ``fsdp`` mesh axis; small params
-   replicate. Covers user models with no hand-written specs (SURVEY.md §7 hard part 3).
+   replicate. Covers user models with no hand-written specs.
 """
 
 from __future__ import annotations
@@ -102,6 +108,21 @@ class PartitionRules:
         return jax.tree_util.tree_unflatten(treedef, shardings)
 
 
+def _fsdp_leaf_sharding(leaf: Any, mesh: Mesh, axis: str, min_weight_size: int) -> NamedSharding:
+    axis_size = mesh.shape.get(axis, 1)
+    shape = getattr(leaf, "shape", ())
+    if axis_size <= 1 or not shape or int(np.prod(shape)) < min_weight_size:
+        return NamedSharding(mesh, P())
+    # prefer the largest dim divisible by the axis size; ties -> last dim (lane-friendly)
+    candidates = [(dim_size, idx) for idx, dim_size in enumerate(shape) if dim_size % axis_size == 0]
+    if not candidates:
+        return NamedSharding(mesh, P())
+    _, best = max(candidates, key=lambda t: (t[0], t[1]))
+    spec = [None] * len(shape)
+    spec[best] = axis
+    return NamedSharding(mesh, P(*spec))
+
+
 def infer_fsdp_sharding(
     pytree: Any,
     mesh: Mesh,
@@ -114,22 +135,50 @@ def infer_fsdp_sharding(
     Leaves smaller than ``min_weight_size`` elements (biases, norms) replicate — the
     all-gather cost would exceed the HBM savings.
     """
-    axis_size = mesh.shape.get(axis, 1)
+    return jax.tree_util.tree_map(
+        lambda leaf: _fsdp_leaf_sharding(leaf, mesh, axis, min_weight_size), pytree
+    )
 
-    def leaf_sharding(leaf: Any) -> NamedSharding:
-        shape = getattr(leaf, "shape", ())
-        if axis_size <= 1 or not shape or int(np.prod(shape)) < min_weight_size:
-            return NamedSharding(mesh, P())
-        # prefer the largest dim divisible by the axis size; ties -> last dim (lane-friendly)
-        candidates = [(dim_size, idx) for idx, dim_size in enumerate(shape) if dim_size % axis_size == 0]
-        if not candidates:
-            return NamedSharding(mesh, P())
-        _, best = max(candidates, key=lambda t: (t[0], t[1]))
-        spec = [None] * len(shape)
-        spec[best] = axis
-        return NamedSharding(mesh, P(*spec))
 
-    return jax.tree_util.tree_map(leaf_sharding, pytree)
+def _is_partitioned(leaf: Any) -> bool:
+    from flax import linen as nn  # cached module lookup; flax is a core dep
+
+    return isinstance(leaf, nn.Partitioned)
+
+
+def _logical_spec(names: Tuple[Any, ...], mesh: Mesh, logical_rules: Optional[Sequence[Tuple[str, Any]]]) -> P:
+    """Map a Partitioned box's logical axis names to mesh axes.
+
+    With ``logical_rules``, flax's first-match-wins resolution applies (t5x
+    convention). Without rules, names are taken as mesh axis names directly
+    (``nn.with_partitioning(init, ("fsdp", "model"))``); names absent from the
+    mesh replicate their dim rather than erroring, so one module definition runs
+    on any mesh subset.
+    """
+    if logical_rules is not None:
+        from flax.linen import spmd
+
+        resolved = spmd.logical_to_mesh_axes(tuple(names), list(logical_rules))
+        entries = tuple(resolved)
+    else:
+        entries = tuple(names)
+    cleaned = []
+    for entry in entries:
+        if entry is None:
+            cleaned.append(None)
+        elif isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if a in mesh.axis_names)
+            cleaned.append(kept if kept else None)
+        else:
+            cleaned.append(entry if entry in mesh.axis_names else None)
+    return P(*cleaned)
+
+
+def unbox_partitioned(pytree: Any) -> Any:
+    """Strip ``nn.Partitioned`` metadata boxes, returning the raw value tree."""
+    return jax.tree_util.tree_map(
+        lambda x: x.unbox() if _is_partitioned(x) else x, pytree, is_leaf=_is_partitioned
+    )
 
 
 def place_global_array(leaf: Any, sharding: NamedSharding) -> Any:
@@ -161,16 +210,22 @@ def combine_fsdp_tp(
     rules: Optional[PartitionRules],
     *,
     min_weight_size: int = 2**14,
+    logical_rules: Optional[Sequence[Tuple[str, Any]]] = None,
 ) -> Any:
-    """Resolve shardings: explicit TP rules where they match, inferred FSDP elsewhere."""
-    if rules is None:
-        return infer_fsdp_sharding(pytree, mesh, min_weight_size=min_weight_size)
-
-    paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(pytree)
-    fsdp = infer_fsdp_sharding(pytree, mesh, min_weight_size=min_weight_size)
-    fsdp_leaves = jax.tree_util.tree_leaves(fsdp)
+    """Resolve shardings, in precedence order per leaf: flax ``nn.Partitioned``
+    metadata (mapped through ``logical_rules``) > explicit regex rules > inferred
+    FSDP. The returned sharding tree matches the UNBOXED structure
+    (:func:`unbox_partitioned`) — each metadata box resolves to one sharding.
+    """
+    paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(pytree, is_leaf=_is_partitioned)
     out = []
-    for (path, leaf), fallback in zip(paths_leaves, fsdp_leaves):
-        spec = rules.spec_for(_path_str(path))
-        out.append(fallback if spec is None else NamedSharding(mesh, spec))
+    for path, leaf in paths_leaves:
+        if _is_partitioned(leaf):
+            out.append(NamedSharding(mesh, _logical_spec(leaf.names, mesh, logical_rules)))
+            continue
+        spec = rules.spec_for(_path_str(path)) if rules is not None else None
+        if spec is not None:
+            out.append(NamedSharding(mesh, spec))
+        else:
+            out.append(_fsdp_leaf_sharding(leaf, mesh, "fsdp", min_weight_size))
     return jax.tree_util.tree_unflatten(treedef, out)

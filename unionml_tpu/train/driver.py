@@ -31,7 +31,13 @@ import jax.numpy as jnp
 
 from unionml_tpu._logging import logger
 from unionml_tpu.parallel.mesh import MeshSpec
-from unionml_tpu.parallel.sharding import PartitionRules, batch_sharding, combine_fsdp_tp, shard_pytree
+from unionml_tpu.parallel.sharding import (
+    PartitionRules,
+    batch_sharding,
+    combine_fsdp_tp,
+    shard_pytree,
+    unbox_partitioned,
+)
 
 
 @dataclasses.dataclass
@@ -47,6 +53,9 @@ class TrainerConfig:
     batch_size: int = 32
     mesh: Optional[MeshSpec] = None
     partition_rules: Optional[PartitionRules] = None
+    #: t5x-style (logical_name, mesh_axis) pairs resolving flax
+    #: ``nn.with_partitioning`` metadata; None = Partitioned names ARE mesh axes
+    logical_axis_rules: Optional[Any] = None
     fsdp_min_weight_size: int = 2**14
     grad_accum_steps: int = 1
     donate: bool = True
@@ -166,8 +175,8 @@ def _sync_fence(tree: Any) -> None:
         jax.block_until_ready(leaf)
 
 
-def _tree_device_shardings(state: Any, mesh, rules: Optional[PartitionRules], min_weight: int):
-    return combine_fsdp_tp(state, mesh, rules, min_weight_size=min_weight)
+def _tree_device_shardings(state: Any, mesh, rules: Optional[PartitionRules], min_weight: int, logical_rules=None):
+    return combine_fsdp_tp(state, mesh, rules, min_weight_size=min_weight, logical_rules=logical_rules)
 
 
 def _make_checkpoint_manager(config: TrainerConfig):
@@ -201,7 +210,12 @@ def fit(
     n_chips = mesh.size
 
     with mesh:
-        state_shardings = _tree_device_shardings(state, mesh, config.partition_rules, config.fsdp_min_weight_size)
+        state_shardings = _tree_device_shardings(
+            state, mesh, config.partition_rules, config.fsdp_min_weight_size, config.logical_axis_rules
+        )
+        # flax nn.with_partitioning metadata has been consumed into the shardings;
+        # train on the raw value tree
+        state = unbox_partitioned(state)
         state = shard_pytree(state, state_shardings)
         batch_sh = batch_sharding(mesh)
 
@@ -456,18 +470,23 @@ def evaluate(
     mesh: Optional[MeshSpec] = None,
     partition_rules: Optional[PartitionRules] = None,
     fsdp_min_weight_size: int = 2**14,
+    logical_axis_rules: Optional[Any] = None,
 ) -> Dict[str, float]:
     """Run a jitted eval step over a split and average the metrics.
 
     The eval step is compiled with the same state shardings the train driver
-    resolves (explicit TP rules + inferred FSDP), so an FSDP/TP-sharded state is
-    consumed in place instead of being resharded per eval split.
+    resolves (logical metadata + explicit TP rules + inferred FSDP), so an
+    FSDP/TP-sharded state is consumed in place instead of being resharded per
+    eval split.
     """
     from unionml_tpu.data.pipeline import PrefetchIterator
 
     built = (mesh or MeshSpec()).build()
     with built:
-        state_shardings = _tree_device_shardings(state, built, partition_rules, fsdp_min_weight_size)
+        state_shardings = _tree_device_shardings(
+            state, built, partition_rules, fsdp_min_weight_size, logical_axis_rules
+        )
+        state = unbox_partitioned(state)
         state = shard_pytree(state, state_shardings)
         batch_sh = batch_sharding(built)
         # batch in_sharding stays unconstrained: the final partial batch arrives
