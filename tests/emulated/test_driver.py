@@ -302,3 +302,58 @@ def test_logical_metadata_names_used_as_mesh_axes_without_rules():
     assert str(shardings["layer"]["kernel"].spec) == "PartitionSpec(None, 'model')"
     unboxed = unbox_partitioned(tree)
     assert unboxed["layer"]["kernel"].shape == (8, 16)
+
+
+def test_fit_reports_memory_stats_or_none():
+    """FitResult carries the §5.5 HBM accounting: a dict of byte counters on
+    backends that expose memory_stats, None on backends that don't (CPU)."""
+    module, state = _make_state()
+    result = fit(
+        state, make_train_step(_loss(module)), _make_data(n=256),
+        TrainerConfig(epochs=1, batch_size=128),
+    )
+    assert result.memory_stats is None or (
+        isinstance(result.memory_stats, dict)
+        and all(isinstance(v, int) for v in result.memory_stats.values())
+    )
+
+
+def test_evaluate_keeps_existing_placement_of_trained_state():
+    """The state fit() returns (logical-metadata layout, boxes already stripped)
+    must be consumed in place by evaluate(): leaf placements survive untouched
+    even though no rules can re-derive them from the unboxed tree."""
+
+    class Annotated(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Dense(
+                256,
+                kernel_init=nn.with_partitioning(nn.initializers.lecun_normal(), ("inp", "hidden")),
+            )(x)
+            return nn.Dense(2)(nn.relu(x))
+
+    module = Annotated()
+    params = module.init(jax.random.PRNGKey(0), jnp.zeros((1, 8)))["params"]
+    state = train_state.TrainState.create(apply_fn=module.apply, params=params, tx=optax.adam(1e-2))
+    mesh_spec = MeshSpec(data=2, fsdp=2, model=2)
+    result = fit(
+        state,
+        make_train_step(_loss(module)),
+        _make_data(),
+        TrainerConfig(
+            epochs=1, batch_size=128, mesh=mesh_spec,
+            logical_axis_rules=[("hidden", "model"), ("inp", "fsdp")],
+        ),
+    )
+    trained_spec = str(result.state.params["Dense_0"]["kernel"].sharding.spec)
+    assert trained_spec == "PartitionSpec('fsdp', 'model')"
+
+    def eval_step(st, batch):
+        X, y = batch
+        logits = module.apply({"params": st.params}, X)
+        return {"accuracy": (jnp.argmax(logits, -1) == y.reshape(-1)).mean()}
+
+    # no rules passed at all: existing placement must be honored, not re-derived
+    metrics = evaluate(result.state, eval_step, _make_data(), batch_size=128, mesh=mesh_spec)
+    assert metrics["accuracy"] > 0.9
+    assert str(result.state.params["Dense_0"]["kernel"].sharding.spec) == trained_spec

@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -55,7 +55,7 @@ class TrainerConfig:
     partition_rules: Optional[PartitionRules] = None
     #: t5x-style (logical_name, mesh_axis) pairs resolving flax
     #: ``nn.with_partitioning`` metadata; None = Partitioned names ARE mesh axes
-    logical_axis_rules: Optional[Any] = None
+    logical_axis_rules: "Optional[Sequence[Tuple[str, Any]]]" = None
     fsdp_min_weight_size: int = 2**14
     grad_accum_steps: int = 1
     donate: bool = True
@@ -93,6 +93,21 @@ class FitResult:
     samples_per_sec: float
     samples_per_sec_per_chip: float
     compile_time_s: float
+    #: per-device HBM accounting after the final step (SURVEY.md §5.5 metrics
+    #: sink commitment): ``{"bytes_in_use": ..., "peak_bytes_in_use": ...}`` from
+    #: device 0, or None when the backend exposes no memory stats (CPU)
+    memory_stats: Optional[Dict[str, int]] = None
+
+
+def _device_memory_stats() -> Optional[Dict[str, int]]:
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    keep = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit", "largest_alloc_size")
+    return {k: int(v) for k, v in stats.items() if k in keep}
 
 
 def make_train_step(
@@ -458,6 +473,7 @@ def fit(
         samples_per_sec=sps,
         samples_per_sec_per_chip=sps / max(n_chips, 1),
         compile_time_s=compile_time,
+        memory_stats=_device_memory_stats(),
     )
 
 
@@ -470,23 +486,34 @@ def evaluate(
     mesh: Optional[MeshSpec] = None,
     partition_rules: Optional[PartitionRules] = None,
     fsdp_min_weight_size: int = 2**14,
-    logical_axis_rules: Optional[Any] = None,
+    logical_axis_rules: "Optional[Sequence[Tuple[str, Any]]]" = None,
 ) -> Dict[str, float]:
     """Run a jitted eval step over a split and average the metrics.
 
-    The eval step is compiled with the same state shardings the train driver
-    resolves (logical metadata + explicit TP rules + inferred FSDP), so an
-    FSDP/TP-sharded state is consumed in place instead of being resharded per
-    eval split.
+    A state leaf that already lives on an equal mesh keeps its placement (the
+    state ``fit`` returns is consumed in place — no per-split reshard, even for
+    layouts that came from since-unboxed ``nn.Partitioned`` metadata); host
+    leaves are placed via the same resolution the train driver uses (logical
+    metadata + explicit TP rules + inferred FSDP).
     """
+    from jax.sharding import NamedSharding
+
     from unionml_tpu.data.pipeline import PrefetchIterator
 
     built = (mesh or MeshSpec()).build()
     with built:
-        state_shardings = _tree_device_shardings(
+        resolved = _tree_device_shardings(
             state, built, partition_rules, fsdp_min_weight_size, logical_axis_rules
         )
         state = unbox_partitioned(state)
+
+        def keep_or_resolve(leaf: Any, fallback: Any) -> Any:
+            existing = getattr(leaf, "sharding", None)
+            if isinstance(existing, NamedSharding) and existing.mesh == built:
+                return existing
+            return fallback
+
+        state_shardings = jax.tree_util.tree_map(keep_or_resolve, state, resolved)
         state = shard_pytree(state, state_shardings)
         batch_sh = batch_sharding(built)
         # batch in_sharding stays unconstrained: the final partial batch arrives
