@@ -318,10 +318,11 @@ def test_fit_reports_memory_stats_or_none():
     )
 
 
-def test_evaluate_keeps_existing_placement_of_trained_state():
+def test_evaluate_keeps_existing_placement_of_trained_state(monkeypatch):
     """The state fit() returns (logical-metadata layout, boxes already stripped)
-    must be consumed in place by evaluate(): leaf placements survive untouched
-    even though no rules can re-derive them from the unboxed tree."""
+    must be consumed in place by evaluate(): the shardings handed to placement
+    are the leaves' EXISTING shardings, not a fresh FSDP resolution — asserted
+    by spying on shard_pytree (numerics alone cannot detect a reshard)."""
 
     class Annotated(nn.Module):
         @nn.compact
@@ -353,7 +354,17 @@ def test_evaluate_keeps_existing_placement_of_trained_state():
         logits = module.apply({"params": st.params}, X)
         return {"accuracy": (jnp.argmax(logits, -1) == y.reshape(-1)).mean()}
 
+    import unionml_tpu.train.driver as driver_mod
+
+    captured = {}
+    real_shard_pytree = driver_mod.shard_pytree
+
+    def spying_shard_pytree(pytree, shardings):
+        captured["kernel_spec"] = str(shardings.params["Dense_0"]["kernel"].spec)
+        return real_shard_pytree(pytree, shardings)
+
+    monkeypatch.setattr(driver_mod, "shard_pytree", spying_shard_pytree)
     # no rules passed at all: existing placement must be honored, not re-derived
     metrics = evaluate(result.state, eval_step, _make_data(), batch_size=128, mesh=mesh_spec)
     assert metrics["accuracy"] > 0.9
-    assert str(result.state.params["Dense_0"]["kernel"].sharding.spec) == trained_spec
+    assert captured["kernel_spec"] == trained_spec  # placed onto its OWN sharding

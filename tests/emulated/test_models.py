@@ -68,26 +68,23 @@ def test_flash_attention_causal_cross_lengths():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
-def test_multihead_attention_flash_dispatch_repeats_gqa_heads(monkeypatch):
-    """The flash kernel expects equal Q/KV head counts; the dispatch must repeat KV
-    heads for grouped-query inputs before handing off."""
-    from unionml_tpu.ops import attention as attn_mod
-    from unionml_tpu.ops import flash_attention as fa_mod
-
-    captured = {}
-
-    def fake_flash(q, k, v, causal=False, **kwargs):
-        captured["kv_heads"] = k.shape[2]
-        return dot_product_attention(q, k, v, causal=causal)
-
-    monkeypatch.setattr(fa_mod, "flash_attention", fake_flash)
-    q = jax.random.normal(jax.random.PRNGKey(0), (1, 64, 8, 32))
-    k = jax.random.normal(jax.random.PRNGKey(1), (1, 64, 2, 32))
-    v = jax.random.normal(jax.random.PRNGKey(2), (1, 64, 2, 32))
+def test_flash_attention_grouped_query_native():
+    """The kernel consumes grouped-query KV unexpanded: its index maps route query
+    head h to KV head h * n_kv // n_heads, so repeated heads are never
+    materialized. Numerics must match the (head-repeating) XLA reference."""
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 256, 8, 128))
+    k = jax.random.normal(jax.random.PRNGKey(1), (1, 256, 2, 128))
+    v = jax.random.normal(jax.random.PRNGKey(2), (1, 256, 2, 128))
     ref = dot_product_attention(q, k, v, causal=True)
-    out = attn_mod.multihead_attention(q, k, v, causal=True, impl="flash")
-    assert captured["kv_heads"] == 8
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_attention_rejects_indivisible_heads():
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 128, 6, 128))
+    k = jax.random.normal(jax.random.PRNGKey(1), (1, 128, 4, 128))
+    with pytest.raises(ValueError, match="multiple of KV heads"):
+        flash_attention(q, k, k, interpret=True)
 
 
 def test_ring_attention_matches_reference():

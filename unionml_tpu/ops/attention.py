@@ -74,9 +74,7 @@ def multihead_attention(
     if impl == "flash" and mask is None:
         from unionml_tpu.ops.flash_attention import flash_attention
 
-        n_heads, n_kv = q.shape[2], k.shape[2]
-        if n_kv != n_heads:  # the flash kernel expects equal head counts
-            k = jnp.repeat(k, n_heads // n_kv, axis=2)
-            v = jnp.repeat(v, n_heads // n_kv, axis=2)
+        # grouped-query KV passes through unexpanded: the kernel's index maps
+        # route query head h to KV head h * n_kv // n_heads
         return flash_attention(q, k, v, causal=causal)
     return dot_product_attention(q, k, v, causal=causal, mask=mask)

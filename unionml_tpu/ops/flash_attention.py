@@ -7,13 +7,25 @@ sequential over its innermost dimension, so scratch persists across the k-block 
 — the canonical pallas accumulation pattern (see /opt/skills/guides/pallas_guide.md,
 "Patterns: Double Buffering" / grid accumulation).
 
+Layout decisions (each mandated by the TPU memory system):
+
+- the kernel indexes ``[B, L, H, D]`` inputs directly with a 4D grid
+  ``(batch, heads, q_blocks, k_blocks)`` — no head-folding transpose, so Q/K/V
+  never take an extra HBM round trip before/after the kernel;
+- grouped-query attention happens in the K/V index maps (query head ``h`` reads
+  KV head ``h * n_kv // n_heads``) — repeated KV heads are never materialized;
+- ``dimension_semantics`` marks batch/head/q-block dims parallel and the k-block
+  dim arbitrary (sequential accumulation), letting Mosaic pipeline the grid;
+- running-stats scratch is lane-replicated ``(block_q, 128)`` — a ``(block_q, 1)``
+  buffer pads to a full lane register anyway and forces relayouts.
+
 Backward: ``jax.custom_vjp`` recomputes attention with the XLA reference
 implementation and differentiates through it — the memory win of the flash forward is
 preserved for inference and for activations under ``jax.checkpoint``; a fused pallas
 backward kernel is a later optimization.
 
-Shapes: ``q, k, v: [B, L, H, D]`` with ``D % 128 == 0`` and ``L`` divisible by the
-block size. Grouped-query is handled by the caller (head repetition) before dispatch.
+Shapes: ``q: [B, Lq, H, D]``, ``k/v: [B, Lk, Hkv, D]`` with ``H % Hkv == 0``,
+``D % 128 == 0``, and lengths divisible by the block size.
 """
 
 from __future__ import annotations
@@ -24,16 +36,14 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-try:  # TPU-specific memory spaces; fall back to interpreter-friendly defaults on CPU
+try:  # TPU plugin module; without it the kernel (interpret mode included) is unusable
     from jax.experimental.pallas import tpu as pltpu
-
-    _VMEM = pltpu.VMEM
 except Exception:  # pragma: no cover
     pltpu = None
-    _VMEM = None
 
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
+_LANES = 128  # TPU vector lane width: stats scratch is lane-replicated
 _NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
@@ -42,9 +52,9 @@ def _flash_fwd_kernel(
 ):
     # offset = k_len - q_len: with unequal lengths, query row i may attend keys up to
     # i + offset (matching dot_product_attention's shifted diagonal)
-    qi = pl.program_id(1)
-    ki = pl.program_id(2)
-    num_k = pl.num_programs(2)
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    num_k = pl.num_programs(3)
 
     @pl.when(ki == 0)
     def _init():
@@ -53,9 +63,9 @@ def _flash_fwd_kernel(
         acc_scratch[:] = jnp.zeros_like(acc_scratch)
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale  # [block_q, D]
-        k = k_ref[0].astype(jnp.float32)  # [block_k, D]
-        v = v_ref[0].astype(jnp.float32)  # [block_k, D]
+        q = q_ref[0, :, 0, :].astype(jnp.float32) * scale  # [block_q, D]
+        k = k_ref[0, :, 0, :].astype(jnp.float32)  # [block_k, D]
+        v = v_ref[0, :, 0, :].astype(jnp.float32)  # [block_k, D]
         scores = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [block_q, block_k]
 
         if causal:
@@ -63,16 +73,17 @@ def _flash_fwd_kernel(
             k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
             scores = jnp.where(q_pos + offset >= k_pos, scores, _NEG_INF)
 
-        m_prev = m_scratch[:]  # [block_q, 1]
+        m_prev = m_scratch[:, :1]  # [block_q, 1] view of the lane-replicated stats
+        l_prev = l_scratch[:, :1]
         m_curr = jnp.max(scores, axis=-1, keepdims=True)
         m_next = jnp.maximum(m_prev, m_curr)
         alpha = jnp.exp(m_prev - m_next)
         p = jnp.exp(scores - m_next)
 
-        l_next = l_scratch[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        l_next = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_scratch[:] = acc_scratch[:] * alpha + jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())))
-        m_scratch[:] = m_next
-        l_scratch[:] = l_next
+        m_scratch[:] = jnp.broadcast_to(m_next, m_scratch.shape)
+        l_scratch[:] = jnp.broadcast_to(l_next, l_scratch.shape)
 
     if causal:
         # skip k blocks entirely above the (offset-shifted) diagonal
@@ -84,50 +95,60 @@ def _flash_fwd_kernel(
 
     @pl.when(ki == num_k - 1)
     def _finalize():
-        denom = jnp.where(l_scratch[:] == 0.0, 1.0, l_scratch[:])
-        o_ref[0] = (acc_scratch[:] / denom).astype(o_ref.dtype)
+        l_final = l_scratch[:, :1]
+        denom = jnp.where(l_final == 0.0, 1.0, l_final)
+        o_ref[0, :, 0, :] = (acc_scratch[:] / denom).astype(o_ref.dtype)
 
 
 def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool, interpret: bool) -> jax.Array:
     batch, q_len, n_heads, head_dim = q.shape
-    k_len = k.shape[1]
+    k_len, n_kv = k.shape[1], k.shape[2]
+    if n_heads % n_kv:
+        raise ValueError(f"query heads ({n_heads}) must be a multiple of KV heads ({n_kv})")
     block_q = min(DEFAULT_BLOCK_Q, q_len)
     block_k = min(DEFAULT_BLOCK_K, k_len)
     scale = head_dim**-0.5
 
-    # fold heads into batch; kernel operates on [BH, L, D]
-    def fold(x):
-        return x.transpose(0, 2, 1, 3).reshape(x.shape[0] * x.shape[2], x.shape[1], x.shape[3])
+    if pltpu is None:  # pragma: no cover
+        raise RuntimeError("pallas TPU backend unavailable; use impl='xla' attention instead")
 
-    qf, kf, vf = fold(q), fold(k), fold(v)
-    grid = (batch * n_heads, q_len // block_q, k_len // block_k)
+    # 4D grid over [B, L, H, D] directly — no head-folding transpose; KV heads are
+    # resolved in the index maps (GQA without materializing repeats)
+    grid = (batch, n_heads, q_len // block_q, k_len // block_k)
+
+    def q_index(b, h, qi, ki):
+        return (b, qi, h, 0)
+
+    def kv_index(b, h, qi, ki):
+        return (b, ki, h * n_kv // n_heads, 0)
 
     kernel = functools.partial(
         _flash_fwd_kernel, causal=causal, block_q=block_q, block_k=block_k, scale=scale, offset=k_len - q_len
     )
-    if pltpu is None:  # pragma: no cover
-        raise RuntimeError("pallas TPU backend unavailable; use impl='xla' attention instead")
-    scratch_shapes = [
-        pltpu.VMEM((block_q, 1), jnp.float32),
-        pltpu.VMEM((block_q, 1), jnp.float32),
-        pltpu.VMEM((block_q, head_dim), jnp.float32),
-    ]
+    compiler_params = None
+    if not interpret:
+        compiler_params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
+        )
 
-    out = pl.pallas_call(
+    return pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, head_dim), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, head_dim), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, head_dim), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_q, 1, head_dim), q_index),
+            pl.BlockSpec((1, block_k, 1, head_dim), kv_index),
+            pl.BlockSpec((1, block_k, 1, head_dim), kv_index),
         ],
-        out_specs=pl.BlockSpec((1, block_q, head_dim), lambda bh, qi, ki: (bh, qi, 0)),
-        scratch_shapes=scratch_shapes,
+        out_specs=pl.BlockSpec((1, block_q, 1, head_dim), q_index),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, head_dim), jnp.float32),
+        ],
+        compiler_params=compiler_params,
         interpret=interpret,
-    )(qf, kf, vf)
-
-    return out.reshape(batch, n_heads, q_len, head_dim).transpose(0, 2, 1, 3)
+    )(q, k, v)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
@@ -154,5 +175,6 @@ def flash_attention(
     q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = False, interpret: bool = False
 ) -> jax.Array:
     """Flash attention entry point. ``interpret=True`` runs the kernel in the pallas
-    interpreter (CPU) — used by the test ring."""
+    interpreter (CPU) — used by the test ring. Accepts grouped-query KV
+    (``k/v: [B, Lk, Hkv, D]`` with ``Hkv`` dividing the query head count)."""
     return _flash(q, k, v, causal, interpret)

@@ -107,7 +107,8 @@ def _device_memory_stats() -> Optional[Dict[str, int]]:
     if not stats:
         return None
     keep = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit", "largest_alloc_size")
-    return {k: int(v) for k, v in stats.items() if k in keep}
+    filtered = {k: int(v) for k, v in stats.items() if k in keep}
+    return filtered or None  # a stats dict without byte counters is as good as none
 
 
 def make_train_step(
