@@ -3,7 +3,6 @@
 but hermetic: the 'cluster' is the local subprocess executor)."""
 
 import subprocess
-import sys
 import textwrap
 from pathlib import Path
 
@@ -247,7 +246,6 @@ def test_tpu_vm_launcher_sizes_workers_to_slice(tmp_path, monkeypatch):
     """With accelerator="v5e-16" (2 hosts) and default n_workers, the backend sizes
     the worker set to the slice topology and wires the jax.distributed env."""
     from unionml_tpu.launcher import LaunchSpec, TPUVMLauncher
-    from unionml_tpu.remote import Backend, BackendConfig
 
     specs = []
 

@@ -8,10 +8,9 @@ rather than primitives, and so ring-attention can share one ppermute helper.
 
 from __future__ import annotations
 
-from typing import Any, Optional, Sequence, Union
+from typing import Any, Sequence, Union
 
 import jax
-import jax.numpy as jnp
 from jax import lax
 
 AxisName = Union[str, Sequence[str]]
