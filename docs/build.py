@@ -208,7 +208,7 @@ def _page_title(source: str, fallback: str) -> str:
 
 def build_site(out_dir: Path) -> List[Path]:
     pages = sorted(DOCS_DIR.glob("*.md")) + sorted((DOCS_DIR / "tutorials").glob("*.md"))
-    nav_order = ["index", "quickstart", "tpu-training", "parallelism", "serving", "remote", "benchmarks"]
+    nav_order = ["index", "quickstart", "dataset", "model", "tpu-training", "parallelism", "serving", "remote", "benchmarks"]
     pages.sort(key=lambda p: nav_order.index(p.stem) if p.stem in nav_order else len(nav_order))
 
     nav_links = []
