@@ -185,20 +185,42 @@ class PrefetchIterator:
         return jax.tree_util.tree_map(place_leaf, host_batch)
 
     def __iter__(self) -> Iterator[Any]:
-        # A deque of already-dispatched device transfers: jax.device_put is async, so
-        # holding `prefetch` in-flight batches overlaps H2D copies with compute.
-        queue: collections.deque = collections.deque()
+        if self.prefetch <= 0:
+            for host_batch in self._host_batches():
+                yield self._place(host_batch)
+            return
+
+        # Production (host fancy-index copy + async device_put dispatch) runs on ONE
+        # background thread, `prefetch+1` batches ahead: the H2D transfer already
+        # overlapped compute (device_put is async), this also moves the host-side
+        # gather off the step loop. A single worker preserves batch order and keeps
+        # the host-batch generator single-threaded.
+        from concurrent.futures import ThreadPoolExecutor
+
         source = self._host_batches()
+        sentinel = object()
+
+        def produce() -> Any:
+            try:
+                return self._place(next(source))
+            except StopIteration:
+                return sentinel
+
+        pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix="unionml-tpu-prefetch")
         try:
-            for _ in range(self.prefetch):
-                queue.append(self._place(next(source)))
-        except StopIteration:
-            pass
-        for host_batch in source:
-            queue.append(self._place(host_batch))
-            yield queue.popleft()
-        while queue:
-            yield queue.popleft()
+            futures: collections.deque = collections.deque(
+                pool.submit(produce) for _ in range(self.prefetch + 1)
+            )
+            while futures:
+                item = futures.popleft().result()
+                if item is sentinel:
+                    break
+                futures.append(pool.submit(produce))
+                yield item
+        finally:
+            # abandoned mid-epoch (step raised / KeyboardInterrupt): drop queued
+            # gathers+transfers instead of finishing them during generator cleanup
+            pool.shutdown(wait=True, cancel_futures=True)
 
     def __len__(self) -> int:
         return max(self.steps_per_epoch() * self.epochs - self.skip_batches, 0)
