@@ -124,10 +124,10 @@ def _run_two_worker_slice(tmp_path, monkeypatch, trainer_config_extra: str, app_
 def test_two_worker_slice_trains_over_global_mesh(tmp_path, monkeypatch):
     model, execution = _run_two_worker_slice(tmp_path, monkeypatch, "", "mh-v1")
 
-    # the workers really formed one 8-device runtime: process 0's log shows the
-    # global mesh; Gloo connections only exist cross-process
+    # the workers really formed one 8-device runtime: the worker logs the global
+    # device count it observes after jax.distributed.initialize
     log0 = (Path(execution.path) / "logs.txt").read_text()
-    assert "Gloo" in log0 or "connected" in log0
+    assert "joined jax.distributed runtime: process 0/2, global devices 8 (4 local)" in log0
 
     model.remote_load(execution)
     assert model.artifact.metrics["train"] > 0.9, model.artifact.metrics

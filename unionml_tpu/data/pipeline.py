@@ -219,7 +219,8 @@ class PrefetchIterator:
                 yield item
         finally:
             # abandoned mid-epoch (step raised / KeyboardInterrupt): drop queued
-            # gathers+transfers instead of finishing them during generator cleanup
+            # not-yet-started gathers+transfers; the one in-flight produce() is
+            # allowed to finish (bounded by a single batch's production time)
             pool.shutdown(wait=True, cancel_futures=True)
 
     def __len__(self) -> int:

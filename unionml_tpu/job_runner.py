@@ -29,6 +29,8 @@ import time
 import traceback
 from pathlib import Path
 
+from unionml_tpu._logging import logger
+
 
 def _start_heartbeat(exec_path: Path, my_attempt: int) -> threading.Event:
     """Stamp ``heartbeat`` periodically so the backend can detect a lost worker.
@@ -90,10 +92,18 @@ def _maybe_init_distributed() -> None:
         # emulated multi-host lane: a TPU plugin on the path would win over the env
         # var, so pin the platform before the backend initializes
         jax.config.update("jax_platforms", "cpu")
+    num_processes = int(os.environ.get("UNIONML_TPU_NUM_PROCESSES", "1"))
+    process_id = int(os.environ.get("UNIONML_TPU_PROCESS_ID", "0"))
     jax.distributed.initialize(
         coordinator_address=coordinator,
-        num_processes=int(os.environ.get("UNIONML_TPU_NUM_PROCESSES", "1")),
-        process_id=int(os.environ.get("UNIONML_TPU_PROCESS_ID", "0")),
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    # the definitive signal that the slice formed: this process sees every
+    # device of every peer (watchdog tests assert on this line)
+    logger.info(
+        f"joined jax.distributed runtime: process {process_id}/{num_processes}, "
+        f"global devices {jax.device_count()} ({jax.local_device_count()} local)"
     )
 
 
