@@ -171,3 +171,65 @@ def test_path_features_are_not_rereresolved(tmp_path):
     # but the same string VALUE is sniffed as a path (reference behavior)
     loaded = dataset.get_features(str(inner))
     assert list(loaded.columns) == ["x1", "x2"]
+
+
+# ---------------------------------------------------------------- fuzzing
+
+
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+_ident = st.text(
+    alphabet=st.characters(min_codepoint=32, max_codepoint=126, exclude_characters='"\\'),
+    min_size=0,
+    max_size=12,
+)
+_value = st.one_of(
+    st.integers(min_value=-(10**12), max_value=10**12),
+    st.floats(allow_nan=False, allow_infinity=False, width=64),
+    st.booleans(),
+    st.none(),
+)
+
+
+@settings(max_examples=200, deadline=None)
+@given(
+    columns=st.lists(_ident, min_size=1, max_size=6, unique=True),
+    n_rows=st.integers(min_value=1, max_value=8),
+    data=st.data(),
+)
+def test_parse_records_fuzz_matches_json_loads(columns, n_rows, data):
+    """For every payload json.dumps can produce from flat numeric records, the
+    native parser must either decline (None) or agree with the Python path on
+    shape, column order, and values (NaN for null, 1/0 for bools)."""
+    rows = [
+        {c: data.draw(_value, label=f"row{i}[{c}]") for c in columns}
+        for i in range(n_rows)
+    ]
+    payload = json.dumps(rows).encode()
+    result = parse_records(payload)
+    assert result is not None, f"well-formed flat records must take the fast path: {payload[:120]!r}"
+    matrix, names, consumed = result
+    assert names == columns and matrix.shape == (n_rows, len(columns))
+    assert consumed == len(payload)
+    for i, row in enumerate(rows):
+        for j, c in enumerate(columns):
+            expected = row[c]
+            got = matrix[i, j]
+            if expected is None:
+                assert np.isnan(got)
+            elif isinstance(expected, bool):
+                assert got == (1.0 if expected else 0.0)
+            else:
+                assert got == float(expected), (expected, got)
+
+
+@settings(max_examples=150, deadline=None)
+@given(junk=st.binary(min_size=0, max_size=80))
+def test_parse_records_fuzz_never_crashes_on_garbage(junk):
+    """Arbitrary bytes must produce None or a valid matrix — never a crash
+    (the parser runs in-process on untrusted request bodies)."""
+    result = parse_records(junk)
+    if result is not None:
+        matrix, names, consumed = result
+        assert matrix.shape[1] == len(names)
+        assert 0 <= consumed <= len(junk)
