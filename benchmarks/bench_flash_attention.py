@@ -61,6 +61,20 @@ def main() -> None:
         results[name] = (xla_ms, flash_ms)
         log(f"{name}: xla {xla_ms:.3f} ms, flash {flash_ms:.3f} ms ({xla_ms / flash_ms:.2f}x)")
 
+        def train_flash(q, k, v):
+            return jax.grad(lambda a, b, c: flash_attention(a, b, c, causal=True).sum(), argnums=(0, 1, 2))(q, k, v)
+
+        def train_xla(q, k, v):
+            return jax.grad(lambda a, b, c: dot_product_attention(a, b, c, causal=True).sum(), argnums=(0, 1, 2))(q, k, v)
+
+        fwdbwd_xla_ms = _time(train_xla, q, k, v) * 1e3
+        fwdbwd_flash_ms = _time(train_flash, q, k, v) * 1e3
+        results[f"{name}_fwdbwd"] = (fwdbwd_xla_ms, fwdbwd_flash_ms)
+        log(
+            f"{name} fwd+bwd: xla {fwdbwd_xla_ms:.3f} ms, flash (fused kernels) "
+            f"{fwdbwd_flash_ms:.3f} ms ({fwdbwd_xla_ms / fwdbwd_flash_ms:.2f}x)"
+        )
+
     xla_ms, flash_ms = results["mha"]
     emit(
         "flash_attention_fwd_latency",
@@ -68,6 +82,8 @@ def main() -> None:
         "ms",
         xla_ms / flash_ms,  # >1.0: flash wins, flip impl="auto"
         xla_ms=xla_ms,
+        fwdbwd_flash_ms=results["mha_fwdbwd"][1],
+        fwdbwd_xla_ms=results["mha_fwdbwd"][0],
         gqa_flash_ms=results["gqa"][1],
         gqa_xla_ms=results["gqa"][0],
         batch=B,

@@ -57,6 +57,61 @@ def test_flash_attention_gradients_match():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
 
 
+def test_flash_attention_gqa_gradients_group_sum():
+    """The fused backward computes dk/dv at query-head resolution then group-sums
+    for GQA (repeat's transpose); gradients must match the head-repeating XLA
+    reference exactly, including shapes [B, Lk, Hkv, D]."""
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 128, 4, 128))
+    k = jax.random.normal(jax.random.PRNGKey(1), (1, 128, 2, 128))
+    v = jax.random.normal(jax.random.PRNGKey(2), (1, 128, 2, 128))
+    g_flash = jax.grad(
+        lambda *a: (flash_attention(*a, causal=True, interpret=True) ** 2).sum(), argnums=(0, 1, 2)
+    )(q, k, v)
+    g_ref = jax.grad(
+        lambda *a: (dot_product_attention(*a, causal=True) ** 2).sum(), argnums=(0, 1, 2)
+    )(q, k, v)
+    assert g_flash[1].shape == k.shape and g_flash[2].shape == v.shape
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-4)
+
+
+def test_attention_empty_causal_rows_are_zero_everywhere():
+    """q_len > k_len causal: rows attending NO keys are zero — a convention all
+    three implementations (dense reference, flash, fused backward) must share;
+    softmax over an all-masked row must never leak a uniform mean of V."""
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 256, 1, 128))
+    k = jax.random.normal(jax.random.PRNGKey(1), (1, 128, 1, 128))
+    v = jax.random.normal(jax.random.PRNGKey(2), (1, 128, 1, 128))
+    ref = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_array_equal(np.asarray(ref[:, :128]), 0.0)  # offset=-128: first 128 rows empty
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    g_flash = jax.grad(
+        lambda *a: (flash_attention(*a, causal=True, interpret=True) ** 2).sum(), argnums=(0, 1, 2)
+    )(q, k, v)
+    g_ref = jax.grad(
+        lambda *a: (dot_product_attention(*a, causal=True) ** 2).sum(), argnums=(0, 1, 2)
+    )(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-4)
+
+
+def test_flash_attention_cross_length_gradients():
+    """q_len != k_len backward: the offset-shifted causal diagonal must mask the
+    recomputed scores identically in the dq and dkv kernels."""
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 128, 1, 128))
+    k = jax.random.normal(jax.random.PRNGKey(1), (1, 256, 1, 128))
+    v = jax.random.normal(jax.random.PRNGKey(2), (1, 256, 1, 128))
+    g_flash = jax.grad(
+        lambda *a: (flash_attention(*a, causal=True, interpret=True) ** 2).sum(), argnums=(0, 1, 2)
+    )(q, k, v)
+    g_ref = jax.grad(
+        lambda *a: (dot_product_attention(*a, causal=True) ** 2).sum(), argnums=(0, 1, 2)
+    )(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-4)
+
+
 def test_flash_attention_causal_cross_lengths():
     """q_len != k_len: causal masking must use the shifted diagonal (query i attends
     keys up to i + k_len - q_len), matching the XLA reference."""

@@ -39,15 +39,23 @@ def dot_product_attention(
         v = jnp.repeat(v, n_heads // n_kv, axis=2)
 
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    visible = None
     if causal:
         q_idx = jnp.arange(q.shape[1])[:, None]
         k_idx = jnp.arange(k.shape[1])[None, :]
-        causal_mask = q_idx >= (k_idx - (k.shape[1] - q.shape[1]))
-        scores = jnp.where(causal_mask[None, None], scores, jnp.finfo(scores.dtype).min)
+        causal_mask = (q_idx >= (k_idx - (k.shape[1] - q.shape[1])))[None, None]
+        scores = jnp.where(causal_mask, scores, jnp.finfo(scores.dtype).min)
+        visible = causal_mask
     if mask is not None:
         scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+        visible = mask if visible is None else jnp.logical_and(visible, mask)
 
     weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if visible is None:
+        return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+    # a row with NO visible keys is zero, not the uniform-softmax mean of V that
+    # softmax(-inf row) would produce — matching ring and flash attention
+    weights = jnp.where(visible.any(axis=-1, keepdims=True), weights, 0)
     return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
 
 

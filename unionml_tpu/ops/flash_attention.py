@@ -19,10 +19,19 @@ Layout decisions (each mandated by the TPU memory system):
 - running-stats scratch is lane-replicated ``(block_q, 128)`` — a ``(block_q, 1)``
   buffer pads to a full lane register anyway and forces relayouts.
 
-Backward: ``jax.custom_vjp`` recomputes attention with the XLA reference
-implementation and differentiates through it — the memory win of the flash forward is
-preserved for inference and for activations under ``jax.checkpoint``; a fused pallas
-backward kernel is a later optimization.
+Backward: fused FlashAttention-2-style pallas kernels. The forward additionally
+saves the per-row logsumexp (``[B, H, Lq]``, lane-major blocks); the backward
+recomputes scores blockwise from it (``P = exp(S - lse)``), so the ``[L, L]``
+matrix never exists in HBM in either direction — training memory stays
+O(L * D + L), which is the whole point for long context. Two kernels:
+
+- ``dq``: grid ``(b, h, q_blocks, k_blocks)``, accumulating over k blocks;
+- ``dk/dv``: grid ``(b, h, k_blocks, q_blocks)``, accumulating over q blocks,
+  computed at full query-head resolution and group-summed afterward for GQA
+  (``jnp.repeat``'s transpose is a segment sum).
+
+``delta = rowsum(dO * O)`` (the softmax-Jacobian correction) is one cheap
+elementwise XLA reduction outside the kernels.
 
 Shapes: ``q: [B, Lq, H, D]``, ``k/v: [B, Lk, Hkv, D]`` with ``H % Hkv == 0``,
 ``D % 128 == 0``, and lengths divisible by the block size.
@@ -45,10 +54,11 @@ DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 _LANES = 128  # TPU vector lane width: stats scratch is lane-replicated
 _NEG_INF = float(jnp.finfo(jnp.float32).min)
+_BIG = 1e30  # lse sentinel for fully-masked rows: exp(S - BIG) == 0
 
 
 def _flash_fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch, acc_scratch, *, causal, block_q, block_k, scale, offset
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch, acc_scratch, *, causal, block_q, block_k, scale, offset
 ):
     # offset = k_len - q_len: with unequal lengths, query row i may attend keys up to
     # i + offset (matching dot_product_attention's shifted diagonal)
@@ -98,9 +108,17 @@ def _flash_fwd_kernel(
         l_final = l_scratch[:, :1]
         denom = jnp.where(l_final == 0.0, 1.0, l_final)
         o_ref[0, :, 0, :] = (acc_scratch[:] / denom).astype(o_ref.dtype)
+        # logsumexp per row, saved for the fused backward: P = exp(S - lse).
+        # Fully-masked rows get +BIG so the backward's exp underflows to 0.
+        lse = jnp.where(
+            l_final == 0.0, jnp.float32(_BIG), m_scratch[:, :1] + jnp.log(denom)
+        )
+        lse_ref[0, 0, :] = lse[:, 0]
 
 
-def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool, interpret: bool) -> jax.Array:
+def _flash_forward(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool, interpret: bool
+) -> "tuple[jax.Array, jax.Array]":
     batch, q_len, n_heads, head_dim = q.shape
     k_len, n_kv = k.shape[1], k.shape[2]
     if n_heads % n_kv:
@@ -125,47 +143,229 @@ def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool, inter
     kernel = functools.partial(
         _flash_fwd_kernel, causal=causal, block_q=block_q, block_k=block_k, scale=scale, offset=k_len - q_len
     )
-    compiler_params = None
-    if not interpret:
-        compiler_params = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
-        )
 
-    return pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((batch, n_heads, q_len), jnp.float32),
+        ),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, 1, head_dim), q_index),
             pl.BlockSpec((1, block_k, 1, head_dim), kv_index),
             pl.BlockSpec((1, block_k, 1, head_dim), kv_index),
         ],
-        out_specs=pl.BlockSpec((1, block_q, 1, head_dim), q_index),
+        out_specs=(
+            pl.BlockSpec((1, block_q, 1, head_dim), q_index),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, qi, ki: (b, h, qi)),
+        ),
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, head_dim), jnp.float32),
         ],
-        compiler_params=compiler_params,
+        compiler_params=_compiler_params(interpret),
         interpret=interpret,
     )(q, k, v)
+    return out, lse
+
+
+def _compiler_params(interpret: bool):
+    if interpret:
+        return None
+    return pltpu.CompilerParams(dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"))
+
+
+def _bwd_recompute(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki, *, causal, block_q, block_k, scale, offset):
+    """Shared backward prologue: recompute P = exp(S - lse) for one (qi, ki) tile
+    and return (q, k, ds, p, do) in f32 — the dq and dk/dv kernels consume the
+    same quantities, so masking/recompute fixes land in exactly one place."""
+    q = q_ref[0, :, 0, :].astype(jnp.float32)
+    k = k_ref[0, :, 0, :].astype(jnp.float32)
+    v = v_ref[0, :, 0, :].astype(jnp.float32)
+    do = do_ref[0, :, 0, :].astype(jnp.float32)
+    lse = lse_ref[0, 0, :][:, None]  # [block_q, 1]
+    delta = delta_ref[0, 0, :][:, None]
+
+    scores = scale * jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+        scores = jnp.where(q_pos + offset >= k_pos, scores, _NEG_INF)
+    p = jnp.exp(scores - lse)  # [block_q, block_k]; 0 for masked/empty rows (lse=BIG)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))
+    ds = p * (dp - delta)
+    return q, k, ds, p, do
+
+
+def _flash_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc, *, causal, block_q, block_k, scale, offset
+):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    num_k = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def _compute():
+        _, k, ds, _, _ = _bwd_recompute(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki,
+            causal=causal, block_q=block_q, block_k=block_k, scale=scale, offset=offset,
+        )
+        dq_acc[:] += scale * jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())))
+
+    if causal:
+        @pl.when(ki * block_k <= qi * block_q + block_q - 1 + offset)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        dq_ref[0, :, 0, :] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+    *, causal, block_q, block_k, scale, offset,
+):
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+    num_q = pl.num_programs(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _compute():
+        q, _, ds, p, do = _bwd_recompute(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki,
+            causal=causal, block_q=block_q, block_k=block_k, scale=scale, offset=offset,
+        )
+        dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())))
+        dk_acc[:] += scale * jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())))
+
+    if causal:
+        # skip q blocks entirely above this k block's (offset-shifted) diagonal
+        @pl.when(qi * block_q + block_q - 1 + offset >= ki * block_k)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(qi == num_q - 1)
+    def _finalize():
+        dk_ref[0, :, 0, :] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, :, 0, :] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, causal: bool, interpret: bool):
+    """FlashAttention-2-style fused backward: scores recomputed blockwise from the
+    saved logsumexp — the [L, L] matrix never touches HBM (the XLA autodiff
+    fallback materializes it, erasing the forward's memory win for training)."""
+    batch, q_len, n_heads, head_dim = q.shape
+    k_len, n_kv = k.shape[1], k.shape[2]
+    block_q = min(DEFAULT_BLOCK_Q, q_len)
+    block_k = min(DEFAULT_BLOCK_K, k_len)
+    scale = head_dim**-0.5
+    offset = k_len - q_len
+
+    # delta_i = rowsum(dO_i * O_i), the dS correction term; [B, H, Lq] like lse
+    delta = jnp.einsum(
+        "blhd,blhd->bhl", g.astype(jnp.float32), out.astype(jnp.float32)
+    )
+
+    def q_index(b, h, qi, ki):
+        return (b, qi, h, 0)
+
+    def kv_index_dq(b, h, qi, ki):
+        return (b, ki, h * n_kv // n_heads, 0)
+
+    def stats_index(b, h, qi, ki):
+        return (b, h, qi)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, causal=causal, block_q=block_q, block_k=block_k, scale=scale, offset=offset
+        ),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=(batch, n_heads, q_len // block_q, k_len // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, 1, head_dim), q_index),
+            pl.BlockSpec((1, block_k, 1, head_dim), kv_index_dq),
+            pl.BlockSpec((1, block_k, 1, head_dim), kv_index_dq),
+            pl.BlockSpec((1, block_q, 1, head_dim), q_index),
+            pl.BlockSpec((1, 1, block_q), stats_index),
+            pl.BlockSpec((1, 1, block_q), stats_index),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, 1, head_dim), q_index),
+        scratch_shapes=[pltpu.VMEM((block_q, head_dim), jnp.float32)],
+        compiler_params=_compiler_params(interpret),
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+
+    # dk/dv accumulate over q blocks (qi innermost); computed at full query-head
+    # resolution, then group-summed for GQA (repeat's transpose is a sum)
+    def kv_index_dkv(b, h, ki, qi):
+        return (b, ki, h * n_kv // n_heads, 0)
+
+    dk_full, dv_full = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, causal=causal, block_q=block_q, block_k=block_k, scale=scale, offset=offset
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((batch, k_len, n_heads, head_dim), k.dtype),
+            jax.ShapeDtypeStruct((batch, k_len, n_heads, head_dim), v.dtype),
+        ),
+        grid=(batch, n_heads, k_len // block_k, q_len // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, 1, head_dim), lambda b, h, ki, qi: (b, qi, h, 0)),
+            pl.BlockSpec((1, block_k, 1, head_dim), kv_index_dkv),
+            pl.BlockSpec((1, block_k, 1, head_dim), kv_index_dkv),
+            pl.BlockSpec((1, block_q, 1, head_dim), lambda b, h, ki, qi: (b, qi, h, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, ki, qi: (b, h, qi)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, ki, qi: (b, h, qi)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_k, 1, head_dim), lambda b, h, ki, qi: (b, ki, h, 0)),
+            pl.BlockSpec((1, block_k, 1, head_dim), lambda b, h, ki, qi: (b, ki, h, 0)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, head_dim), jnp.float32),
+            pltpu.VMEM((block_k, head_dim), jnp.float32),
+        ],
+        compiler_params=_compiler_params(interpret),
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+
+    if n_kv != n_heads:
+        group = n_heads // n_kv
+        dk = dk_full.reshape(batch, k_len, n_kv, group, head_dim).sum(axis=3).astype(k.dtype)
+        dv = dv_full.reshape(batch, k_len, n_kv, group, head_dim).sum(axis=3).astype(v.dtype)
+    else:
+        dk, dv = dk_full, dv_full
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash(q, k, v, causal, interpret):
-    return _flash_forward(q, k, v, causal, interpret)
+    out, _ = _flash_forward(q, k, v, causal, interpret)
+    return out
 
 
 def _flash_fwd_rule(q, k, v, causal, interpret):
-    return _flash_forward(q, k, v, causal, interpret), (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd_rule(causal, interpret, residuals, g):
-    from unionml_tpu.ops.attention import dot_product_attention
-
-    q, k, v = residuals
-    _, vjp = jax.vjp(lambda q_, k_, v_: dot_product_attention(q_, k_, v_, causal=causal), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = residuals
+    return _flash_backward(q, k, v, out, lse, g, causal, interpret)
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
