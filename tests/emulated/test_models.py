@@ -311,6 +311,41 @@ def test_llama_ring_attention_end_to_end():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
 
 
+def test_llama_ulysses_attention_end_to_end():
+    """Full decoder with impl='ulysses' under shard_map matches impl='xla' —
+    the all-to-all sequence-parallel path wired through the model library."""
+    cfg_u = LlamaConfig.tiny(attention_impl="ulysses", dtype=jnp.float32)
+    cfg_ref = LlamaConfig.tiny(attention_impl="xla", dtype=jnp.float32)
+    tokens = _tokens(2, 64, cfg_ref.vocab_size)
+    params = Llama(cfg_ref).init(RNG, tokens)["params"]
+
+    ref = Llama(cfg_ref).apply({"params": params}, tokens)
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    # heads (4) must divide the axis: sequence=4 (vs ring's 8)
+    mesh = MeshSpec(data=2, sequence=4).build()
+
+    def fwd(tokens_local, params):
+        import jax.numpy as jnp
+        from jax import lax
+
+        seq_idx = lax.axis_index("sequence")
+        local_len = tokens_local.shape[1]
+        positions = seq_idx * local_len + jnp.arange(local_len)
+        return Llama(cfg_u).apply({"params": params}, tokens_local, positions)
+
+    out = shard_map(
+        fwd,
+        mesh=mesh,
+        in_specs=(P("data", "sequence"), P()),
+        out_specs=P("data", "sequence", None),
+        check_vma=False,
+    )(tokens, params)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
 # ---------------------------------------------------------------- bert / vit / mlp
 
 
