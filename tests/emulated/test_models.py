@@ -278,69 +278,43 @@ def test_llama_lora_freezes_base_params():
     assert not np.array_equal(np.asarray(lora_before), np.asarray(lora_after))
 
 
-def test_llama_ring_attention_end_to_end():
-    """Full decoder with impl='ring' under shard_map matches impl='xla'."""
-    cfg_ring = LlamaConfig.tiny(attention_impl="ring", dtype=jnp.float32)
+@pytest.mark.parametrize(
+    "impl,mesh_axes,batch_entry",
+    [
+        ("ring", dict(data=1, sequence=8), None),
+        # ulysses: the sequence-axis size (4) must divide the head count
+        # (4 after GQA expansion), so it runs on a smaller sequence axis
+        ("ulysses", dict(data=2, sequence=4), "data"),
+    ],
+)
+def test_llama_sequence_parallel_end_to_end(impl, mesh_axes, batch_entry):
+    """Full decoder under shard_map with each sequence-parallel impl matches
+    impl='xla': ring (K/V rotation) and ulysses (all-to-all) wired through the
+    model library."""
+    cfg_sp = LlamaConfig.tiny(attention_impl=impl, dtype=jnp.float32)
     cfg_ref = LlamaConfig.tiny(attention_impl="xla", dtype=jnp.float32)
     tokens = _tokens(2, 64, cfg_ref.vocab_size)
     params = Llama(cfg_ref).init(RNG, tokens)["params"]
 
     ref = Llama(cfg_ref).apply({"params": params}, tokens)
 
-    from jax import shard_map
+    from jax import lax, shard_map
     from jax.sharding import PartitionSpec as P
 
-    mesh = MeshSpec(data=1, sequence=8).build()
+    mesh = MeshSpec(**mesh_axes).build()
+
     # positions must be the *global* positions of the local shard: pass explicitly
     def fwd(tokens_local, params):
-        import jax.numpy as jnp
-        from jax import lax
-
         seq_idx = lax.axis_index("sequence")
         local_len = tokens_local.shape[1]
         positions = seq_idx * local_len + jnp.arange(local_len)
-        return Llama(cfg_ring).apply({"params": params}, tokens_local, positions)
+        return Llama(cfg_sp).apply({"params": params}, tokens_local, positions)
 
     out = shard_map(
         fwd,
         mesh=mesh,
-        in_specs=(P(None, "sequence"), P()),
-        out_specs=P(None, "sequence", None),
-        check_vma=False,
-    )(tokens, params)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
-
-
-def test_llama_ulysses_attention_end_to_end():
-    """Full decoder with impl='ulysses' under shard_map matches impl='xla' —
-    the all-to-all sequence-parallel path wired through the model library."""
-    cfg_u = LlamaConfig.tiny(attention_impl="ulysses", dtype=jnp.float32)
-    cfg_ref = LlamaConfig.tiny(attention_impl="xla", dtype=jnp.float32)
-    tokens = _tokens(2, 64, cfg_ref.vocab_size)
-    params = Llama(cfg_ref).init(RNG, tokens)["params"]
-
-    ref = Llama(cfg_ref).apply({"params": params}, tokens)
-
-    from jax import shard_map
-    from jax.sharding import PartitionSpec as P
-
-    # heads (4) must divide the axis: sequence=4 (vs ring's 8)
-    mesh = MeshSpec(data=2, sequence=4).build()
-
-    def fwd(tokens_local, params):
-        import jax.numpy as jnp
-        from jax import lax
-
-        seq_idx = lax.axis_index("sequence")
-        local_len = tokens_local.shape[1]
-        positions = seq_idx * local_len + jnp.arange(local_len)
-        return Llama(cfg_u).apply({"params": params}, tokens_local, positions)
-
-    out = shard_map(
-        fwd,
-        mesh=mesh,
-        in_specs=(P("data", "sequence"), P()),
-        out_specs=P("data", "sequence", None),
+        in_specs=(P(batch_entry, "sequence"), P()),
+        out_specs=P(batch_entry, "sequence", None),
         check_vma=False,
     )(tokens, params)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
