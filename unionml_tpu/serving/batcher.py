@@ -115,6 +115,7 @@ class MicroBatcher:
         self._pad_to_bucket = self.config.pad_to_bucket if pad_to_bucket is None else pad_to_bucket
         self._queue: "asyncio.Queue[Tuple[Any, int, asyncio.Future]]" = asyncio.Queue()
         self._worker: Optional[asyncio.Task] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
 
     def _padding_active(self) -> bool:
         if callable(self._pad_to_bucket):
@@ -122,8 +123,25 @@ class MicroBatcher:
         return bool(self._pad_to_bucket)
 
     def start(self) -> None:
-        if self._worker is None or self._worker.done():
-            self._worker = asyncio.get_event_loop().create_task(self._run())
+        loop = asyncio.get_event_loop()
+        if self._worker is not None and not self._worker.done() and self._loop is loop:
+            return
+        if self._loop is not loop:
+            # the previous loop is gone (each asyncio.run creates a fresh loop —
+            # the test-client surface, or a serve/stop/serve cycle): rebind the
+            # queue + worker, otherwise submit() would enqueue onto a dead
+            # loop's queue and hang. Requests stranded on the dead loop cannot
+            # be completed (their futures belong to it) and are dropped with it.
+            if self._worker is not None and not self._worker.done():
+                try:
+                    self._worker.cancel()  # foreign-loop task: cancel best-effort
+                except RuntimeError:  # its loop is already closed
+                    pass
+            self._queue = asyncio.Queue()
+            self._loop = loop
+        # same loop: keep the queue — a restarted worker (e.g. after stop())
+        # must drain any backlog already enqueued
+        self._worker = loop.create_task(self._run())
 
     async def stop(self) -> None:
         if self._worker is not None:
