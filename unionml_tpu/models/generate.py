@@ -1,0 +1,230 @@
+"""Autoregressive generation engine: bucketed jitted prefill + one-compile decode loop.
+
+The reference has no inference engine at all (it serves whatever ``model.predict``
+does eagerly, unionml/fastapi.py:50-64); for the LLM family that leaves the flagship
+model unservable. This module is the TPU-native answer, built on the same rules as
+the serving layer's :class:`~unionml_tpu.serving.compile.CompiledPredictor`:
+
+- **static shapes only**: prompts are padded to configured length buckets, the KV
+  cache is a fixed ``[B, S_max, H_kv, D]`` ring of buffers, and the decode loop is a
+  ``lax.scan`` over ``max_new_tokens`` steps — XLA sees ``len(buckets)`` prefill
+  shapes and exactly one decode shape per (batch, cache_len);
+- **per-example contiguous cache rows**: variable-length prompts are right-padded
+  and each example's K/V rows are written at its own offsets
+  (:func:`~unionml_tpu.models.layers._write_cache`), so no left-padding or position
+  remapping is needed and RoPE positions equal cache slots;
+- **cache donation**: prefill and every decode dispatch donate the cache buffers,
+  so HBM holds one cache, not two;
+- **mesh placement**: with a mesh + partition rules the params are placed sharded
+  (e.g. megatron TP via :func:`~unionml_tpu.models.llama.llama_partition_rules`) and
+  the cache is sharded batch-over-``data`` / heads-over-``model``; XLA inserts the
+  collectives, identical tokens come out (tests/emulated/test_generate.py).
+
+Works with any flax module following the :class:`~unionml_tpu.models.llama.Llama`
+cache contract: ``apply(vars, tokens, positions=[B,L], cache=...) -> (out, cache)``
+(and ``return_hidden=True`` giving pre-head hidden states so prefill never
+materializes a ``[B, P, vocab]`` logits tensor).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from unionml_tpu._logging import logger
+
+__all__ = ["GenerationConfig", "Generator", "init_cache", "sample_tokens"]
+
+
+@dataclasses.dataclass(frozen=True)
+class GenerationConfig:
+    """Decoding knobs. ``temperature == 0`` means greedy (argmax) decoding;
+    ``top_k``/``top_p`` filter the distribution before sampling."""
+
+    max_new_tokens: int = 128
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    eos_id: Optional[int] = None
+    pad_id: int = 0
+    #: prompt-length buckets; a batch's prompts are padded to the smallest bucket
+    #: that fits, so XLA compiles at most ``len(prompt_buckets)`` prefill shapes
+    prompt_buckets: Tuple[int, ...] = (64, 256, 1024)
+
+
+def init_cache(config: Any, batch: int, cache_len: int) -> Tuple[Any, ...]:
+    """Zeroed per-layer KV buffers for a decoder with ``config.n_layers`` layers,
+    ``config.n_kv_heads`` KV heads and head_dim ``dim // n_heads``, stored in the
+    compute dtype (bf16 on TPU — halves cache HBM vs f32)."""
+    head_dim = config.dim // config.n_heads
+    shape = (batch, cache_len, config.n_kv_heads, head_dim)
+    return tuple(
+        {"k": jnp.zeros(shape, config.dtype), "v": jnp.zeros(shape, config.dtype)}
+        for _ in range(config.n_layers)
+    )
+
+
+def sample_tokens(logits: jax.Array, key: jax.Array, config: GenerationConfig) -> jax.Array:
+    """Sample next tokens from ``logits [B, V]`` under the config's decoding policy."""
+    if config.temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / config.temperature
+    if config.top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -config.top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if config.top_p < 1.0:
+        sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        exclusive_cum = jnp.cumsum(probs, axis=-1) - probs
+        # keep the smallest prefix whose mass reaches top_p; the lowest kept logit
+        # becomes the cutoff mapped back onto the unsorted axis
+        dropped = exclusive_cum >= config.top_p
+        min_kept = jnp.min(jnp.where(dropped, jnp.inf, sorted_desc), axis=-1, keepdims=True)
+        logits = jnp.where(logits < min_kept, -jnp.inf, logits)
+    return jax.random.categorical(key, logits).astype(jnp.int32)
+
+
+class Generator:
+    """Batch text generation over a cached decoder.
+
+    >>> gen = Generator(module, params, GenerationConfig(max_new_tokens=64))
+    >>> tokens = gen([[1, 5, 9], [3, 3]], seed=0)   # [2, 64] int32
+
+    ``prefill_traces`` / ``decode_traces`` count XLA traces; within the configured
+    prompt buckets and a fixed batch size they stay at (<= len(buckets), 1).
+    """
+
+    def __init__(
+        self,
+        module: Any,
+        params: Any,
+        config: GenerationConfig = GenerationConfig(),
+        *,
+        mesh: Optional[Any] = None,
+        partition_rules: Optional[Any] = None,
+    ):
+        self.module = module
+        self.config = config
+        self.mesh = mesh
+        self.prefill_traces = 0
+        self.decode_traces = 0
+
+        if mesh is not None:
+            from unionml_tpu.parallel.sharding import combine_fsdp_tp, shard_pytree, unbox_partitioned
+
+            shardings = combine_fsdp_tp(params, mesh, partition_rules)
+            params = shard_pytree(unbox_partitioned(params), shardings)
+        self.params = params
+
+        def apply(p: Any, tokens: jax.Array, positions: jax.Array, cache: Any):
+            hidden, cache = module.apply(
+                {"params": p}, tokens, positions=positions, return_hidden=True, cache=cache
+            )
+            return hidden, cache
+
+        def head(p: Any, hidden: jax.Array) -> jax.Array:
+            kernel = p["lm_head"]["kernel"]
+            return (hidden @ kernel.astype(hidden.dtype)).astype(jnp.float32)
+
+        def prefill(p, tokens, lengths, cache, key):
+            self.prefill_traces += 1
+            batch, prompt_len = tokens.shape
+            positions = jnp.broadcast_to(jnp.arange(prompt_len)[None], (batch, prompt_len))
+            hidden, cache = apply(p, tokens, positions, cache)
+            last = jnp.take_along_axis(hidden, (lengths - 1)[:, None, None], axis=1)[:, 0]
+            tok0 = sample_tokens(head(p, last), key, config)
+            return tok0, cache
+
+        def decode(p, cache, tok0, lengths, key):
+            """Feed tok0 (sampled from the prompt) and roll max_new_tokens-1 steps."""
+            self.decode_traces += 1
+            eos = config.eos_id
+
+            def body(carry, _):
+                cache, tok, lengths, done, key = carry
+                key, sub = jax.random.split(key)
+                positions = lengths[:, None]  # each example's next free cache slot
+                hidden, cache = apply(p, tok[:, None], positions, cache)
+                nxt = sample_tokens(head(p, hidden[:, 0]), sub, config)
+                nxt = jnp.where(done, jnp.int32(config.pad_id), nxt)
+                lengths = lengths + jnp.where(done, 0, 1)
+                if eos is not None:
+                    done = done | (nxt == eos)
+                return (cache, nxt, lengths, done, key), nxt
+
+            done = (tok0 == eos) if eos is not None else jnp.zeros(tok0.shape, bool)
+            steps = config.max_new_tokens - 1
+            if steps <= 0:
+                return tok0[:, None], lengths, cache
+            (cache, _, lengths, _, _), rest = jax.lax.scan(
+                body, (cache, tok0, lengths, done, key), None, length=steps
+            )
+            # the final cache is returned (and dropped by the caller) so the donated
+            # input buffers have an output to alias with — one cache in HBM throughout
+            return jnp.concatenate([tok0[:, None], rest.T], axis=1), lengths, cache
+
+        # donate the cache through both stages: one cache lives in HBM, not two
+        self._prefill = jax.jit(prefill, donate_argnums=(3,))
+        self._decode = jax.jit(decode, donate_argnums=(1,))
+
+    # ------------------------------------------------------------------ helpers
+
+    def _bucket(self, max_prompt: int) -> int:
+        for b in sorted(self.config.prompt_buckets):
+            if b >= max_prompt:
+                return b
+        # oversized prompt: one extra trace at the next multiple of 64, logged
+        bucket = int(math.ceil(max_prompt / 64) * 64)
+        logger.info(f"prompt length {max_prompt} exceeds configured buckets; padding to {bucket}")
+        return bucket
+
+    def _place_cache(self, cache: Any) -> Any:
+        if self.mesh is None:
+            return cache
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def spec(a: jax.Array) -> NamedSharding:
+            data = "data" if "data" in self.mesh.axis_names else None
+            model = "model" if "model" in self.mesh.axis_names else None
+            if model is not None and a.shape[2] % self.mesh.shape["model"] != 0:
+                model = None  # KV heads not divisible by the model axis: replicate heads
+            return NamedSharding(self.mesh, P(data, None, model, None))
+
+        return jax.tree_util.tree_map(lambda a: jax.device_put(a, spec(a)), cache)
+
+    # ------------------------------------------------------------------ generate
+
+    def __call__(self, prompts: Sequence[Sequence[int]], *, seed: int = 0) -> np.ndarray:
+        """Generate ``max_new_tokens`` per prompt; returns ``[len(prompts), max_new]``
+        int32 (``pad_id`` after each example's ``eos_id``)."""
+        cfg = self.config
+        n = len(prompts)
+        lengths = np.array([max(len(p), 1) for p in prompts], np.int32)
+        bucket = self._bucket(int(lengths.max()))
+        # pad the batch to a power of two so XLA sees few batch shapes — and to a
+        # multiple of the mesh's data axis so the cache's batch dim shards evenly
+        batch = 1 << max(0, (n - 1).bit_length())
+        if self.mesh is not None and "data" in self.mesh.axis_names:
+            data = int(self.mesh.shape["data"])
+            batch = int(math.ceil(batch / data) * data)
+        tokens = np.full((batch, bucket), cfg.pad_id, np.int32)
+        for i, p in enumerate(prompts):
+            tokens[i, : len(p)] = np.asarray(p, np.int32)
+        all_lengths = np.ones((batch,), np.int32)
+        all_lengths[:n] = lengths
+
+        cache_len = max(bucket, max(cfg.prompt_buckets, default=0)) + cfg.max_new_tokens
+        cache = self._place_cache(init_cache(self.module.config, batch, cache_len))
+        key = jax.random.PRNGKey(seed)
+        key, prefill_key = jax.random.split(key)
+        tok0, cache = self._prefill(
+            self.params, jnp.asarray(tokens), jnp.asarray(all_lengths), cache, prefill_key
+        )
+        out, _, _ = self._decode(self.params, cache, tok0, jnp.asarray(all_lengths), key)
+        return np.asarray(out)[:n]

@@ -14,15 +14,28 @@ model library backing the BASELINE.json configs. Conventions:
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
+from jax import lax
 
 from unionml_tpu.ops.attention import multihead_attention
 
 Dtype = Any
+
+#: One layer's KV cache: ``{"k": [B, S_max, H_kv, D], "v": [B, S_max, H_kv, D]}``.
+LayerCache = Dict[str, jax.Array]
+
+
+def _write_cache(buffer: jax.Array, new: jax.Array, starts: jax.Array) -> jax.Array:
+    """Write ``new: [B, L, H, D]`` into ``buffer: [B, S_max, H, D]`` at per-example
+    row offsets ``starts: [B]`` (each example's sequence is contiguous in its own
+    cache rows, so variable-length prompts need no left-padding)."""
+    return jax.vmap(lambda buf, upd, s: lax.dynamic_update_slice(buf, upd, (s, 0, 0)))(
+        buffer, new.astype(buffer.dtype), starts
+    )
 
 
 class RMSNorm(nn.Module):
@@ -107,8 +120,12 @@ class Attention(nn.Module):
 
     @nn.compact
     def __call__(
-        self, x: jax.Array, positions: Optional[jax.Array] = None, mask: Optional[jax.Array] = None
-    ) -> jax.Array:
+        self,
+        x: jax.Array,
+        positions: Optional[jax.Array] = None,
+        mask: Optional[jax.Array] = None,
+        cache: Optional[LayerCache] = None,
+    ) -> Any:
         features = x.shape[-1]
         n_kv = self.n_kv_heads or self.n_heads
         head_dim = self.head_dim or features // self.n_heads
@@ -130,6 +147,30 @@ class Attention(nn.Module):
                 positions = jnp.arange(length)
             q = rotary_embedding(q, positions, self.rope_theta)
             k = rotary_embedding(k, positions, self.rope_theta)
+
+        if cache is not None:
+            # Incremental decoding: the new rows' K/V land in the cache at each
+            # example's next free slots (= the absolute positions), and attention
+            # runs over the full static-shape buffer with an explicit visibility
+            # mask — key slot j is visible to the query at absolute position p
+            # iff j <= p, which is causal over everything written so far and
+            # hides slots not yet (re)written. Static shapes throughout: the
+            # decode step compiles exactly once per (batch, cache_len).
+            if positions is None or positions.ndim != 2:
+                raise ValueError("cached attention requires per-example positions [B, L]")
+            if mask is not None:
+                raise NotImplementedError("cached attention builds its own mask")
+            cache = {
+                "k": _write_cache(cache["k"], k, positions[:, 0]),
+                "v": _write_cache(cache["v"], v, positions[:, 0]),
+            }
+            slot = jnp.arange(cache["k"].shape[1])
+            visible = slot[None, None, None, :] <= positions[:, None, :, None]  # [B,1,L,S_max]
+            out = multihead_attention(
+                q, cache["k"].astype(q.dtype), cache["v"].astype(q.dtype), causal=False, mask=visible, impl="xla"
+            )
+            out = out.reshape(batch, length, self.n_heads * head_dim)
+            return dense(features, "o_proj")(out), cache
 
         if self.impl in ("ring", "ulysses"):
             if mask is not None:
@@ -184,14 +225,18 @@ class TransformerBlock(nn.Module):
 
     @nn.compact
     def __call__(
-        self, x: jax.Array, positions: Optional[jax.Array] = None, mask: Optional[jax.Array] = None
-    ) -> jax.Array:
+        self,
+        x: jax.Array,
+        positions: Optional[jax.Array] = None,
+        mask: Optional[jax.Array] = None,
+        cache: Optional[LayerCache] = None,
+    ) -> Any:
         norm = (
             (lambda name: RMSNorm(dtype=self.dtype, name=name))
             if self.decoder
             else (lambda name: nn.LayerNorm(dtype=self.dtype, name=name))
         )
-        x = x + Attention(
+        attn_out = Attention(
             n_heads=self.n_heads,
             n_kv_heads=self.n_kv_heads,
             causal=self.decoder,
@@ -202,7 +247,10 @@ class TransformerBlock(nn.Module):
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             name="attn",
-        )(norm("attn_norm")(x), positions, mask)
+        )(norm("attn_norm")(x), positions, mask, cache)
+        if cache is not None:
+            attn_out, cache = attn_out
+        x = x + attn_out
         x = x + MLP(
             hidden_dim=self.hidden_dim,
             gated=self.decoder,
@@ -211,4 +259,4 @@ class TransformerBlock(nn.Module):
             param_dtype=self.param_dtype,
             name="mlp",
         )(norm("mlp_norm")(x))
-        return x
+        return (x, cache) if cache is not None else x

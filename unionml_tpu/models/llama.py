@@ -11,7 +11,7 @@ TP + fsdp) against the param tree, the sequence axis rides
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -65,8 +65,16 @@ class Llama(nn.Module):
 
     @nn.compact
     def __call__(
-        self, tokens: jax.Array, positions: Optional[jax.Array] = None, return_hidden: bool = False
-    ) -> jax.Array:
+        self,
+        tokens: jax.Array,
+        positions: Optional[jax.Array] = None,
+        return_hidden: bool = False,
+        cache: Optional[Tuple[Any, ...]] = None,
+    ) -> Any:
+        """``cache`` (one :data:`~unionml_tpu.models.layers.LayerCache` per layer,
+        see :func:`unionml_tpu.models.generate.init_cache`) switches the stack into
+        incremental-decoding mode: the return value becomes ``(out, new_cache)``
+        and ``positions`` must be per-example absolute positions ``[B, L]``."""
         cfg = self.config
         x = nn.Embed(
             cfg.vocab_size, cfg.dim, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="embed"
@@ -77,8 +85,9 @@ class Llama(nn.Module):
         block_cls = TransformerBlock
         if cfg.remat:
             block_cls = nn.remat(TransformerBlock, static_argnums=())
+        new_cache = []
         for i in range(cfg.n_layers):
-            x = block_cls(
+            block = block_cls(
                 n_heads=cfg.n_heads,
                 n_kv_heads=cfg.n_kv_heads,
                 hidden_dim=cfg.hidden_dim,
@@ -90,19 +99,24 @@ class Llama(nn.Module):
                 dtype=cfg.dtype,
                 param_dtype=cfg.param_dtype,
                 name=f"layer_{i}",
-            )(x, positions)
+            )
+            if cache is not None:
+                x, layer_cache = block(x, positions, None, cache[i])
+                new_cache.append(layer_cache)
+            else:
+                x = block(x, positions)
 
         x = RMSNorm(dtype=cfg.dtype, name="final_norm")(x)
         if return_hidden:
             # pre-head hidden states for chunked-loss paths; init always runs with
             # return_hidden=False so the lm_head params exist in the tree (flax
             # ignores unvisited params at apply time)
-            return x
+            return (x, tuple(new_cache)) if cache is not None else x
         # untied LM head (kept separate so vocab-parallel TP sharding is per-rule)
         logits = nn.Dense(
             cfg.vocab_size, use_bias=False, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="lm_head"
         )(x)
-        return logits
+        return (logits, tuple(new_cache)) if cache is not None else logits
 
 
 def llama_partition_rules() -> PartitionRules:
