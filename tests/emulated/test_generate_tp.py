@@ -40,3 +40,19 @@ def test_sharded_generation_matches_unsharded(spec):
     np.testing.assert_array_equal(sharded(prompts), expected)
     # a single prompt must also shard (batch pads up to the data-axis size)
     np.testing.assert_array_equal(sharded([prompts[0]]), expected[:1])
+
+
+def test_quantized_sharded_generation_matches_quantized_unsharded():
+    """int8 weights + TP mesh: the QuantizedTensor pytree (int8 q + size-1-dim
+    scales) must place under the kernel partition rules and emit the same tokens
+    as quantized single-device generation."""
+    module, params = _tiny()
+    cfg = GenerationConfig(max_new_tokens=8, temperature=0.0, prompt_buckets=(16,))
+    prompts = [[3, 1, 4, 1, 5], [9, 2, 6], [7, 1, 8, 2], [2, 7]]
+
+    expected = Generator(module, params, cfg, quantize="int8")(prompts)
+    mesh = MeshSpec(data=2, fsdp=2, model=2).build()
+    sharded = Generator(
+        module, params, cfg, mesh=mesh, partition_rules=llama_partition_rules(), quantize="int8"
+    )
+    np.testing.assert_array_equal(sharded(prompts), expected)

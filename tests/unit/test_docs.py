@@ -24,6 +24,7 @@ def test_site_builds_all_pages(tmp_path):
         "quickstart.html",
         "tpu-training.html",
         "parallelism.html",
+        "generation.html",
         "serving.html",
         "remote.html",
         "benchmarks.html",

@@ -1,0 +1,77 @@
+"""Weight-only int8 quantization correctness."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from unionml_tpu.models import GenerationConfig, Generator, Llama, LlamaConfig
+from unionml_tpu.ops.quant import QuantizedTensor, dequantize, dequantize_tree, quantize_array, quantize_params
+
+
+def test_roundtrip_error_bound():
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(256, 512)).astype(np.float32) * rng.uniform(0.01, 10, size=(1, 512))
+    qt = quantize_array(w)
+    assert qt.q.dtype == jnp.int8 and qt.q.shape == w.shape
+    back = np.asarray(dequantize(qt, jnp.float32))
+    # symmetric per-channel int8: error per element <= scale/2 = abs_max/254
+    col_max = np.abs(w).max(axis=0)
+    assert (np.abs(back - w) <= col_max / 254 + 1e-6).all()
+
+
+def test_quantize_params_selects_matmul_kernels_only():
+    config = LlamaConfig.tiny(dtype=jnp.float32, param_dtype=jnp.float32)
+    module = Llama(config)
+    params = module.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    qparams = quantize_params(params, min_size=1)
+
+    flat = {
+        "/".join(str(getattr(p, "key", p)) for p in path): leaf
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+            qparams, is_leaf=lambda x: isinstance(x, QuantizedTensor)
+        )[0]
+    }
+    assert isinstance(flat["layer_0/attn/q_proj/kernel"], QuantizedTensor)
+    assert isinstance(flat["layer_0/mlp/wi/kernel"], QuantizedTensor)
+    assert isinstance(flat["lm_head/kernel"], QuantizedTensor)
+    assert not isinstance(flat["embed/embedding"], QuantizedTensor)  # gathers, not matmuls
+    assert not isinstance(flat["final_norm/scale"], QuantizedTensor)
+
+
+def test_quantized_forward_stays_close():
+    config = LlamaConfig.tiny(dtype=jnp.float32, param_dtype=jnp.float32)
+    module = Llama(config)
+    params = module.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    tokens = jnp.asarray([[3, 1, 4, 1, 5, 9, 2, 6]], jnp.int32)
+
+    ref = module.apply({"params": params}, tokens)
+    deq = dequantize_tree(quantize_params(params, min_size=1), dtype=jnp.float32)
+    out = module.apply({"params": deq}, tokens)
+    # logits drift stays small relative to the logits' own scale
+    denom = float(jnp.abs(ref).max())
+    assert float(jnp.abs(out - ref).max()) / denom < 0.05
+
+
+def test_quantized_generation_runs_and_is_deterministic():
+    config = LlamaConfig.tiny(dtype=jnp.float32, param_dtype=jnp.float32)
+    module = Llama(config)
+    params = module.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    gen = Generator(
+        module, params,
+        GenerationConfig(max_new_tokens=8, temperature=0.0, prompt_buckets=(16,)),
+        quantize="int8",
+    )
+    prompts = [[5, 6, 7], [1, 2, 3, 4, 5, 6]]
+    out = gen(prompts)
+    assert out.shape == (2, 8)
+    np.testing.assert_array_equal(out, gen(prompts))
+
+
+def test_unsupported_mode_rejected():
+    config = LlamaConfig.tiny(dtype=jnp.float32, param_dtype=jnp.float32)
+    module = Llama(config)
+    params = module.init(jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32))["params"]
+    with pytest.raises(ValueError, match="int8"):
+        Generator(module, params, GenerationConfig(), quantize="fp4")

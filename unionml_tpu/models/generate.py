@@ -18,7 +18,7 @@ the serving layer's :class:`~unionml_tpu.serving.compile.CompiledPredictor`:
 - **mesh placement**: with a mesh + partition rules the params are placed sharded
   (e.g. megatron TP via :func:`~unionml_tpu.models.llama.llama_partition_rules`) and
   the cache is sharded batch-over-``data`` / heads-over-``model``; XLA inserts the
-  collectives, identical tokens come out (tests/emulated/test_generate.py).
+  collectives, identical tokens come out (tests/emulated/test_generate_tp.py).
 
 Works with any flax module following the :class:`~unionml_tpu.models.llama.Llama`
 cache contract: ``apply(vars, tokens, positions=[B,L], cache=...) -> (out, cache)``
@@ -70,6 +70,28 @@ def init_cache(config: Any, batch: int, cache_len: int) -> Tuple[Any, ...]:
     )
 
 
+def _quantized_shardings(qparams: Any, shardings: Any, mesh: Any) -> Any:
+    """Expand a (pre-quantization) sharding tree to match a quantized params tree:
+    each :class:`~unionml_tpu.ops.quant.QuantizedTensor` leaf becomes a
+    QuantizedTensor of shardings — the int8 values take the kernel's resolved
+    sharding, and the per-channel ``scale`` keeps only the axes on its non-unit
+    dims (size-1 reduction dims cannot carry a mesh axis)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from unionml_tpu.ops.quant import QuantizedTensor
+
+    def fix(leaf: Any, sharding: Any) -> Any:
+        if not isinstance(leaf, QuantizedTensor):
+            return sharding
+        spec = tuple(sharding.spec) + (None,) * (len(leaf.scale.shape) - len(tuple(sharding.spec)))
+        scale_spec = tuple(None if dim == 1 else axis for dim, axis in zip(leaf.scale.shape, spec))
+        return QuantizedTensor(q=sharding, scale=NamedSharding(mesh, P(*scale_spec)))
+
+    return jax.tree_util.tree_map(
+        fix, qparams, shardings, is_leaf=lambda x: isinstance(x, QuantizedTensor)
+    )
+
+
 def sample_tokens(logits: jax.Array, key: jax.Array, config: GenerationConfig) -> jax.Array:
     """Sample next tokens from ``logits [B, V]`` under the config's decoding policy."""
     if config.temperature == 0.0:
@@ -108,19 +130,50 @@ class Generator:
         *,
         mesh: Optional[Any] = None,
         partition_rules: Optional[Any] = None,
+        quantize: Optional[str] = None,
     ):
         self.module = module
         self.config = config
         self.mesh = mesh
         self.prefill_traces = 0
         self.decode_traces = 0
+        compute_dtype = getattr(getattr(module, "config", None), "dtype", jnp.bfloat16)
+
+        if quantize not in (None, "int8"):
+            raise ValueError(f"unsupported quantize mode {quantize!r}; expected None or 'int8'")
 
         if mesh is not None:
             from unionml_tpu.parallel.sharding import combine_fsdp_tp, shard_pytree, unbox_partitioned
 
+            # resolve shardings from the still-boxed tree so nn.Partitioned
+            # metadata keeps its precedence over regex rules / inferred FSDP,
+            # then unbox (the sharding tree matches the unboxed structure)
             shardings = combine_fsdp_tp(params, mesh, partition_rules)
-            params = shard_pytree(unbox_partitioned(params), shardings)
+            params = unbox_partitioned(params)
+            if quantize == "int8":
+                from unionml_tpu.ops.quant import quantize_params
+
+                params = quantize_params(params)
+                shardings = _quantized_shardings(params, shardings, mesh)
+            params = shard_pytree(params, shardings)
+        else:
+            from unionml_tpu.parallel.sharding import unbox_partitioned
+
+            params = unbox_partitioned(params)
+            if quantize == "int8":
+                from unionml_tpu.ops.quant import quantize_params
+
+                params = quantize_params(params)
         self.params = params
+
+        if quantize == "int8":
+            from unionml_tpu.ops.quant import dequantize_tree
+
+            # called inside jit (and inside the decode scan body): XLA fuses the
+            # int8->compute convert into consumers; int8 is what crosses HBM
+            dequant = lambda p: dequantize_tree(p, dtype=compute_dtype)  # noqa: E731
+        else:
+            dequant = lambda p: p  # noqa: E731
 
         def apply(p: Any, tokens: jax.Array, positions: jax.Array, cache: Any):
             hidden, cache = module.apply(
@@ -134,6 +187,7 @@ class Generator:
 
         def prefill(p, tokens, lengths, cache, key):
             self.prefill_traces += 1
+            p = dequant(p)
             batch, prompt_len = tokens.shape
             positions = jnp.broadcast_to(jnp.arange(prompt_len)[None], (batch, prompt_len))
             hidden, cache = apply(p, tokens, positions, cache)
@@ -149,9 +203,10 @@ class Generator:
             def body(carry, _):
                 cache, tok, lengths, done, key = carry
                 key, sub = jax.random.split(key)
+                ps = dequant(p)  # per-step so int8, not bf16, is the steady-state HBM read
                 positions = lengths[:, None]  # each example's next free cache slot
-                hidden, cache = apply(p, tok[:, None], positions, cache)
-                nxt = sample_tokens(head(p, hidden[:, 0]), sub, config)
+                hidden, cache = apply(ps, tok[:, None], positions, cache)
+                nxt = sample_tokens(head(ps, hidden[:, 0]), sub, config)
                 nxt = jnp.where(done, jnp.int32(config.pad_id), nxt)
                 lengths = lengths + jnp.where(done, 0, 1)
                 if eos is not None:

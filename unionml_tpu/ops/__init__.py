@@ -1,6 +1,13 @@
-"""TPU compute ops: attention kernels (XLA reference, pallas flash, ring/SP)."""
+"""TPU compute ops: attention kernels (XLA reference, pallas flash, ring/SP), int8 quant."""
 
 from unionml_tpu.ops.attention import dot_product_attention, multihead_attention  # noqa: F401
+from unionml_tpu.ops.quant import (  # noqa: F401
+    QuantizedTensor,
+    dequantize,
+    dequantize_tree,
+    quantize_array,
+    quantize_params,
+)
 from unionml_tpu.ops.ring_attention import (  # noqa: F401
     ring_attention,
     sequence_sharded_attention,

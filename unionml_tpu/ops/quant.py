@@ -1,0 +1,122 @@
+"""Weight-only int8 quantization for inference.
+
+No reference counterpart (the reference has no inference engine, SURVEY.md §0);
+this is a TPU-native serving optimization. Small-batch autoregressive decode is
+HBM-bandwidth bound — every step streams the full parameter bytes once — so
+storing weights as int8 with per-output-channel scales halves the bytes per step
+and, on the roofline, doubles decode throughput. Accuracy: per-channel symmetric
+int8 on transformer matmul weights is the standard lossless-in-practice setting
+(GPTQ/AWQ quantize further, to 4-bit, from this baseline).
+
+Mechanics: :func:`quantize_params` rewrites selected 2D+ leaves of a params
+pytree into :class:`QuantizedTensor` (int8 values + f32 per-channel scale, a
+registered pytree so it flows through jit/donation/sharding untouched);
+:func:`dequantize_tree` maps back to the compute dtype *inside* the jitted
+computation, where XLA fuses the ``convert + multiply`` into the consumer's
+HLO — the int8 bytes are what crosses HBM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["QuantizedTensor", "quantize_array", "quantize_params", "dequantize", "dequantize_tree"]
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class QuantizedTensor:
+    """Symmetric per-channel int8 weight: ``w ≈ q * scale`` with ``q`` int8 and
+    ``scale`` broadcast over the quantization axis (default: per output channel,
+    i.e. per trailing-dim column)."""
+
+    q: jax.Array  # int8, same shape as the original weight
+    scale: jax.Array  # f32, shape = weight shape with the reduction axes set to 1
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):
+        return self.q.dtype
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, _, children):
+        return cls(*children)
+
+
+def quantize_array(w: Any, *, channel_axis: int = -1) -> QuantizedTensor:
+    """Quantize one weight to int8 with a per-``channel_axis`` symmetric scale."""
+    w = jnp.asarray(w)
+    axes = tuple(i for i in range(w.ndim) if i != (channel_axis % w.ndim))
+    abs_max = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axes, keepdims=True)
+    scale = jnp.maximum(abs_max, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127).astype(jnp.int8)
+    return QuantizedTensor(q=q, scale=scale)
+
+
+def dequantize(leaf: Any, dtype: Any = jnp.bfloat16) -> Any:
+    """Inverse of :func:`quantize_array`; passes non-quantized leaves through."""
+    if isinstance(leaf, QuantizedTensor):
+        return (leaf.q.astype(jnp.float32) * leaf.scale).astype(dtype)
+    return leaf
+
+
+def _is_qt(x: Any) -> bool:
+    return isinstance(x, QuantizedTensor)
+
+
+def dequantize_tree(params: Any, dtype: Any = jnp.bfloat16) -> Any:
+    """Map :func:`dequantize` over a pytree (call *inside* jit so the convert
+    fuses into consumers rather than materializing f32/bf16 copies in HBM)."""
+    return jax.tree_util.tree_map(lambda x: dequantize(x, dtype), params, is_leaf=_is_qt)
+
+
+#: default targets: large matmul kernels; embeddings stay unquantized (gather
+#: reads one row per token — quantizing saves nothing and costs accuracy) and
+#: norms/biases/low-rank adapters are too small to matter
+_DEFAULT_INCLUDE = r"(kernel)$"
+_DEFAULT_EXCLUDE = r"(embed|embedding|norm|scale|bias|lora_a|lora_b)"
+
+
+def quantize_params(
+    params: Any,
+    *,
+    include: str = _DEFAULT_INCLUDE,
+    exclude: str = _DEFAULT_EXCLUDE,
+    min_size: int = 1 << 16,
+    channel_axis: int = -1,
+) -> Any:
+    """Quantize matching weight leaves of a params pytree to int8.
+
+    A leaf is quantized when its path matches ``include``, does not match
+    ``exclude``, has rank >= 2, and has at least ``min_size`` elements.
+    """
+    inc, exc = re.compile(include), re.compile(exclude)
+
+    def path_str(path: Sequence[Any]) -> str:
+        return "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+
+    def maybe_quantize(path, leaf):
+        p = path_str(path)
+        shape = getattr(leaf, "shape", ())
+        if (
+            inc.search(p)
+            and not exc.search(p)
+            and len(shape) >= 2
+            and int(np.prod(shape)) >= min_size
+        ):
+            return quantize_array(leaf, channel_axis=channel_axis)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(maybe_quantize, params)
