@@ -47,6 +47,19 @@ def test_basic_template_trains_and_predicts(render):
     assert len(predictions) == 5 and all(p in (0, 1, 2) for p in predictions)
 
 
+def test_text_generation_template_trains_and_generates(render):
+    render("text-generation")
+    module = importlib.import_module("app")
+
+    _, metrics = module.model.train(hyperparameters={"learning_rate": 3e-3})
+    assert metrics["train"] < 3.0  # mean next-token cross-entropy (nats)
+    prompts = ["the quick brown ", "a stitch "]
+    outputs = module.model.predict(features=prompts)
+    assert [t.startswith(p) for t, p in zip(outputs, prompts)] == [True, True]
+    assert all(set(t[len(p):]) <= set(module.CHARS) for t, p in zip(outputs, prompts))
+    assert module.model.predict(features=prompts) == outputs  # greedy determinism
+
+
 def test_serverless_template_trains_and_scores(render):
     render("basic-serverless")
     module = importlib.import_module("app")

@@ -1,0 +1,147 @@
+"""{{app_name}}: a character-level language model, trained and served TPU-natively.
+
+The fourth template family: where `basic`/`basic-serverless` serve sklearn
+estimators and `image-classification` a step-mode CNN, this app trains a tiny
+Llama-architecture decoder with the jit-compiled step trainer and serves
+*autoregressive text generation* through the same Dataset/Model protocol —
+``POST /predict`` takes prompt strings and returns continuations via the
+KV-cached generation engine (``unionml_tpu.models.generate``).
+
+Swap ``CORPUS`` for your own text, scale ``LlamaConfig`` up, and add
+``MeshSpec(...)``/``llama_partition_rules()`` to the TrainerConfig to shard.
+"""
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pandas as pd
+from flax.training import train_state
+
+from unionml_tpu import Dataset, Model, TrainerConfig
+from unionml_tpu.models import GenerationConfig, Generator, Llama, LlamaConfig, causal_lm_loss
+
+SEQ_LEN = 32
+NEW_TOKENS = 48
+
+# a self-contained training corpus: classic pangrams and proverbs; replace with
+# a reader that loads your own text files
+CORPUS = [
+    "the quick brown fox jumps over the lazy dog.",
+    "pack my box with five dozen liquor jugs.",
+    "how vexingly quick daft zebras jump!",
+    "a stitch in time saves nine.",
+    "all that glitters is not gold.",
+    "actions speak louder than words.",
+    "practice makes perfect, and perfect needs practice.",
+    "the early bird catches the worm.",
+]
+
+#: char-level vocabulary; id 0 is reserved as pad
+CHARS = sorted({c for line in CORPUS for c in line})
+PAD_ID = 0
+STOI = {c: i + 1 for i, c in enumerate(CHARS)}
+ITOS = {i + 1: c for i, c in enumerate(CHARS)}
+VOCAB_SIZE = len(CHARS) + 1
+
+config = LlamaConfig.tiny(
+    vocab_size=VOCAB_SIZE, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, hidden_dim=128,
+    max_seq_len=SEQ_LEN + NEW_TOKENS, dtype=jnp.float32, param_dtype=jnp.float32,
+)
+module = Llama(config)
+
+dataset = Dataset(name="char_corpus", test_size=0.2, shuffle=True)
+model = Model(name="{{app_name}}", dataset=dataset)
+model.__app_module__ = "app:model"
+
+
+def encode(text: str) -> List[int]:
+    return [STOI[c] for c in text if c in STOI]
+
+
+def decode(token_ids) -> str:
+    return "".join(ITOS.get(int(t), "") for t in token_ids if int(t) != PAD_ID)
+
+
+@dataset.reader
+def reader(repeats: int = 24) -> pd.DataFrame:
+    return pd.DataFrame({"text": CORPUS * repeats})
+
+
+@dataset.parser
+def parser(
+    data: pd.DataFrame, features: Optional[List[str]], targets: List[str]
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Chop the corpus into fixed [N, SEQ_LEN] next-token-prediction windows."""
+    stream: List[int] = []
+    for line in data["text"]:
+        stream.extend(encode(line) + [STOI[" "]])
+    n = max(len(stream) // SEQ_LEN, 1)
+    stream = (stream * SEQ_LEN)[: n * SEQ_LEN]  # wrap-pad the tail window
+    windows = np.asarray(stream, np.int32).reshape(n, SEQ_LEN)
+    return windows, windows  # causal LM: the tokens are their own labels
+
+
+@model.init
+def init(hyperparameters: dict) -> train_state.TrainState:
+    params = module.init(jax.random.PRNGKey(0), jnp.zeros((1, SEQ_LEN), jnp.int32))["params"]
+    return train_state.TrainState.create(
+        apply_fn=module.apply,
+        params=params,
+        tx=optax.adamw(hyperparameters.get("learning_rate", 3e-3)),
+    )
+
+
+@model.trainer(config=TrainerConfig(epochs=6, batch_size=16, shuffle=True))
+def trainer(state: train_state.TrainState, batch) -> tuple:
+    tokens = batch[0] if isinstance(batch, (tuple, list)) else batch
+
+    def loss_fn(params):
+        return causal_lm_loss(lambda p, t: module.apply({"params": p}, t), params, tokens)
+
+    loss, grads = jax.value_and_grad(loss_fn)(state.params)
+    return state.apply_gradients(grads=grads), {"loss": loss}
+
+
+@model.evaluator
+def evaluator(state: train_state.TrainState, features: np.ndarray, target: np.ndarray) -> float:
+    """Mean next-token cross-entropy (nats); lower is better."""
+    return float(
+        causal_lm_loss(lambda p, t: module.apply({"params": p}, t), state.params, jnp.asarray(features))
+    )
+
+
+@dataset.feature_loader
+def feature_loader(raw) -> List[str]:
+    """Serving features are prompt strings (or one string)."""
+    if isinstance(raw, str):
+        return [raw]
+    return [str(p) for p in raw]
+
+
+_generators: dict = {}
+
+
+@model.predictor
+def predictor(state: train_state.TrainState, features: List[str]) -> List[str]:
+    gen = _generators.get(id(state))
+    if gen is None:
+        gen = Generator(
+            module,
+            state.params,
+            GenerationConfig(max_new_tokens=NEW_TOKENS, temperature=0.0, prompt_buckets=(SEQ_LEN,)),
+        )
+        _generators.clear()  # one live state at a time; drop stale compiled engines
+        _generators[id(state)] = gen
+    prompts = [encode(p) or [STOI[" "]] for p in features]
+    out = gen(prompts)
+    return [p + decode(row) for p, row in zip(features, out)]
+
+
+if __name__ == "__main__":
+    model_object, metrics = model.train(hyperparameters={"learning_rate": 3e-3})
+    print("eval loss:", metrics)
+    print(model.predict(features=["the quick brown "])[0])
+    model.save("model_object.ckpt")

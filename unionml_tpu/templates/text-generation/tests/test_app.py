@@ -1,0 +1,18 @@
+from app import CHARS, NEW_TOKENS, model, reader
+
+
+def test_train_and_generate():
+    _, metrics = model.train(hyperparameters={"learning_rate": 3e-3})
+    assert metrics["train"] < 3.0  # mean next-token cross-entropy (nats)
+
+    prompts = ["the quick brown ", "a stitch "]
+    outputs = model.predict(features=prompts)
+    assert len(outputs) == 2
+    for prompt, text in zip(prompts, outputs):
+        assert text.startswith(prompt)
+        continuation = text[len(prompt):]
+        assert 0 < len(continuation) <= NEW_TOKENS
+        assert set(continuation) <= set(CHARS)
+
+    # greedy decoding is deterministic
+    assert model.predict(features=prompts) == outputs

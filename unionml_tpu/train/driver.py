@@ -402,6 +402,14 @@ def fit(
         last_metrics: Any = None
         trace_active = False
 
+        # XLA:CPU emulated-mesh collectives run an in-process rendezvous across one
+        # thread per "device"; with async dispatch piling up executions on a small
+        # host (this box: nproc=1), participants starve past the 40 s rendezvous
+        # termination timeout and the runtime hard-aborts the process. Serialize
+        # dispatch there — a per-step fence costs nothing on an already-CPU-bound
+        # test backend. Real TPU keeps the async pipeline.
+        serialize_dispatch = jax.default_backend() == "cpu" and mesh.size > 1
+
         prev_debug_nans = jax.config.jax_debug_nans
         if config.debug_nans:
             jax.config.update("jax_debug_nans", True)
@@ -422,6 +430,8 @@ def fit(
                         first_batch_samples = batch_n
                     else:
                         state, last_metrics = run_step(state, payload)
+                        if serialize_dispatch:
+                            _sync_fence(last_metrics)
                 # drop the payload reference before the generator's next epoch-boundary
                 # permute runs — otherwise the old permuted copy stays live and peak
                 # HBM hits 3x the dataset in device_data mode
