@@ -113,6 +113,44 @@ def test_sample_tokens_top_p_masks_tail():
     assert draws <= {0, 1} and 0 in draws
 
 
+def test_stream_matches_call(tiny):
+    module, params, _ = tiny
+    gen = Generator(
+        module, params, GenerationConfig(max_new_tokens=11, temperature=0.0, prompt_buckets=(16,))
+    )
+    prompts = [[3, 14, 15, 92], [7, 7]]
+    full = gen(prompts)
+    chunks = list(gen.stream(prompts, chunk_size=4))
+    assert all(c.shape[1] <= 4 for c in chunks)
+    np.testing.assert_array_equal(np.concatenate(chunks, axis=1), full)
+    # sampled decoding streams identically too (same seed, same key path)
+    sampled = Generator(
+        module, params, GenerationConfig(max_new_tokens=9, temperature=0.9, prompt_buckets=(16,))
+    )
+    full_s = sampled(prompts, seed=5)
+    chunks_s = list(sampled.stream(prompts, seed=5, chunk_size=3))
+    np.testing.assert_array_equal(np.concatenate(chunks_s, axis=1), full_s)
+
+
+def test_stream_stops_early_after_eos(tiny):
+    module, params, _ = tiny
+    base = Generator(module, params, GenerationConfig(max_new_tokens=10, temperature=0.0, prompt_buckets=(8,)))
+    prompt = [10, 20, 30]
+    free_run = base([prompt])[0].tolist()
+    eos = free_run[1]
+    gen = Generator(
+        module, params,
+        GenerationConfig(max_new_tokens=10, temperature=0.0, prompt_buckets=(8,), eos_id=eos, pad_id=0),
+    )
+    chunks = list(gen.stream([prompt], chunk_size=2))
+    out = np.concatenate(chunks, axis=1)[0].tolist()
+    cut = free_run.index(eos) + 1
+    assert out[:cut] == free_run[:cut]
+    assert all(t == 0 for t in out[cut:])
+    # stream ended at a chunk boundary after every row finished, not at max_new
+    assert len(out) < 10
+
+
 def test_init_cache_shapes(tiny):
     _, _, config = tiny
     cache = init_cache(config, batch=2, cache_len=32)

@@ -47,7 +47,10 @@ def test_basic_template_trains_and_predicts(render):
     assert len(predictions) == 5 and all(p in (0, 1, 2) for p in predictions)
 
 
-def test_text_generation_template_trains_and_generates(render):
+def test_text_generation_template_trains_generates_and_serves(render, tmp_path):
+    import asyncio
+    import json
+
     render("text-generation")
     module = importlib.import_module("app")
 
@@ -58,6 +61,20 @@ def test_text_generation_template_trains_and_generates(render):
     assert [t.startswith(p) for t, p in zip(outputs, prompts)] == [True, True]
     assert all(set(t[len(p):]) <= set(module.CHARS) for t, p in zip(outputs, prompts))
     assert module.model.predict(features=prompts) == outputs  # greedy determinism
+
+    # artifact round trip: a reloaded LM generates the same continuations
+    path = tmp_path / "model_object.ckpt"
+    module.model.save(str(path))
+    module.model.artifact = None
+    module.model.load(str(path))
+    assert module.model.predict(features=prompts) == outputs
+
+    # generation over HTTP: prompt strings in, continuations out
+    app = module.model.serve()
+    status, texts, _ = asyncio.run(
+        app.dispatch("POST", "/predict", json.dumps({"features": prompts}).encode())
+    )
+    assert status == 200 and texts == outputs
 
 
 def test_serverless_template_trains_and_scores(render):

@@ -195,8 +195,11 @@ class Generator:
             tok0 = sample_tokens(head(p, last), key, config)
             return tok0, cache
 
-        def decode(p, cache, tok0, lengths, key):
-            """Feed tok0 (sampled from the prompt) and roll max_new_tokens-1 steps."""
+        def decode_steps(p, cache, tok, lengths, done, key, steps: int):
+            """Roll ``steps`` decode steps from the carry; returns the new tokens
+            ``[B, steps]`` and the advanced carry. One ``lax.scan`` compile per
+            distinct ``steps`` value — __call__ always uses max_new_tokens - 1 and
+            stream() a fixed chunk size, so the trace set stays tiny."""
             self.decode_traces += 1
             eos = config.eos_id
 
@@ -213,20 +216,14 @@ class Generator:
                     done = done | (nxt == eos)
                 return (cache, nxt, lengths, done, key), nxt
 
-            done = (tok0 == eos) if eos is not None else jnp.zeros(tok0.shape, bool)
-            steps = config.max_new_tokens - 1
-            if steps <= 0:
-                return tok0[:, None], lengths, cache
-            (cache, _, lengths, _, _), rest = jax.lax.scan(
-                body, (cache, tok0, lengths, done, key), None, length=steps
-            )
-            # the final cache is returned (and dropped by the caller) so the donated
-            # input buffers have an output to alias with — one cache in HBM throughout
-            return jnp.concatenate([tok0[:, None], rest.T], axis=1), lengths, cache
+            carry, toks = jax.lax.scan(body, (cache, tok, lengths, done, key), None, length=steps)
+            # the advanced carry (incl. cache) is returned so the donated input
+            # buffers have outputs to alias with — one cache in HBM throughout
+            return toks.T, carry
 
         # donate the cache through both stages: one cache lives in HBM, not two
         self._prefill = jax.jit(prefill, donate_argnums=(3,))
-        self._decode = jax.jit(decode, donate_argnums=(1,))
+        self._decode = jax.jit(decode_steps, static_argnums=(6,), donate_argnums=(1,))
 
     # ------------------------------------------------------------------ helpers
 
@@ -255,9 +252,9 @@ class Generator:
 
     # ------------------------------------------------------------------ generate
 
-    def __call__(self, prompts: Sequence[Sequence[int]], *, seed: int = 0) -> np.ndarray:
-        """Generate ``max_new_tokens`` per prompt; returns ``[len(prompts), max_new]``
-        int32 (``pad_id`` after each example's ``eos_id``)."""
+    def _start(self, prompts: Sequence[Sequence[int]], seed: int, extra_cache: int = 0):
+        """Shared prefill setup: pad/bucket the prompts, allocate + place the cache,
+        run prefill, and return the first sampled token plus the decode carry."""
         cfg = self.config
         n = len(prompts)
         lengths = np.array([max(len(p), 1) for p in prompts], np.int32)
@@ -274,12 +271,47 @@ class Generator:
         all_lengths = np.ones((batch,), np.int32)
         all_lengths[:n] = lengths
 
-        cache_len = max(bucket, max(cfg.prompt_buckets, default=0)) + cfg.max_new_tokens
+        cache_len = max(bucket, max(cfg.prompt_buckets, default=0)) + cfg.max_new_tokens + extra_cache
         cache = self._place_cache(init_cache(self.module.config, batch, cache_len))
         key = jax.random.PRNGKey(seed)
         key, prefill_key = jax.random.split(key)
         tok0, cache = self._prefill(
             self.params, jnp.asarray(tokens), jnp.asarray(all_lengths), cache, prefill_key
         )
-        out, _, _ = self._decode(self.params, cache, tok0, jnp.asarray(all_lengths), key)
-        return np.asarray(out)[:n]
+        eos = cfg.eos_id
+        done = (tok0 == eos) if eos is not None else jnp.zeros(tok0.shape, bool)
+        return n, tok0, (cache, tok0, jnp.asarray(all_lengths), done, key)
+
+    def __call__(self, prompts: Sequence[Sequence[int]], *, seed: int = 0) -> np.ndarray:
+        """Generate ``max_new_tokens`` per prompt; returns ``[len(prompts), max_new]``
+        int32 (``pad_id`` after each example's ``eos_id``)."""
+        n, tok0, carry = self._start(prompts, seed)
+        steps = self.config.max_new_tokens - 1
+        first = np.asarray(tok0)[:, None]
+        if steps <= 0:
+            return first[:n]
+        rest, _ = self._decode(self.params, *carry, steps)
+        return np.concatenate([first, np.asarray(rest)], axis=1)[:n]
+
+    def stream(self, prompts: Sequence[Sequence[int]], *, seed: int = 0, chunk_size: int = 16):
+        """Incremental generation: yields ``[len(prompts), <=chunk_size]`` arrays of
+        newly decoded tokens as they materialize (the first yield is the single
+        prompt-sampled token). The decode compiles once per ``chunk_size``; when
+        every row has emitted ``eos_id`` the stream ends early. Total tokens across
+        yields equal ``__call__``'s output for the same seed."""
+        cfg = self.config
+        if chunk_size < 1:
+            raise ValueError("chunk_size must be >= 1")
+        # the last chunk may overshoot max_new_tokens; give its cache writes room
+        n_chunks = max(0, -(-(cfg.max_new_tokens - 1) // chunk_size))
+        extra = n_chunks * chunk_size - (cfg.max_new_tokens - 1)
+        n, tok0, carry = self._start(prompts, seed, extra_cache=extra)
+        yield np.asarray(tok0)[:n, None]
+        produced = 1
+        while produced < cfg.max_new_tokens:
+            if bool(np.asarray(carry[3]).all()):
+                return  # every row finished with eos
+            toks, carry = self._decode(self.params, *carry, chunk_size)
+            take = min(chunk_size, cfg.max_new_tokens - produced)
+            yield np.asarray(toks)[:n, :take]
+            produced += take
