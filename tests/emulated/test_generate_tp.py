@@ -42,6 +42,27 @@ def test_sharded_generation_matches_unsharded(spec):
     np.testing.assert_array_equal(sharded([prompts[0]]), expected[:1])
 
 
+def test_expert_parallel_generation_matches_unsharded():
+    """MoE decoder served expert-parallel: stacked expert FFN weights sharded
+    P('expert', ...) while the KV cache shards batch-over-data — tokens must
+    equal the unsharded run (ample capacity: routing is drop-free on both paths)."""
+    from unionml_tpu.models import MoEConfig, MoETransformer, moe_partition_rules
+
+    config = MoEConfig.tiny(
+        vocab_size=64, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, hidden_dim=96,
+        n_experts=4, k=2, capacity_factor=8.0, dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    module = MoETransformer(config)
+    params = module.init(jax.random.PRNGKey(3), jnp.zeros((1, 8), jnp.int32))["params"]
+    cfg = GenerationConfig(max_new_tokens=6, temperature=0.0, prompt_buckets=(16,))
+    prompts = [[3, 1, 4, 1], [5, 9, 2], [6, 5], [3, 5, 8, 9]]
+
+    expected = Generator(module, params, cfg)(prompts)
+    mesh = MeshSpec(data=2, expert=4).build()
+    sharded = Generator(module, params, cfg, mesh=mesh, partition_rules=moe_partition_rules())
+    np.testing.assert_array_equal(sharded(prompts), expected)
+
+
 def test_quantized_sharded_generation_matches_quantized_unsharded():
     """int8 weights + TP mesh: the QuantizedTensor pytree (int8 q + size-1-dim
     scales) must place under the kernel partition rules and emit the same tokens

@@ -189,9 +189,14 @@ class MoEBlock(nn.Module):
     config: MoEConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array, positions: Optional[jax.Array] = None) -> jax.Array:
+    def __call__(
+        self,
+        x: jax.Array,
+        positions: Optional[jax.Array] = None,
+        cache: Optional[Any] = None,
+    ) -> Any:
         cfg = self.config
-        x = x + Attention(
+        attn_out = Attention(
             n_heads=cfg.n_heads,
             n_kv_heads=cfg.n_kv_heads,
             causal=True,
@@ -200,7 +205,10 @@ class MoEBlock(nn.Module):
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
             name="attn",
-        )(RMSNorm(dtype=cfg.dtype, name="attn_norm")(x), positions)
+        )(RMSNorm(dtype=cfg.dtype, name="attn_norm")(x), positions, None, cache)
+        if cache is not None:
+            attn_out, cache = attn_out
+        x = x + attn_out
         x = x + MoELayer(
             n_experts=cfg.n_experts,
             hidden_dim=cfg.hidden_dim,
@@ -210,27 +218,42 @@ class MoEBlock(nn.Module):
             param_dtype=cfg.param_dtype,
             name="moe",
         )(RMSNorm(dtype=cfg.dtype, name="moe_norm")(x))
-        return x
+        return (x, cache) if cache is not None else x
 
 
 class MoETransformer(nn.Module):
-    """Causal LM with routed-expert FFNs (Mixtral-family shape): tokens -> logits."""
+    """Causal LM with routed-expert FFNs (Mixtral-family shape): tokens -> logits.
+
+    Follows the same cache contract as :class:`~unionml_tpu.models.llama.Llama`, so
+    :class:`~unionml_tpu.models.generate.Generator` serves it unchanged. Note the
+    capacity semantics under incremental decoding: each step routes only the new
+    tokens, so expert capacity is per-step — with ample ``capacity_factor`` this
+    is exactly full-sequence routing, and under pressure it drops strictly fewer
+    tokens than the training-time whole-sequence dispatch.
+    """
 
     config: MoEConfig
 
     @nn.compact
-    def __call__(self, tokens: jax.Array, positions: Optional[jax.Array] = None) -> jax.Array:
+    def __call__(
+        self,
+        tokens: jax.Array,
+        positions: Optional[jax.Array] = None,
+        return_hidden: bool = False,
+        cache: Optional[Tuple[Any, ...]] = None,
+    ) -> Any:
         from unionml_tpu.models.layers import TransformerBlock
 
         cfg = self.config
         x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="embed")(tokens)
         if positions is None:
             positions = jnp.arange(tokens.shape[1])
+        new_cache = []
         for i in range(cfg.n_layers):
             if i % cfg.moe_every == cfg.moe_every - 1:
-                x = MoEBlock(cfg, name=f"layer_{i}")(x, positions)
+                block = MoEBlock(cfg, name=f"layer_{i}")
             else:
-                x = TransformerBlock(
+                block = TransformerBlock(
                     n_heads=cfg.n_heads,
                     n_kv_heads=cfg.n_kv_heads,
                     hidden_dim=cfg.hidden_dim,
@@ -240,11 +263,20 @@ class MoETransformer(nn.Module):
                     dtype=cfg.dtype,
                     param_dtype=cfg.param_dtype,
                     name=f"layer_{i}",
-                )(x, positions)
+                )
+            if cache is not None:
+                args = (x, positions, cache[i]) if isinstance(block, MoEBlock) else (x, positions, None, cache[i])
+                x, layer_cache = block(*args)
+                new_cache.append(layer_cache)
+            else:
+                x = block(x, positions)
         x = RMSNorm(dtype=cfg.dtype, name="final_norm")(x)
-        return nn.Dense(
+        if return_hidden:
+            return (x, tuple(new_cache)) if cache is not None else x
+        logits = nn.Dense(
             cfg.vocab_size, use_bias=False, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="lm_head"
         )(x)
+        return (logits, tuple(new_cache)) if cache is not None else logits
 
 
 def moe_partition_rules() -> PartitionRules:
