@@ -172,6 +172,35 @@ def test_moe_greedy_matches_full_forward_oracle():
         assert row.tolist() == naive_greedy(module, params, prompt, 8), prompt
 
 
+def test_moe_generation_is_padding_invariant_at_tight_capacity():
+    """Bucket right-padding and pow2 batch padding must not change MoE outputs:
+    pad tokens are masked out of expert routing, so at the default (tight)
+    capacity_factor the same prompt yields the same tokens whether it sits in a
+    small bucket, a large bucket, or a batch padded with synthetic rows."""
+    from unionml_tpu.models import MoEConfig, MoETransformer
+
+    config = MoEConfig.tiny(
+        vocab_size=61, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, hidden_dim=96,
+        n_experts=4, k=2, capacity_factor=1.25, dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    module = MoETransformer(config)
+    params = module.init(jax.random.PRNGKey(2), jnp.zeros((1, 8), jnp.int32))["params"]
+    prompt = [3, 1, 4, 1, 5]
+
+    def run(buckets, prompts):
+        gen = Generator(
+            module, params,
+            GenerationConfig(max_new_tokens=6, temperature=0.0, prompt_buckets=buckets),
+        )
+        return gen(prompts)
+
+    small = run((8,), [prompt])
+    large_bucket = run((32,), [prompt])  # 27 pad columns instead of 3
+    padded_batch = run((8,), [prompt, [9, 2], [7]])  # batch pads 3 -> 4 rows
+    np.testing.assert_array_equal(large_bucket, small)
+    np.testing.assert_array_equal(padded_batch[:1], small)
+
+
 def test_init_cache_shapes(tiny):
     _, _, config = tiny
     cache = init_cache(config, batch=2, cache_len=32)

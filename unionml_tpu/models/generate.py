@@ -175,9 +175,14 @@ class Generator:
         else:
             dequant = lambda p: p  # noqa: E731
 
-        def apply(p: Any, tokens: jax.Array, positions: jax.Array, cache: Any):
+        def apply(p: Any, tokens: jax.Array, positions: jax.Array, cache: Any, token_mask: Any):
             hidden, cache = module.apply(
-                {"params": p}, tokens, positions=positions, return_hidden=True, cache=cache
+                {"params": p},
+                tokens,
+                positions=positions,
+                return_hidden=True,
+                cache=cache,
+                token_mask=token_mask,
             )
             return hidden, cache
 
@@ -185,12 +190,15 @@ class Generator:
             kernel = p["lm_head"]["kernel"]
             return (hidden @ kernel.astype(hidden.dtype)).astype(jnp.float32)
 
-        def prefill(p, tokens, lengths, cache, key):
+        def prefill(p, tokens, lengths, cache, key, row_valid):
             self.prefill_traces += 1
             p = dequant(p)
             batch, prompt_len = tokens.shape
             positions = jnp.broadcast_to(jnp.arange(prompt_len)[None], (batch, prompt_len))
-            hidden, cache = apply(p, tokens, positions, cache)
+            # padding (right-pad columns and synthetic batch rows) must not claim
+            # routed-expert capacity — mask it out of the token stream
+            token_mask = (jnp.arange(prompt_len)[None] < lengths[:, None]) & row_valid[:, None]
+            hidden, cache = apply(p, tokens, positions, cache, token_mask)
             last = jnp.take_along_axis(hidden, (lengths - 1)[:, None, None], axis=1)[:, 0]
             tok0 = sample_tokens(head(p, last), key, config)
             return tok0, cache
@@ -208,7 +216,7 @@ class Generator:
                 key, sub = jax.random.split(key)
                 ps = dequant(p)  # per-step so int8, not bf16, is the steady-state HBM read
                 positions = lengths[:, None]  # each example's next free cache slot
-                hidden, cache = apply(ps, tok[:, None], positions, cache)
+                hidden, cache = apply(ps, tok[:, None], positions, cache, (~done)[:, None])
                 nxt = sample_tokens(head(ps, hidden[:, 0]), sub, config)
                 nxt = jnp.where(done, jnp.int32(config.pad_id), nxt)
                 lengths = lengths + jnp.where(done, 0, 1)
@@ -275,11 +283,15 @@ class Generator:
         cache = self._place_cache(init_cache(self.module.config, batch, cache_len))
         key = jax.random.PRNGKey(seed)
         key, prefill_key = jax.random.split(key)
+        row_valid = jnp.arange(batch) < n
         tok0, cache = self._prefill(
-            self.params, jnp.asarray(tokens), jnp.asarray(all_lengths), cache, prefill_key
+            self.params, jnp.asarray(tokens), jnp.asarray(all_lengths), cache, prefill_key, row_valid
         )
         eos = cfg.eos_id
         done = (tok0 == eos) if eos is not None else jnp.zeros(tok0.shape, bool)
+        # synthetic batch-padding rows start done: they emit pads, never advance
+        # their cache, and stay out of routed-expert capacity
+        done = done | ~row_valid
         return n, tok0, (cache, tok0, jnp.asarray(all_lengths), done, key)
 
     def __call__(self, prompts: Sequence[Sequence[int]], *, seed: int = 0) -> np.ndarray:

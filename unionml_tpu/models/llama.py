@@ -70,11 +70,18 @@ class Llama(nn.Module):
         positions: Optional[jax.Array] = None,
         return_hidden: bool = False,
         cache: Optional[Tuple[Any, ...]] = None,
+        token_mask: Optional[jax.Array] = None,
     ) -> Any:
         """``cache`` (one :data:`~unionml_tpu.models.layers.LayerCache` per layer,
         see :func:`unionml_tpu.models.generate.init_cache`) switches the stack into
         incremental-decoding mode: the return value becomes ``(out, new_cache)``
-        and ``positions`` must be per-example absolute positions ``[B, L]``."""
+        and ``positions`` must be per-example absolute positions ``[B, L]``.
+
+        ``token_mask`` (``[B, L]`` bool, False = padding) is part of the shared
+        cache contract so the Generator can drive dense and routed decoders
+        uniformly; a dense decoder ignores it — rows are independent and causal
+        masking already hides right-padding from real tokens."""
+        del token_mask
         cfg = self.config
         x = nn.Embed(
             cfg.vocab_size, cfg.dim, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="embed"

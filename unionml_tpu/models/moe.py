@@ -38,11 +38,15 @@ Dtype = Any
 
 
 def top_k_dispatch(
-    router_probs: jax.Array, k: int, capacity: int
+    router_probs: jax.Array, k: int, capacity: int, valid: Optional[jax.Array] = None
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Build dispatch/combine tensors from router probabilities.
 
     :param router_probs: ``[n_tokens, n_experts]`` softmax outputs.
+    :param valid: optional ``[n_tokens]`` bool — False tokens (padding) claim no
+        expert capacity, get zero dispatch/combine rows, and are excluded from the
+        aux loss. Without it, identical pad embeddings all route to the same
+        experts and can crowd real tokens out of capacity.
     :returns: ``(dispatch [N, E, C] bool-ish, combine [N, E, C], aux_loss scalar)``.
     """
     n_tokens, n_experts = router_probs.shape
@@ -55,6 +59,8 @@ def top_k_dispatch(
     counts = jnp.zeros((n_experts,), jnp.int32)
     for slot in range(k):  # k is small and static; unrolled at trace time
         onehot = jax.nn.one_hot(gate_idx[:, slot], n_experts, dtype=jnp.int32)  # [N, E]
+        if valid is not None:
+            onehot = onehot * valid.astype(jnp.int32)[:, None]
         # position of each token within its chosen expert's capacity buffer
         pos = jnp.cumsum(onehot, axis=0) - 1 + counts[None, :]
         counts = counts + onehot.sum(axis=0)
@@ -65,8 +71,15 @@ def top_k_dispatch(
         combine = combine + gate_vals[:, slot, None, None] * slot_dispatch
 
     # Switch load-balance loss: n_experts * sum_e f_e * p_e, minimized at uniform
-    token_frac = jax.nn.one_hot(gate_idx[:, 0], n_experts).mean(axis=0)
-    prob_frac = router_probs.mean(axis=0)
+    top1 = jax.nn.one_hot(gate_idx[:, 0], n_experts)
+    if valid is None:
+        token_frac = top1.mean(axis=0)
+        prob_frac = router_probs.mean(axis=0)
+    else:
+        w = valid.astype(router_probs.dtype)[:, None]
+        denom = jnp.maximum(w.sum(), 1.0)
+        token_frac = (top1 * w).sum(axis=0) / denom
+        prob_frac = (router_probs * w).sum(axis=0) / denom
     aux_loss = n_experts * jnp.sum(token_frac * prob_frac)
     return dispatch, combine, aux_loss
 
@@ -87,7 +100,7 @@ class MoELayer(nn.Module):
     param_dtype: Dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array, token_mask: Optional[jax.Array] = None) -> jax.Array:
         batch, length, dim = x.shape
         n_tokens = batch * length
         tokens = x.reshape(n_tokens, dim)
@@ -97,7 +110,10 @@ class MoELayer(nn.Module):
         router_logits = nn.Dense(
             self.n_experts, use_bias=False, dtype=jnp.float32, param_dtype=self.param_dtype, name="router"
         )(tokens.astype(jnp.float32))
-        dispatch, combine, aux_loss = top_k_dispatch(jax.nn.softmax(router_logits, -1), self.k, capacity)
+        valid = token_mask.reshape(n_tokens) if token_mask is not None else None
+        dispatch, combine, aux_loss = top_k_dispatch(
+            jax.nn.softmax(router_logits, -1), self.k, capacity, valid
+        )
         self.sow("losses", "moe_aux_loss", aux_loss)
 
         # dispatch: one einsum, [E, C, D] sharded over the expert axis -> XLA
@@ -193,7 +209,9 @@ class MoEBlock(nn.Module):
         self,
         x: jax.Array,
         positions: Optional[jax.Array] = None,
+        mask: Optional[jax.Array] = None,
         cache: Optional[Any] = None,
+        token_mask: Optional[jax.Array] = None,
     ) -> Any:
         cfg = self.config
         attn_out = Attention(
@@ -205,7 +223,7 @@ class MoEBlock(nn.Module):
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
             name="attn",
-        )(RMSNorm(dtype=cfg.dtype, name="attn_norm")(x), positions, None, cache)
+        )(RMSNorm(dtype=cfg.dtype, name="attn_norm")(x), positions, mask, cache)
         if cache is not None:
             attn_out, cache = attn_out
         x = x + attn_out
@@ -217,7 +235,7 @@ class MoEBlock(nn.Module):
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
             name="moe",
-        )(RMSNorm(dtype=cfg.dtype, name="moe_norm")(x))
+        )(RMSNorm(dtype=cfg.dtype, name="moe_norm")(x), token_mask)
         return (x, cache) if cache is not None else x
 
 
@@ -225,11 +243,12 @@ class MoETransformer(nn.Module):
     """Causal LM with routed-expert FFNs (Mixtral-family shape): tokens -> logits.
 
     Follows the same cache contract as :class:`~unionml_tpu.models.llama.Llama`, so
-    :class:`~unionml_tpu.models.generate.Generator` serves it unchanged. Note the
-    capacity semantics under incremental decoding: each step routes only the new
-    tokens, so expert capacity is per-step — with ample ``capacity_factor`` this
-    is exactly full-sequence routing, and under pressure it drops strictly fewer
-    tokens than the training-time whole-sequence dispatch.
+    :class:`~unionml_tpu.models.generate.Generator` serves it unchanged.
+    ``token_mask`` (``[B, L]`` bool, False = padding) keeps pad tokens from
+    claiming expert capacity — without it, bucketed/batch-padded serving would
+    let identical pad embeddings crowd real tokens out of their experts.
+    Capacity under incremental decoding is per routed group (per decode step);
+    size ``capacity_factor`` for the serving batch, not the training sequence.
     """
 
     config: MoEConfig
@@ -241,6 +260,7 @@ class MoETransformer(nn.Module):
         positions: Optional[jax.Array] = None,
         return_hidden: bool = False,
         cache: Optional[Tuple[Any, ...]] = None,
+        token_mask: Optional[jax.Array] = None,
     ) -> Any:
         from unionml_tpu.models.layers import TransformerBlock
 
@@ -250,7 +270,8 @@ class MoETransformer(nn.Module):
             positions = jnp.arange(tokens.shape[1])
         new_cache = []
         for i in range(cfg.n_layers):
-            if i % cfg.moe_every == cfg.moe_every - 1:
+            moe_block = i % cfg.moe_every == cfg.moe_every - 1
+            if moe_block:
                 block = MoEBlock(cfg, name=f"layer_{i}")
             else:
                 block = TransformerBlock(
@@ -264,12 +285,12 @@ class MoETransformer(nn.Module):
                     param_dtype=cfg.param_dtype,
                     name=f"layer_{i}",
                 )
+            extra = (token_mask,) if moe_block else ()  # only routed blocks consume it
             if cache is not None:
-                args = (x, positions, cache[i]) if isinstance(block, MoEBlock) else (x, positions, None, cache[i])
-                x, layer_cache = block(*args)
+                x, layer_cache = block(x, positions, None, cache[i], *extra)
                 new_cache.append(layer_cache)
             else:
-                x = block(x, positions)
+                x = block(x, positions, None, None, *extra)
         x = RMSNorm(dtype=cfg.dtype, name="final_norm")(x)
         if return_hidden:
             return (x, tuple(new_cache)) if cache is not None else x
