@@ -69,6 +69,33 @@ def test_quantized_generation_runs_and_is_deterministic():
     np.testing.assert_array_equal(out, gen(prompts))
 
 
+def test_int8_matmul_kernel_matches_dequant_reference():
+    """Pallas kernel (interpret mode on CPU) vs dequant + dot, several shapes
+    incl. M needing padding and the fallback path for untileable shapes."""
+    from unionml_tpu.ops.int8_matmul import int8_matmul, quantized_matmul
+
+    rng = np.random.default_rng(1)
+    for m, k, f in [(8, 256, 512), (5, 512, 1536), (130, 128, 256)]:
+        qt = quantize_array(rng.normal(size=(k, f)).astype(np.float32))
+        x = jnp.asarray(rng.normal(size=(m, k)), jnp.float32)
+        ref = np.asarray(x) @ (np.asarray(qt.q, np.float32) * np.asarray(qt.scale))
+        out = np.asarray(int8_matmul(x, qt.q, qt.scale, out_dtype=jnp.float32, interpret=True))
+        scale_ref = np.abs(ref).max() + 1e-9
+        assert np.abs(out - ref).max() / scale_ref < 0.01  # bf16 x-cast rounding
+
+    # untileable weight shape: quantized_matmul silently takes the dequant path
+    qt = quantize_array(rng.normal(size=(96, 100)).astype(np.float32))
+    x = jnp.asarray(rng.normal(size=(4, 96)), jnp.float32)
+    out = quantized_matmul(x, qt, out_dtype=jnp.float32, impl="pallas")
+    ref = np.asarray(x) @ (np.asarray(qt.q, np.float32) * np.asarray(qt.scale))
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
+
+    # batched leading dims flow through
+    x3 = jnp.asarray(rng.normal(size=(2, 3, 96)), jnp.float32)
+    out3 = quantized_matmul(x3, qt, out_dtype=jnp.float32)
+    assert out3.shape == (2, 3, 100)
+
+
 def test_unsupported_mode_rejected():
     config = LlamaConfig.tiny(dtype=jnp.float32, param_dtype=jnp.float32)
     module = Llama(config)

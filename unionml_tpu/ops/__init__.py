@@ -1,6 +1,7 @@
 """TPU compute ops: attention kernels (XLA reference, pallas flash, ring/SP), int8 quant."""
 
 from unionml_tpu.ops.attention import dot_product_attention, multihead_attention  # noqa: F401
+from unionml_tpu.ops.int8_matmul import int8_matmul, quantized_matmul  # noqa: F401
 from unionml_tpu.ops.quant import (  # noqa: F401
     QuantizedTensor,
     dequantize,
