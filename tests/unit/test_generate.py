@@ -151,6 +151,25 @@ def test_stream_stops_early_after_eos(tiny):
     assert len(out) < 10
 
 
+def test_chunked_prefill_matches_single_dispatch(tiny):
+    """Long-context prefill in fixed chunks through the cache must emit exactly
+    the tokens of the one-dispatch prefill, across variable prompt lengths —
+    and the chunk shape compiles once regardless of prompt length."""
+    module, params, _ = tiny
+    base_cfg = dict(max_new_tokens=6, temperature=0.0, prompt_buckets=(32,))
+    plain = Generator(module, params, GenerationConfig(**base_cfg))
+    chunked = Generator(module, params, GenerationConfig(**base_cfg, prefill_chunk=8))
+
+    prompts = [[7, 7, 7, 21, 40, 2, 19, 55, 31, 90, 3, 14], [1, 88], list(range(1, 28))]
+    np.testing.assert_array_equal(chunked(prompts), plain(prompts))
+    np.testing.assert_array_equal(chunked([[5, 4, 3]]), plain([[5, 4, 3]]))
+
+    sampled_cfg = dict(max_new_tokens=5, temperature=0.8, top_k=20, prompt_buckets=(32,))
+    plain_s = Generator(module, params, GenerationConfig(**sampled_cfg))
+    chunked_s = Generator(module, params, GenerationConfig(**sampled_cfg, prefill_chunk=8))
+    np.testing.assert_array_equal(chunked_s(prompts, seed=3), plain_s(prompts, seed=3))
+
+
 def test_moe_greedy_matches_full_forward_oracle():
     """The MoE decoder follows the same cache contract; with ample expert capacity
     (no token drops) incremental routing equals whole-sequence routing, so greedy

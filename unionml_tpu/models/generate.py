@@ -56,6 +56,12 @@ class GenerationConfig:
     #: prompt-length buckets; a batch's prompts are padded to the smallest bucket
     #: that fits, so XLA compiles at most ``len(prompt_buckets)`` prefill shapes
     prompt_buckets: Tuple[int, ...] = (64, 256, 1024)
+    #: long-context prefill: process the prompt in fixed chunks of this many
+    #: tokens through the cache instead of one [B, bucket] dispatch — activation
+    #: memory stays O(B * chunk * dim) and ONE chunk shape covers every prompt
+    #: length (the chunk fn compiles once, prompt buckets stop mattering for
+    #: compile count). None = single-dispatch prefill.
+    prefill_chunk: Optional[int] = None
 
 
 def init_cache(config: Any, batch: int, cache_len: int) -> Tuple[Any, ...]:
@@ -203,6 +209,27 @@ class Generator:
             tok0 = sample_tokens(head(p, last), key, config)
             return tok0, cache
 
+        def prefill_chunk(p, tokens, start, lengths, cache, row_valid):
+            """One chunk of a long-context prefill: columns [start, start+C) of the
+            padded prompt flow through the cache (attention sees all previously
+            written slots). Also extracts the hidden row of each example's last
+            real token if it falls inside this chunk."""
+            self.prefill_traces += 1
+            p = dequant(p)
+            batch, chunk = tokens.shape
+            positions = start + jnp.broadcast_to(jnp.arange(chunk)[None], (batch, chunk))
+            token_mask = (positions < lengths[:, None]) & row_valid[:, None]
+            hidden, cache = apply(p, tokens, positions, cache, token_mask)
+            sel = positions == (lengths - 1)[:, None]  # at most one true column per row
+            chunk_last = jnp.einsum("blc,bl->bc", hidden.astype(jnp.float32), sel.astype(jnp.float32))
+            return chunk_last, sel.any(axis=1), cache
+
+        def first_token(p, last, key):
+            """Sample the first generated token from accumulated last-row hiddens
+            (chunked-prefill epilogue; everything but lm_head is DCE'd)."""
+            p = dequant(p)
+            return sample_tokens(head(p, last.astype(compute_dtype)), key, config)
+
         def decode_steps(p, cache, tok, lengths, done, key, steps: int):
             """Roll ``steps`` decode steps from the carry; returns the new tokens
             ``[B, steps]`` and the advanced carry. One ``lax.scan`` compile per
@@ -231,6 +258,8 @@ class Generator:
 
         # donate the cache through both stages: one cache lives in HBM, not two
         self._prefill = jax.jit(prefill, donate_argnums=(3,))
+        self._prefill_chunk = jax.jit(prefill_chunk, donate_argnums=(4,))
+        self._first_token = jax.jit(first_token)
         self._decode = jax.jit(decode_steps, static_argnums=(6,), donate_argnums=(1,))
 
     # ------------------------------------------------------------------ helpers
@@ -279,14 +308,33 @@ class Generator:
         all_lengths = np.ones((batch,), np.int32)
         all_lengths[:n] = lengths
 
+        chunk = cfg.prefill_chunk
+        if chunk:
+            bucket = -(-bucket // chunk) * chunk  # chunk-aligned; bucket shape is moot
+            tokens = np.pad(tokens, ((0, 0), (0, bucket - tokens.shape[1])), constant_values=cfg.pad_id)
         cache_len = max(bucket, max(cfg.prompt_buckets, default=0)) + cfg.max_new_tokens + extra_cache
         cache = self._place_cache(init_cache(self.module.config, batch, cache_len))
         key = jax.random.PRNGKey(seed)
         key, prefill_key = jax.random.split(key)
         row_valid = jnp.arange(batch) < n
-        tok0, cache = self._prefill(
-            self.params, jnp.asarray(tokens), jnp.asarray(all_lengths), cache, prefill_key, row_valid
-        )
+        if chunk and bucket > chunk:
+            lengths_dev = jnp.asarray(all_lengths)
+            last = jnp.zeros((batch, self.module.config.dim), jnp.float32)
+            for c in range(0, bucket, chunk):
+                chunk_last, has, cache = self._prefill_chunk(
+                    self.params,
+                    jnp.asarray(tokens[:, c : c + chunk]),
+                    jnp.int32(c),
+                    lengths_dev,
+                    cache,
+                    row_valid,
+                )
+                last = jnp.where(has[:, None], chunk_last, last)
+            tok0 = self._first_token(self.params, last, prefill_key)
+        else:
+            tok0, cache = self._prefill(
+                self.params, jnp.asarray(tokens), jnp.asarray(all_lengths), cache, prefill_key, row_valid
+            )
         eos = cfg.eos_id
         done = (tok0 == eos) if eos is not None else jnp.zeros(tok0.shape, bool)
         # synthetic batch-padding rows start done: they emit pads, never advance
