@@ -1,0 +1,102 @@
+"""Speculative decoding cost model on real hardware.
+
+Random-init models make *realized* acceptance meaningless (a random draft agrees
+with a random target ~never), so this bench measures what hardware determines —
+the per-round cost — and reports the implied speedup curve over plain decode:
+
+    speedup(E[accepted]) = (E[accepted] + 1) * t_plain_token / t_round
+
+where t_round = gamma draft steps + ONE target verify of gamma+1 positions
+(decode is weight-bandwidth bound, so the verify costs about one plain step).
+``vs_baseline`` is the break-even acceptance count — how many of the gamma
+drafts must be right on average before speculation wins; everything above it is
+profit. The exactness of the engine (output == target-only greedy) is pinned by
+tests/unit/test_speculative.py.
+
+Prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+from benchmarks.common import Timer, emit, log
+
+PROXY_LAYERS = 8
+DRAFT_LAYERS = 1
+DRAFT_DIM = 1024
+BATCH = 8
+PROMPT_LEN = 128
+NEW_TOKENS = 64
+GAMMA = 4
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from unionml_tpu.models import GenerationConfig, Generator, Llama, LlamaConfig, SpeculativeGenerator
+
+    log(f"devices: {jax.devices()}")
+    t_config = LlamaConfig.llama3_8b(
+        n_layers=PROXY_LAYERS, param_dtype=jnp.bfloat16, max_seq_len=PROMPT_LEN + NEW_TOKENS + GAMMA + 1
+    )
+    d_config = LlamaConfig.llama3_8b(
+        n_layers=DRAFT_LAYERS, dim=DRAFT_DIM, n_heads=8, n_kv_heads=4, hidden_dim=4 * DRAFT_DIM,
+        param_dtype=jnp.bfloat16, max_seq_len=PROMPT_LEN + NEW_TOKENS + GAMMA + 1,
+    )
+    target = Llama(t_config)
+    draft = Llama(d_config)
+    tp = jax.jit(lambda k: target.init(k, jnp.zeros((1, 8), jnp.int32))["params"])(jax.random.PRNGKey(0))
+    dp = jax.jit(lambda k: draft.init(k, jnp.zeros((1, 8), jnp.int32))["params"])(jax.random.PRNGKey(1))
+    count = lambda p: sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(p))  # noqa: E731
+    log(f"target {count(tp)/1e9:.2f}B params, draft {count(dp)/1e6:.0f}M params, gamma={GAMMA}")
+
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(1, t_config.vocab_size, size=PROMPT_LEN)) for _ in range(BATCH)]
+    cfg = GenerationConfig(max_new_tokens=NEW_TOKENS, temperature=0.0, prompt_buckets=(PROMPT_LEN,))
+
+    # plain decode reference
+    plain = Generator(target, tp, cfg)
+    plain(prompts)
+    with Timer() as tw:
+        plain(prompts)
+    t_plain_token = tw.elapsed / NEW_TOKENS
+    log(f"plain decode: {t_plain_token*1e3:.2f} ms/token")
+
+    spec = SpeculativeGenerator(target, tp, draft, dp, cfg, gamma=GAMMA)
+    spec(prompts)  # compile
+    spec.rounds = spec.accepted_tokens = 0
+    with Timer() as sw:
+        spec(prompts)
+    t_round = sw.elapsed / max(spec.rounds, 1)
+    acc = spec.accepted_tokens / max(spec.rounds * BATCH, 1)
+    log(f"speculative: {spec.rounds} rounds, {t_round*1e3:.2f} ms/round, "
+        f"measured acceptance {acc:.2f}/{GAMMA} (random models: ~0 expected)")
+
+    breakeven = t_round / t_plain_token - 1
+    ceiling = (GAMMA + 1) * t_plain_token / t_round
+    log(f"break-even E[accepted] = {breakeven:.2f} of {GAMMA}; all-accept ceiling {ceiling:.2f}x")
+    for e_acc in (1, 2, 3, 4):
+        log(f"  E[accepted]={e_acc}: implied speedup {(e_acc+1)*t_plain_token/t_round:.2f}x")
+
+    emit(
+        "speculative_breakeven_accept",
+        breakeven,
+        "drafts/round",
+        breakeven,
+        round_ms=round(t_round * 1e3, 2),
+        plain_token_ms=round(t_plain_token * 1e3, 2),
+        ceiling_speedup=round(ceiling, 2),
+        gamma=GAMMA,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -22,6 +22,9 @@ SCRIPTS = {
     "vit": "bench_vit.py",
     "serving": "bench_serving.py",
     "serving_jit": "bench_serving_jit.py",
+    "generate": "bench_generate.py",
+    "speculative": "bench_speculative.py",
+    "int8_matmul": "bench_int8_matmul.py",
 }
 
 

@@ -1,0 +1,74 @@
+"""Speculative decoding exactness: output must equal target-only greedy decoding
+regardless of the draft model — a good draft only changes speed, never tokens."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from unionml_tpu.models import GenerationConfig, Generator, Llama, LlamaConfig, SpeculativeGenerator
+
+
+def _model(seed: int, n_layers: int = 2, dim: int = 64):
+    config = LlamaConfig.tiny(
+        vocab_size=97, dim=dim, n_layers=n_layers, n_heads=4, n_kv_heads=2,
+        hidden_dim=2 * dim, dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    module = Llama(config)
+    params = module.init(jax.random.PRNGKey(seed), jnp.zeros((1, 8), jnp.int32))["params"]
+    return module, params
+
+
+PROMPTS = [[3, 14, 15, 92, 6], [27, 1], [8, 2, 8, 1, 8, 2, 8], [44, 9]]
+
+
+@pytest.mark.parametrize("gamma", [1, 3, 5])
+def test_disagreeing_draft_still_exact(gamma):
+    """An unrelated (random) draft disagrees almost always — acceptance ~0 — yet
+    the emitted tokens must be exactly the target's greedy sequence."""
+    target, tp = _model(0)
+    draft, dp = _model(123, n_layers=1, dim=32)
+    cfg = GenerationConfig(max_new_tokens=10, temperature=0.0, prompt_buckets=(16,))
+
+    expected = Generator(target, tp, cfg)(PROMPTS)
+    spec = SpeculativeGenerator(target, tp, draft, dp, cfg, gamma=gamma)
+    np.testing.assert_array_equal(spec(PROMPTS), expected)
+    assert spec.rounds >= 1
+
+
+def test_perfect_draft_is_exact_and_accepts():
+    """Draft == target: proposals mostly accept (not always — the [B,1] draft
+    forward and [B,gamma+1] verify forward can differ by an ulp and flip a
+    near-tie argmax), so rounds land well below one-per-token and the output
+    is still exact."""
+    target, tp = _model(0)
+    cfg = GenerationConfig(max_new_tokens=12, temperature=0.0, prompt_buckets=(16,))
+
+    expected = Generator(target, tp, cfg)(PROMPTS)
+    spec = SpeculativeGenerator(target, tp, target, tp, cfg, gamma=3)
+    np.testing.assert_array_equal(spec(PROMPTS), expected)
+    # 11 post-prefill tokens: all-accept needs 3 rounds, one-per-token needs 11
+    assert spec.rounds <= 8
+    assert spec.accepted_tokens >= spec.rounds  # acceptance is clearly happening
+
+
+def test_eos_truncates_exactly_like_plain_decoding():
+    target, tp = _model(0)
+    draft, dp = _model(7, n_layers=1, dim=32)
+    free = Generator(
+        target, tp, GenerationConfig(max_new_tokens=10, temperature=0.0, prompt_buckets=(16,))
+    )(PROMPTS)
+    eos = int(free[0][2])  # force an eos mid-sequence for row 0
+    cfg = GenerationConfig(max_new_tokens=10, temperature=0.0, prompt_buckets=(16,), eos_id=eos, pad_id=0)
+
+    expected = Generator(target, tp, cfg)(PROMPTS)
+    spec = SpeculativeGenerator(target, tp, draft, dp, cfg, gamma=4)
+    np.testing.assert_array_equal(spec(PROMPTS), expected)
+
+
+def test_sampling_rejected():
+    target, tp = _model(0)
+    draft, dp = _model(1)
+    with pytest.raises(NotImplementedError):
+        SpeculativeGenerator(target, tp, draft, dp, GenerationConfig(temperature=0.7))

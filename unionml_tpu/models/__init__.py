@@ -2,6 +2,7 @@
 
 from unionml_tpu.models.bert import BertConfig, BertEncoder, bert_partition_rules, classification_loss  # noqa: F401
 from unionml_tpu.models.generate import GenerationConfig, Generator, init_cache, sample_tokens  # noqa: F401
+from unionml_tpu.models.speculative import SpeculativeGenerator  # noqa: F401
 from unionml_tpu.models.llama import (  # noqa: F401
     Llama,
     LlamaConfig,

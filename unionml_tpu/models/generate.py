@@ -180,6 +180,7 @@ class Generator:
             dequant = lambda p: dequantize_tree(p, dtype=compute_dtype)  # noqa: E731
         else:
             dequant = lambda p: p  # noqa: E731
+        self._dequant_params = dequant  # for engines composing on top (speculative)
 
         def apply(p: Any, tokens: jax.Array, positions: jax.Array, cache: Any, token_mask: Any):
             hidden, cache = module.apply(

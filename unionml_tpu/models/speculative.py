@@ -1,0 +1,228 @@
+"""Greedy speculative decoding: a small draft model proposes, the target verifies.
+
+Standard draft-and-verify (Leviathan et al.-style, greedy specialization): per
+round the draft model decodes ``gamma`` tokens autoregressively (cheap — small
+model), then the target model scores all ``gamma + 1`` positions in ONE cached
+forward (the same HBM traffic as a single decode step at small batch: decode is
+weight-bandwidth bound, so verifying gamma+1 tokens costs roughly one token).
+The longest prefix where draft and target argmax agree is accepted, plus the
+target's own next token as the correction/bonus — so every round emits between
+1 and gamma+1 tokens and the output is **exactly** the target-only greedy
+sequence (the oracle the tests pin).
+
+TPU-native specifics:
+
+- both models follow the shared cache contract (``unionml_tpu.models.generate``),
+  so rollback is free: per-example ``lengths`` simply advance by each row's
+  accepted count, and stale K/V beyond that is invisible (visibility mask is
+  ``slot <= position``) and overwritten by later writes — no copying, no
+  per-row cache surgery, and rows with different acceptance counts coexist in
+  one batch;
+- the whole post-prefill generation is ONE jitted ``lax.while_loop`` dispatch
+  (per-round host round trips through a remote-TPU tunnel measured ~20x the
+  round's compute); every shape is static and emitted tokens land in a device
+  output buffer via per-row ``dynamic_update_slice`` at each row's ``produced``
+  offset;
+- eos handling matches :class:`~unionml_tpu.models.generate.Generator`: the
+  first eos in a round truncates that row's emission and marks it done.
+
+Sampling (temperature > 0) requires distribution-level rejection sampling and is
+not implemented — construct with a greedy config or use the plain Generator.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from unionml_tpu.models.generate import GenerationConfig, Generator
+
+__all__ = ["SpeculativeGenerator"]
+
+
+class SpeculativeGenerator:
+    """Greedy speculative decoding over a (target, draft) model pair.
+
+    >>> spec = SpeculativeGenerator(target, target_params, draft, draft_params,
+    ...                             GenerationConfig(max_new_tokens=128, temperature=0.0),
+    ...                             gamma=4)
+    >>> tokens = spec(prompts)          # == Generator(target, ...)(prompts), faster
+
+    ``rounds`` / ``accepted_tokens`` counters expose the realized acceptance rate
+    (``accepted_tokens / (rounds * gamma)``).
+    """
+
+    def __init__(
+        self,
+        target_module: Any,
+        target_params: Any,
+        draft_module: Any,
+        draft_params: Any,
+        config: GenerationConfig = GenerationConfig(temperature=0.0),
+        *,
+        gamma: int = 4,
+        mesh: Optional[Any] = None,
+        partition_rules: Optional[Any] = None,
+        quantize: Optional[str] = None,
+    ):
+        if config.temperature != 0.0:
+            raise NotImplementedError("speculative decoding is greedy-only; use temperature=0")
+        if gamma < 1:
+            raise ValueError("gamma must be >= 1")
+        self.config = config
+        self.gamma = gamma
+        self.rounds = 0
+        self.accepted_tokens = 0
+        # reuse the Generator machinery for prefill/placement/bucketing on both
+        # models; the draft runs unquantized (it is small by construction)
+        self._target = Generator(
+            target_module, target_params, config,
+            mesh=mesh, partition_rules=partition_rules, quantize=quantize,
+        )
+        self._draft = Generator(draft_module, draft_params, config, mesh=mesh, partition_rules=partition_rules)
+        self._round_fn = None
+
+    # ------------------------------------------------------------------ round
+
+    def _build_round(self):
+        gamma = int(self.gamma)
+        cfg = self.config
+        target, draft = self._target, self._draft
+        pad = jnp.int32(cfg.pad_id)
+        eos = cfg.eos_id
+
+        def draft_apply(p, tok, positions, cache):
+            hidden, cache = draft.module.apply(
+                {"params": p}, tok, positions=positions, return_hidden=True,
+                cache=cache, token_mask=None,
+            )
+            kernel = p["lm_head"]["kernel"]
+            return (hidden @ kernel.astype(hidden.dtype)).astype(jnp.float32), cache
+
+        def target_apply(p, tok, positions, cache, token_mask):
+            hidden, cache = target.module.apply(
+                {"params": p}, tok, positions=positions, return_hidden=True,
+                cache=cache, token_mask=token_mask,
+            )
+            kernel = p["lm_head"]["kernel"]
+            return (hidden @ kernel.astype(hidden.dtype)).astype(jnp.float32), cache
+
+        def spec_round(tp, dp, t_cache, d_cache, tok, lengths, done, produced, out_buf):
+
+            # --- draft: gamma greedy steps (small-model cached decode) ---
+            def draft_body(carry, _):
+                cache, t, ln = carry
+                logits, cache = draft_apply(dp, t[:, None], ln[:, None], cache)
+                nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+                return (cache, nxt, ln + 1), nxt
+
+            (d_cache, _, _), drafts = jax.lax.scan(
+                draft_body, (d_cache, tok, lengths), None, length=gamma
+            )
+            drafts = drafts.T  # [B, gamma]
+
+            # --- target: verify tok + all gamma drafts in one cached forward ---
+            inputs = jnp.concatenate([tok[:, None], drafts], axis=1)  # [B, gamma+1]
+            positions = lengths[:, None] + jnp.arange(gamma + 1)[None]
+            logits, t_cache = target_apply(tp, inputs, positions, t_cache, (~done)[:, None])
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, gamma+1]
+
+            # longest agreeing prefix: a[b] = #{i : drafts[b, :i+1] == greedy[b, :i+1]}
+            match = jnp.cumprod((drafts == greedy[:, :gamma]).astype(jnp.int32), axis=1)
+            accepted = match.sum(axis=1)  # [B] in [0, gamma]
+
+            # emitted tokens this round: greedy[:, :accepted+1] then pads
+            idx = jnp.arange(gamma + 1)[None]
+            emit_mask = idx <= accepted[:, None]
+            emitted = jnp.where(emit_mask, greedy, pad)
+            if eos is not None:
+                is_eos = (emitted == eos) & emit_mask
+                # truncate after the first eos: positions strictly beyond it emit pad
+                seen_before = jnp.cumsum(is_eos.astype(jnp.int32), axis=1) - is_eos.astype(jnp.int32)
+                emit_mask = emit_mask & (seen_before == 0)
+                emitted = jnp.where(emit_mask, emitted, pad)
+                row_hits_eos = is_eos.any(axis=1)
+            else:
+                row_hits_eos = jnp.zeros_like(done)
+            emitted = jnp.where(done[:, None], pad, emitted)
+            n_emit = jnp.where(done, 0, emit_mask.sum(axis=1))
+
+            # clip to the generation budget
+            room = jnp.maximum(cfg.max_new_tokens - produced, 0)
+            n_emit = jnp.minimum(n_emit, room)
+            emitted = jnp.where(idx < n_emit[:, None], emitted, pad)
+
+            out_buf = jax.vmap(
+                lambda buf, row, start: jax.lax.dynamic_update_slice(buf, row, (start,))
+            )(out_buf, emitted, produced)
+
+            new_done = done | row_hits_eos | (produced + n_emit >= cfg.max_new_tokens)
+            # next round continues after the last emitted token; finished rows freeze
+            tok = jnp.where(
+                new_done, tok, jnp.take_along_axis(emitted, jnp.maximum(n_emit - 1, 0)[:, None], axis=1)[:, 0]
+            )
+            lengths = lengths + jnp.where(done, 0, n_emit)
+            produced = produced + n_emit
+            acc_count = jnp.where(done, 0, jnp.minimum(accepted, room)).sum()
+            return t_cache, d_cache, tok, lengths, new_done, produced, out_buf, acc_count
+
+        def spec_loop(tp, dp, t_cache, d_cache, tok, lengths, done, produced, out_buf):
+            """The full post-prefill generation as ONE device-side while_loop —
+            per-round host round trips through a remote-TPU tunnel would otherwise
+            dominate the round cost (measured ~20x the compute)."""
+            tp = target._dequant_params(tp)
+            dp = draft._dequant_params(dp)
+
+            def cond(state):
+                return jnp.any(~state[4])
+
+            def body(state):
+                t_cache, d_cache, tok, lengths, done, produced, out_buf, rounds, acc_total = state
+                t_cache, d_cache, tok, lengths, done, produced, out_buf, acc = spec_round(
+                    tp, dp, t_cache, d_cache, tok, lengths, done, produced, out_buf
+                )
+                return (t_cache, d_cache, tok, lengths, done, produced, out_buf, rounds + 1, acc_total + acc)
+
+            state = (t_cache, d_cache, tok, lengths, done, produced, out_buf, jnp.int32(0), jnp.int32(0))
+            state = jax.lax.while_loop(cond, body, state)
+            # final caches ride along (and are dropped by the caller) so the
+            # donated inputs have outputs to alias with
+            return state[6], state[7], state[8], state[0], state[1]
+
+        return jax.jit(spec_loop, donate_argnums=(2, 3))
+
+    # ------------------------------------------------------------------ generate
+
+    def __call__(self, prompts: Sequence[Sequence[int]], *, seed: int = 0) -> np.ndarray:
+        """Generate greedily; returns exactly what the target-only Generator would."""
+        cfg = self.config
+        if self._round_fn is None:
+            self._round_fn = self._build_round()
+
+        # prefill both models; extra cache headroom for the last round's overshoot
+        n, tok0_t, (t_cache, _, lengths, done_t, _) = self._target._start(
+            prompts, seed, extra_cache=self.gamma + 1
+        )
+        _, _, (d_cache, _, d_lengths, _, _) = self._draft._start(prompts, seed, extra_cache=self.gamma + 1)
+        del d_lengths  # same values as lengths (same prompts)
+
+        batch = int(tok0_t.shape[0])
+        cap = cfg.max_new_tokens + self.gamma + 1
+        out_buf = jnp.full((batch, cap), cfg.pad_id, jnp.int32)
+        # the prompt-sampled token is emission #1 (same as Generator's tok0)
+        out_buf = out_buf.at[:, 0].set(tok0_t)
+        produced = jnp.ones((batch,), jnp.int32)
+        done = done_t | (produced >= cfg.max_new_tokens)
+        tok = tok0_t
+
+        out_buf, rounds, accepted, _, _ = self._round_fn(
+            self._target.params, self._draft.params,
+            t_cache, d_cache, tok, lengths, done, produced, out_buf,
+        )
+        self.rounds += int(rounds)
+        self.accepted_tokens += int(accepted)
+        return np.asarray(out_buf)[:n, : cfg.max_new_tokens]
