@@ -139,6 +139,71 @@ def test_metrics_endpoint_reports_latency_percentiles(trained_app):
     assert predict["p50_ms"] > 0 and predict["p99_ms"] >= predict["p50_ms"]
 
 
+def test_predict_stream_requires_registration(trained_app):
+    status, payload, _ = _dispatch(
+        trained_app, "POST", "/predict-stream", json.dumps({"features": []}).encode()
+    )
+    assert status == 404
+    assert "stream predictor" in payload["detail"]
+
+
+def test_predict_stream_chunked_over_socket(sklearn_model):
+    """The streaming route over a real socket: chunked transfer encoding, one
+    ND-JSON line per yielded item, arriving as separate HTTP chunks."""
+    import socket
+    import threading
+    import time as _time
+
+    sklearn_model.train(hyperparameters={"max_iter": 500})
+
+    @sklearn_model.stream_predictor
+    def stream_predictor(model_object, features):
+        for i in range(3):
+            yield {"piece": i, "rows": len(features)}
+
+    app = serving_app(sklearn_model)
+    host = "127.0.0.1"
+    with socket.socket() as probe_sock:
+        probe_sock.bind((host, 0))
+        port = probe_sock.getsockname()[1]
+    thread = threading.Thread(target=lambda: app.run(host=host, port=port), daemon=True)
+    thread.start()
+    for _ in range(100):
+        try:
+            socket.create_connection((host, port), timeout=1).close()
+            break
+        except OSError:
+            _time.sleep(0.05)
+
+    body = json.dumps({"features": [{"x": 1.0}]}).encode()
+    request = (
+        f"POST /predict-stream HTTP/1.1\r\nHost: x\r\nConnection: close\r\n"
+        f"Content-Length: {len(body)}\r\n\r\n"
+    ).encode() + body
+    with socket.create_connection((host, port), timeout=10) as sock:
+        sock.sendall(request)
+        raw = b""
+        while True:
+            data = sock.recv(65536)
+            if not data:
+                break
+            raw += data
+    headers, _, chunked = raw.partition(b"\r\n\r\n")
+    assert b"Transfer-Encoding: chunked" in headers
+    assert b"application/x-ndjson" in headers
+    # de-chunk
+    payload = b""
+    rest = chunked
+    while rest:
+        size_line, _, rest = rest.partition(b"\r\n")
+        size = int(size_line, 16)
+        if size == 0:
+            break
+        payload, rest = payload + rest[:size], rest[size + 2 :]
+    lines = [json.loads(line) for line in payload.decode().strip().split("\n")]
+    assert lines == [{"piece": i, "rows": 1} for i in range(3)]
+
+
 def test_http_keep_alive_serves_multiple_requests_per_connection(trained_app):
     import socket
     import threading

@@ -76,6 +76,21 @@ def test_text_generation_template_trains_generates_and_serves(render, tmp_path):
     )
     assert status == 200 and texts == outputs
 
+    # streaming route: ND-JSON chunks of per-prompt text pieces; reassembling
+    # each prompt's pieces reproduces the non-streaming continuation
+    async def consume():
+        status, payload, content_type = await app.dispatch(
+            "POST", "/predict-stream", json.dumps({"features": prompts}).encode()
+        )
+        assert status == 200 and content_type == "application/x-ndjson"
+        return [chunk async for chunk in payload]
+
+    chunks = asyncio.run(consume())
+    assert len(chunks) > 1  # actually incremental, not one blob
+    pieces = [json.loads(c.decode()) for c in chunks]
+    for i, prompt in enumerate(prompts):
+        assert prompt + "".join(p[i] for p in pieces) == outputs[i]
+
 
 def test_serverless_template_trains_and_scores(render):
     render("basic-serverless")

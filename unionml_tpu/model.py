@@ -74,6 +74,7 @@ class Model:
         self._init: Callable = self._default_init
         self._trainer: Optional[Callable] = None
         self._predictor: Optional[Callable] = None
+        self._stream_predictor: Optional[Callable] = None
         self._evaluator: Optional[Callable] = None
         self._saver: Callable = self._default_saver
         self._loader: Callable = self._default_loader
@@ -258,6 +259,19 @@ class Model:
         self._predict_stage_kwargs = {"resources": DEFAULT_RESOURCES, **stage_kwargs}
         self._predict_stage = None
         self._predict_from_features_stage = None
+        return fn
+
+    def stream_predictor(self, fn: Optional[Callable] = None):
+        """Register an incremental predictor for the streaming serving route
+        (``POST /predict-stream``): ``fn(model_object, features)`` must return an
+        iterator/generator of JSON-serializable chunks, which the server emits as
+        newline-delimited JSON over chunked transfer encoding. No reference
+        analog — the reference's serve path cannot stream
+        (unionml/fastapi.py:50-64); this is the serving face of
+        :meth:`unionml_tpu.models.generate.Generator.stream`."""
+        if fn is None:
+            return self.stream_predictor
+        self._stream_predictor = fn
         return fn
 
     def _call_predictor(self, model_object: Any, features: Any) -> Any:

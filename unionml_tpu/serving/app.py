@@ -18,6 +18,7 @@ Deviations, both deliberate:
 
 from __future__ import annotations
 
+import asyncio
 import json
 import os
 import re
@@ -79,6 +80,7 @@ class ServingApp:
         self.server.route("GET", "/health", self._health)
         self.server.route("GET", "/metrics", self._metrics)
         self.server.route("POST", "/predict", self._predict)
+        self.server.route("POST", "/predict-stream", self._predict_stream)
 
     # ------------------------------------------------------------------ lifecycle
 
@@ -220,6 +222,46 @@ class ServingApp:
         except Exception as exc:
             raise HTTPError(500, f"prediction failed: {type(exc).__name__}: {exc}")
         return 200, _to_jsonable(predictions), "application/json"
+
+    async def _predict_stream(self, body: bytes):
+        """Incremental predictions as newline-delimited JSON over chunked transfer.
+
+        Requires a registered ``@model.stream_predictor`` — an
+        ``fn(model_object, features) -> iterator of chunks`` (e.g. wrapping
+        :meth:`unionml_tpu.models.generate.Generator.stream`). Each yielded chunk
+        is one ND-JSON line on the wire, emitted as it materializes. The blocking
+        iterator is advanced in the default executor so device steps never stall
+        the event loop; in-server latency metrics cover time-to-first-chunk."""
+        if self.model._stream_predictor is None:
+            raise HTTPError(404, "no stream predictor registered; use @model.stream_predictor")
+        try:
+            payload = json.loads(body.decode() or "{}")
+        except json.JSONDecodeError as exc:
+            raise HTTPError(400, f"invalid JSON body: {exc}")
+        features = payload.get("features") if isinstance(payload, dict) else None
+        if features is None:
+            raise HTTPError(500, "features must be supplied.")
+        if self.model.artifact is None:
+            raise HTTPError(500, "Model artifact not found.")
+        try:
+            features = self.model._dataset.get_features(features)
+            iterator = iter(self.model._stream_predictor(self.model.artifact.model_object, features))
+        except HTTPError:
+            raise
+        except Exception as exc:
+            raise HTTPError(500, f"stream setup failed: {type(exc).__name__}: {exc}")
+
+        loop = asyncio.get_running_loop()
+        sentinel = object()
+
+        async def chunks():
+            while True:
+                item = await loop.run_in_executor(None, next, iterator, sentinel)
+                if item is sentinel:
+                    return
+                yield (json.dumps(_to_jsonable(item), default=str) + "\n").encode()
+
+        return 200, chunks(), "application/x-ndjson"
 
     # ------------------------------------------------------------------ entry points
 

@@ -84,6 +84,29 @@ class HTTPServer:
         return method.upper(), path, body, keep_alive
 
     @staticmethod
+    def _encode_stream_head(status: int, content_type: str, *, keep_alive: bool) -> bytes:
+        connection = "keep-alive" if keep_alive else "close"
+        return (
+            f"HTTP/1.1 {status} {_STATUS_PHRASES.get(status, 'Unknown')}\r\n"
+            f"Content-Type: {content_type}\r\n"
+            "Transfer-Encoding: chunked\r\n"
+            f"Connection: {connection}\r\n\r\n"
+        ).encode("latin1")
+
+    @staticmethod
+    async def _write_stream(writer: asyncio.StreamWriter, payload: Any) -> None:
+        """Emit an async-iterator payload as HTTP/1.1 chunked transfer encoding,
+        draining per chunk so each arrives as soon as it is produced."""
+        async for chunk in payload:
+            data = chunk if isinstance(chunk, bytes) else str(chunk).encode()
+            if not data:
+                continue  # a zero-length chunk would terminate the stream early
+            writer.write(f"{len(data):x}\r\n".encode("latin1") + data + b"\r\n")
+            await writer.drain()
+        writer.write(b"0\r\n\r\n")
+        await writer.drain()
+
+    @staticmethod
     def _encode_response(
         status: int, payload: Any, content_type: str = "application/json", *, keep_alive: bool = False
     ) -> bytes:
@@ -146,8 +169,13 @@ class HTTPServer:
                     break
                 method, path, body, keep_alive = request
                 status, payload, content_type = await self.dispatch(method, path, body)
-                writer.write(self._encode_response(status, payload, content_type, keep_alive=keep_alive))
-                await writer.drain()
+                if hasattr(payload, "__aiter__"):
+                    # streaming handler: chunked transfer, one HTTP chunk per item
+                    writer.write(self._encode_stream_head(status, content_type, keep_alive=keep_alive))
+                    await self._write_stream(writer, payload)
+                else:
+                    writer.write(self._encode_response(status, payload, content_type, keep_alive=keep_alive))
+                    await writer.drain()
                 if not keep_alive:
                     break
         except (ValueError, asyncio.IncompleteReadError) as exc:

@@ -124,8 +124,7 @@ def feature_loader(raw) -> List[str]:
 _generators: dict = {}
 
 
-@model.predictor
-def predictor(state: train_state.TrainState, features: List[str]) -> List[str]:
+def _generator_for(state: train_state.TrainState) -> Generator:
     gen = _generators.get(id(state))
     if gen is None:
         gen = Generator(
@@ -135,9 +134,25 @@ def predictor(state: train_state.TrainState, features: List[str]) -> List[str]:
         )
         _generators.clear()  # one live state at a time; drop stale compiled engines
         _generators[id(state)] = gen
-    prompts = [encode(p) or [STOI[" "]] for p in features]
-    out = gen(prompts)
+    return gen
+
+
+def _encode_prompts(features: List[str]) -> List[List[int]]:
+    return [encode(p) or [STOI[" "]] for p in features]
+
+
+@model.predictor
+def predictor(state: train_state.TrainState, features: List[str]) -> List[str]:
+    out = _generator_for(state)(_encode_prompts(features))
     return [p + decode(row) for p, row in zip(features, out)]
+
+
+@model.stream_predictor
+def stream_predictor(state: train_state.TrainState, features: List[str]):
+    """POST /predict-stream: yields per-prompt text pieces as they decode —
+    concatenating a prompt's pieces reproduces the /predict continuation."""
+    for chunk in _generator_for(state).stream(_encode_prompts(features), chunk_size=8):
+        yield [decode(row) for row in chunk]
 
 
 if __name__ == "__main__":
