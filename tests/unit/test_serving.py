@@ -204,6 +204,73 @@ def test_predict_stream_chunked_over_socket(sklearn_model):
     assert lines == [{"piece": i, "rows": 1} for i in range(3)]
 
 
+def test_predict_stream_setup_error_is_500_not_truncated_200(sklearn_model):
+    """Generator-function predictors defer their body to the first next(); the
+    route must surface that first failure as a clean 500, not a truncated 200."""
+    sklearn_model.train(hyperparameters={"max_iter": 500})
+
+    @sklearn_model.stream_predictor
+    def stream_predictor(model_object, features):
+        raise RuntimeError("boom")
+        yield  # pragma: no cover
+
+    app = serving_app(sklearn_model)
+    status, payload, _ = _dispatch(
+        app, "POST", "/predict-stream", json.dumps({"features": [{"x": 1.0}]}).encode()
+    )
+    assert status == 500 and "boom" in payload["detail"]
+
+    # body contract matches /predict: a non-dict JSON body is a 400
+    status, payload, _ = _dispatch(app, "POST", "/predict-stream", b"[1, 2]")
+    assert status == 400 and "JSON object" in payload["detail"]
+
+
+def test_predict_stream_http10_gets_unframed_body(sklearn_model):
+    """HTTP/1.0 peers cannot parse chunked framing: they get raw ND-JSON bytes
+    delimited by connection close."""
+    import socket
+    import threading
+    import time as _time
+
+    sklearn_model.train(hyperparameters={"max_iter": 500})
+
+    @sklearn_model.stream_predictor
+    def stream_predictor(model_object, features):
+        yield {"n": 1}
+        yield {"n": 2}
+
+    app = serving_app(sklearn_model)
+    host = "127.0.0.1"
+    with socket.socket() as probe_sock:
+        probe_sock.bind((host, 0))
+        port = probe_sock.getsockname()[1]
+    threading.Thread(target=lambda: app.run(host=host, port=port), daemon=True).start()
+    for _ in range(100):
+        try:
+            socket.create_connection((host, port), timeout=1).close()
+            break
+        except OSError:
+            _time.sleep(0.05)
+
+    body = json.dumps({"features": [{"x": 1.0}]}).encode()
+    request = (
+        f"POST /predict-stream HTTP/1.0\r\nHost: x\r\nContent-Length: {len(body)}\r\n\r\n"
+    ).encode() + body
+    with socket.create_connection((host, port), timeout=10) as sock:
+        sock.sendall(request)
+        raw = b""
+        while True:
+            data = sock.recv(65536)
+            if not data:
+                break  # close-delimited
+            raw += data
+    headers, _, stream_body = raw.partition(b"\r\n\r\n")
+    assert b"Transfer-Encoding" not in headers
+    assert b"Connection: close" in headers
+    lines = [json.loads(line) for line in stream_body.decode().strip().split("\n")]
+    assert lines == [{"n": 1}, {"n": 2}]
+
+
 def test_http_keep_alive_serves_multiple_requests_per_connection(trained_app):
     import socket
     import threading

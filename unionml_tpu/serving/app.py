@@ -194,12 +194,7 @@ class ServingApp:
                 raise
             except Exception as exc:
                 raise HTTPError(500, f"prediction failed: {type(exc).__name__}: {exc}")
-        try:
-            payload = json.loads(body.decode() or "{}")
-        except json.JSONDecodeError as exc:
-            raise HTTPError(400, f"invalid JSON body: {exc}")
-        if not isinstance(payload, dict):
-            raise HTTPError(400, "request body must be a JSON object")
+        payload = self._parse_json_object(body)
 
         inputs = payload.get("inputs")
         features = payload.get("features")
@@ -223,6 +218,17 @@ class ServingApp:
             raise HTTPError(500, f"prediction failed: {type(exc).__name__}: {exc}")
         return 200, _to_jsonable(predictions), "application/json"
 
+    @staticmethod
+    def _parse_json_object(body: bytes) -> dict:
+        """Shared request-body contract for /predict and /predict-stream."""
+        try:
+            payload = json.loads(body.decode() or "{}")
+        except json.JSONDecodeError as exc:
+            raise HTTPError(400, f"invalid JSON body: {exc}")
+        if not isinstance(payload, dict):
+            raise HTTPError(400, "request body must be a JSON object")
+        return payload
+
     async def _predict_stream(self, body: bytes):
         """Incremental predictions as newline-delimited JSON over chunked transfer.
 
@@ -231,35 +237,35 @@ class ServingApp:
         :meth:`unionml_tpu.models.generate.Generator.stream`). Each yielded chunk
         is one ND-JSON line on the wire, emitted as it materializes. The blocking
         iterator is advanced in the default executor so device steps never stall
-        the event loop; in-server latency metrics cover time-to-first-chunk."""
+        the event loop. The FIRST chunk is produced before the response starts:
+        generator-function predictors defer their body to the first ``next()``,
+        so without this a setup error would surface as a truncated 200 instead
+        of a 500 — and it makes the in-server latency metric for this route mean
+        time-to-first-chunk."""
         if self.model._stream_predictor is None:
             raise HTTPError(404, "no stream predictor registered; use @model.stream_predictor")
-        try:
-            payload = json.loads(body.decode() or "{}")
-        except json.JSONDecodeError as exc:
-            raise HTTPError(400, f"invalid JSON body: {exc}")
-        features = payload.get("features") if isinstance(payload, dict) else None
+        payload = self._parse_json_object(body)
+        features = payload.get("features")
         if features is None:
             raise HTTPError(500, "features must be supplied.")
         if self.model.artifact is None:
             raise HTTPError(500, "Model artifact not found.")
+        loop = asyncio.get_running_loop()
+        sentinel = object()
         try:
             features = self.model._dataset.get_features(features)
             iterator = iter(self.model._stream_predictor(self.model.artifact.model_object, features))
+            first = await loop.run_in_executor(None, next, iterator, sentinel)
         except HTTPError:
             raise
         except Exception as exc:
-            raise HTTPError(500, f"stream setup failed: {type(exc).__name__}: {exc}")
-
-        loop = asyncio.get_running_loop()
-        sentinel = object()
+            raise HTTPError(500, f"stream predictor failed: {type(exc).__name__}: {exc}")
 
         async def chunks():
-            while True:
-                item = await loop.run_in_executor(None, next, iterator, sentinel)
-                if item is sentinel:
-                    return
+            item = first
+            while item is not sentinel:
                 yield (json.dumps(_to_jsonable(item), default=str) + "\n").encode()
+                item = await loop.run_in_executor(None, next, iterator, sentinel)
 
         return 200, chunks(), "application/x-ndjson"
 

@@ -46,7 +46,7 @@ class HTTPServer:
 
     async def _read_request(
         self, reader: asyncio.StreamReader, request_line: Optional[bytes] = None
-    ) -> Optional[Tuple[str, str, bytes, bool]]:
+    ) -> Optional[Tuple[str, str, bytes, bool, bool]]:
         if request_line is None:
             request_line = await reader.readline()
         if not request_line:
@@ -59,7 +59,8 @@ class HTTPServer:
 
         content_length = 0
         # HTTP/1.1 defaults to persistent connections; 1.0 must opt in
-        keep_alive = "1.0" not in version
+        http10 = "1.0" in version
+        keep_alive = not http10
         wants_close = False
         while True:
             header_line = await reader.readline()
@@ -81,30 +82,38 @@ class HTTPServer:
         if content_length > MAX_BODY_BYTES:
             raise ValueError("request body too large")
         body = await reader.readexactly(content_length) if content_length else b""
-        return method.upper(), path, body, keep_alive
+        return method.upper(), path, body, keep_alive, http10
 
     @staticmethod
-    def _encode_stream_head(status: int, content_type: str, *, keep_alive: bool) -> bytes:
-        connection = "keep-alive" if keep_alive else "close"
+    def _encode_stream_head(status: int, content_type: str, *, keep_alive: bool, http10: bool) -> bytes:
+        """Response head for a streaming body. HTTP/1.0 peers cannot parse chunked
+        framing, so they get an unframed close-delimited body instead."""
+        connection = "keep-alive" if (keep_alive and not http10) else "close"
+        framing = "" if http10 else "Transfer-Encoding: chunked\r\n"
         return (
             f"HTTP/1.1 {status} {_STATUS_PHRASES.get(status, 'Unknown')}\r\n"
             f"Content-Type: {content_type}\r\n"
-            "Transfer-Encoding: chunked\r\n"
+            f"{framing}"
             f"Connection: {connection}\r\n\r\n"
         ).encode("latin1")
 
     @staticmethod
-    async def _write_stream(writer: asyncio.StreamWriter, payload: Any) -> None:
-        """Emit an async-iterator payload as HTTP/1.1 chunked transfer encoding,
-        draining per chunk so each arrives as soon as it is produced."""
+    async def _write_stream(writer: asyncio.StreamWriter, payload: Any, *, http10: bool) -> None:
+        """Emit an async-iterator payload, draining per chunk so each arrives as
+        soon as it is produced: chunked transfer encoding for HTTP/1.1, raw bytes
+        delimited by connection close for HTTP/1.0."""
         async for chunk in payload:
             data = chunk if isinstance(chunk, bytes) else str(chunk).encode()
             if not data:
-                continue  # a zero-length chunk would terminate the stream early
-            writer.write(f"{len(data):x}\r\n".encode("latin1") + data + b"\r\n")
+                continue  # a zero-length HTTP chunk would terminate the stream early
+            if http10:
+                writer.write(data)
+            else:
+                writer.write(f"{len(data):x}\r\n".encode("latin1") + data + b"\r\n")
             await writer.drain()
-        writer.write(b"0\r\n\r\n")
-        await writer.drain()
+        if not http10:
+            writer.write(b"0\r\n\r\n")
+            await writer.drain()
 
     @staticmethod
     def _encode_response(
@@ -167,12 +176,28 @@ class HTTPServer:
                 request = await self._read_request(reader, request_line)
                 if request is None:
                     break
-                method, path, body, keep_alive = request
+                method, path, body, keep_alive, http10 = request
                 status, payload, content_type = await self.dispatch(method, path, body)
                 if hasattr(payload, "__aiter__"):
-                    # streaming handler: chunked transfer, one HTTP chunk per item
-                    writer.write(self._encode_stream_head(status, content_type, keep_alive=keep_alive))
-                    await self._write_stream(writer, payload)
+                    # streaming handler: one HTTP chunk per item (1.0 peers get an
+                    # unframed close-delimited body)
+                    keep_alive = keep_alive and not http10
+                    writer.write(self._encode_stream_head(status, content_type, keep_alive=keep_alive, http10=http10))
+                    try:
+                        await self._write_stream(writer, payload, http10=http10)
+                    except Exception as exc:
+                        # predictor failure mid-stream, or the client went away
+                        # (ConnectionResetError from drain): the response is already
+                        # underway, so truncate the stream and drop the connection
+                        logger.warning(f"stream aborted: {type(exc).__name__}: {exc}")
+                        break
+                    finally:
+                        closer = getattr(payload, "aclose", None)
+                        if closer is not None:
+                            try:
+                                await closer()  # release the producer promptly
+                            except Exception:
+                                pass
                 else:
                     writer.write(self._encode_response(status, payload, content_type, keep_alive=keep_alive))
                     await writer.drain()
