@@ -1,0 +1,97 @@
+"""Beam search correctness.
+
+Oracles: (1) with beam width covering the whole search space, beam search must
+find the exact max-sum-log-prob continuation that brute-force enumeration of
+every token sequence finds; (2) beam width 1 must equal greedy decoding."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from unionml_tpu.models import GenerationConfig, Generator, Llama, LlamaConfig
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    config = LlamaConfig.tiny(
+        vocab_size=6, dim=32, n_layers=2, n_heads=4, n_kv_heads=2, hidden_dim=64,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    module = Llama(config)
+    params = module.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))["params"]
+    return module, params, config
+
+
+def brute_force_best(module, params, prompt, steps, vocab):
+    """Enumerate every continuation and return the max-sum-log-prob one."""
+    best, best_score = None, -np.inf
+    for cont in itertools.product(range(vocab), repeat=steps):
+        tokens = list(prompt) + list(cont)
+        logits = module.apply({"params": params}, jnp.asarray([tokens], jnp.int32))
+        lp = jax.nn.log_softmax(logits[0].astype(jnp.float32), axis=-1)
+        score = sum(float(lp[len(prompt) - 1 + i, cont[i]]) for i in range(steps))
+        if score > best_score:
+            best, best_score = cont, score
+    return list(best), best_score
+
+
+def test_full_width_beam_equals_exhaustive_search(tiny):
+    module, params, config = tiny
+    steps, vocab = 3, config.vocab_size
+    gen = Generator(
+        module, params, GenerationConfig(max_new_tokens=steps, temperature=0.0, prompt_buckets=(8,))
+    )
+    for prompt in ([1, 4, 2], [5, 3]):
+        expected, _ = brute_force_best(module, params, prompt, steps, vocab)
+        # beam width vocab^(steps-1) tracks every prefix -> exact search
+        out = gen.beam_search([prompt], num_beams=vocab ** (steps - 1))
+        assert out[0].tolist() == expected, prompt
+
+
+def test_beam_one_equals_greedy(tiny):
+    module, params, _ = tiny
+    gen = Generator(
+        module, params, GenerationConfig(max_new_tokens=8, temperature=0.0, prompt_buckets=(8,))
+    )
+    prompts = [[1, 2, 3], [4, 5]]
+    np.testing.assert_array_equal(gen.beam_search(prompts, num_beams=1), gen(prompts))
+
+
+def test_beam_width_improves_or_matches_score(tiny):
+    """A wider beam can only find an equal-or-better-scoring sequence."""
+    module, params, config = tiny
+    steps = 4
+    gen = Generator(
+        module, params, GenerationConfig(max_new_tokens=steps, temperature=0.0, prompt_buckets=(8,))
+    )
+    prompt = [2, 1]
+
+    def seq_score(cont):
+        tokens = list(prompt) + list(cont)
+        logits = module.apply({"params": params}, jnp.asarray([tokens], jnp.int32))
+        lp = jax.nn.log_softmax(logits[0].astype(jnp.float32), axis=-1)
+        return sum(float(lp[len(prompt) - 1 + i, cont[i]]) for i in range(steps))
+
+    scores = [seq_score(gen.beam_search([prompt], num_beams=k)[0].tolist()) for k in (1, 2, 4, 8)]
+    assert all(b >= a - 1e-5 for a, b in zip(scores, scores[1:])), scores
+
+
+def test_beam_eos_finishes_and_pads(tiny):
+    module, params, config = tiny
+    gen_free = Generator(
+        module, params, GenerationConfig(max_new_tokens=6, temperature=0.0, prompt_buckets=(8,))
+    )
+    free = gen_free.beam_search([[1, 2]], num_beams=3)[0].tolist()
+    eos = free[1]
+    gen = Generator(
+        module, params,
+        GenerationConfig(max_new_tokens=6, temperature=0.0, prompt_buckets=(8,), eos_id=eos, pad_id=0),
+    )
+    out = gen.beam_search([[1, 2]], num_beams=3)[0].tolist()
+    if eos in out:
+        cut = out.index(eos)
+        assert all(t == 0 for t in out[cut + 1 :])

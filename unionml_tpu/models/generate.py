@@ -208,7 +208,7 @@ class Generator:
             hidden, cache = apply(p, tokens, positions, cache, token_mask)
             last = jnp.take_along_axis(hidden, (lengths - 1)[:, None, None], axis=1)[:, 0]
             tok0 = sample_tokens(head(p, last), key, config)
-            return tok0, cache
+            return tok0, cache, last.astype(jnp.float32)
 
         def prefill_chunk(p, tokens, start, lengths, cache, row_valid):
             """One chunk of a long-context prefill: columns [start, start+C) of the
@@ -262,6 +262,9 @@ class Generator:
         self._prefill_chunk = jax.jit(prefill_chunk, donate_argnums=(4,))
         self._first_token = jax.jit(first_token)
         self._decode = jax.jit(decode_steps, static_argnums=(6,), donate_argnums=(1,))
+        self._apply_fn = apply  # for engines composing on top (beam search)
+        self._head_fn = head
+        self._beam_fns: dict = {}
 
     # ------------------------------------------------------------------ helpers
 
@@ -290,19 +293,32 @@ class Generator:
 
     # ------------------------------------------------------------------ generate
 
-    def _start(self, prompts: Sequence[Sequence[int]], seed: int, extra_cache: int = 0):
+    def _start(
+        self,
+        prompts: Sequence[Sequence[int]],
+        seed: int,
+        extra_cache: int = 0,
+        batch_override: Optional[int] = None,
+    ):
         """Shared prefill setup: pad/bucket the prompts, allocate + place the cache,
-        run prefill, and return the first sampled token plus the decode carry."""
+        run prefill, and return the first sampled token, the last-token hidden
+        states, and the decode carry. ``batch_override`` pins the padded batch
+        exactly (beam search needs batch == groups * num_beams)."""
         cfg = self.config
         n = len(prompts)
         lengths = np.array([max(len(p), 1) for p in prompts], np.int32)
         bucket = self._bucket(int(lengths.max()))
-        # pad the batch to a power of two so XLA sees few batch shapes — and to a
-        # multiple of the mesh's data axis so the cache's batch dim shards evenly
-        batch = 1 << max(0, (n - 1).bit_length())
-        if self.mesh is not None and "data" in self.mesh.axis_names:
-            data = int(self.mesh.shape["data"])
-            batch = int(math.ceil(batch / data) * data)
+        if batch_override is not None:
+            if batch_override < n:
+                raise ValueError(f"batch_override {batch_override} < {n} prompts")
+            batch = batch_override
+        else:
+            # pad the batch to a power of two so XLA sees few batch shapes — and to
+            # a multiple of the mesh's data axis so the cache batch dim shards evenly
+            batch = 1 << max(0, (n - 1).bit_length())
+            if self.mesh is not None and "data" in self.mesh.axis_names:
+                data = int(self.mesh.shape["data"])
+                batch = int(math.ceil(batch / data) * data)
         tokens = np.full((batch, bucket), cfg.pad_id, np.int32)
         for i, p in enumerate(prompts):
             tokens[i, : len(p)] = np.asarray(p, np.int32)
@@ -333,7 +349,7 @@ class Generator:
                 last = jnp.where(has[:, None], chunk_last, last)
             tok0 = self._first_token(self.params, last, prefill_key)
         else:
-            tok0, cache = self._prefill(
+            tok0, cache, last = self._prefill(
                 self.params, jnp.asarray(tokens), jnp.asarray(all_lengths), cache, prefill_key, row_valid
             )
         eos = cfg.eos_id
@@ -341,18 +357,137 @@ class Generator:
         # synthetic batch-padding rows start done: they emit pads, never advance
         # their cache, and stay out of routed-expert capacity
         done = done | ~row_valid
-        return n, tok0, (cache, tok0, jnp.asarray(all_lengths), done, key)
+        return n, tok0, last, (cache, tok0, jnp.asarray(all_lengths), done, key)
 
     def __call__(self, prompts: Sequence[Sequence[int]], *, seed: int = 0) -> np.ndarray:
         """Generate ``max_new_tokens`` per prompt; returns ``[len(prompts), max_new]``
         int32 (``pad_id`` after each example's ``eos_id``)."""
-        n, tok0, carry = self._start(prompts, seed)
+        n, tok0, _, carry = self._start(prompts, seed)
         steps = self.config.max_new_tokens - 1
         first = np.asarray(tok0)[:, None]
         if steps <= 0:
             return first[:n]
         rest, _ = self._decode(self.params, *carry, steps)
         return np.concatenate([first, np.asarray(rest)], axis=1)[:n]
+
+    def beam_search(
+        self,
+        prompts: Sequence[Sequence[int]],
+        *,
+        num_beams: int = 4,
+        length_penalty: float = 0.0,
+    ) -> np.ndarray:
+        """Deterministic beam search: returns the highest-sum-log-prob continuation
+        of ``max_new_tokens`` per prompt (``[n_prompts, max_new]`` int32).
+
+        Beams are batch rows: each prompt is prefilled ``num_beams`` times and the
+        whole search runs as ONE jitted ``lax.scan`` — each step scores all beams,
+        takes the top ``num_beams`` of the ``num_beams * vocab`` candidates per
+        prompt, and physically gathers the KV cache rows to the surviving parents
+        (decode streams the weights anyway; the cache gather is a small fraction
+        of the step's HBM traffic). A beam that emits ``eos_id`` is finished: it
+        keeps competing with its score frozen, padding from there on. With
+        ``length_penalty`` > 0 final scores are divided by
+        ``((5 + len) / 6) ** length_penalty`` (GNMT convention).
+        """
+        cfg = self.config
+        if num_beams < 1:
+            raise ValueError("num_beams must be >= 1")
+        n = len(prompts)
+        # pad whole GROUPS (not rows) so the batch is exactly groups * num_beams
+        groups = 1 << max(0, (n - 1).bit_length())
+        if self.mesh is not None and "data" in self.mesh.axis_names:
+            data = int(self.mesh.shape["data"])
+            while (groups * num_beams) % data:
+                groups *= 2
+        padded_prompts = [list(p) for p in prompts] + [[cfg.pad_id]] * (groups - n)
+        expanded = [list(p) for p in padded_prompts for _ in range(num_beams)]
+        _, _, last, (cache, _, lengths, _, _) = self._start(
+            expanded, 0, batch_override=groups * num_beams
+        )
+        done = jnp.arange(groups * num_beams) >= n * num_beams  # synthetic groups only
+        fn = self._beam_fns.get(num_beams)
+        if fn is None:
+            fn = self._build_beam_fn(num_beams)
+            self._beam_fns[num_beams] = fn
+        out, scores, _ = fn(self.params, cache, last, lengths, done)
+        out = np.asarray(out).reshape(groups, num_beams, -1)[:n]
+        scores = np.asarray(scores).reshape(groups, num_beams)[:n]
+        if cfg.eos_id is not None and length_penalty > 0.0:
+            lens = np.where(out == cfg.eos_id, 1, 0).argmax(axis=2)
+            lens = np.where((out == cfg.eos_id).any(axis=2), lens + 1, out.shape[2])
+            scores = scores / (((5.0 + lens) / 6.0) ** length_penalty)
+        best = scores.argmax(axis=1)
+        return out[np.arange(n), best]
+
+    def _build_beam_fn(self, num_beams: int):
+        cfg = self.config
+        eos = cfg.eos_id
+        pad = jnp.int32(cfg.pad_id)
+
+        def beam_fn(p, cache, last, lengths, done):
+            p = self._dequant_params(p)
+            batch = last.shape[0]
+            groups = batch // num_beams
+            compute_dtype = getattr(getattr(self.module, "config", None), "dtype", jnp.bfloat16)
+
+            def logprobs(hidden):
+                return jax.nn.log_softmax(self._head_fn(p, hidden), axis=-1)
+
+            # first expansion from the PREFILL distribution: all beams of a group
+            # share the prompt, so its top tokens seed distinct beams. With
+            # num_beams > vocab only vocab distinct seeds exist; the surplus beams
+            # start at -inf and join the pool as the tree widens in later steps.
+            lp0 = logprobs(last.astype(compute_dtype)).reshape(groups, num_beams, -1)
+            vocab = lp0.shape[-1]
+            k0 = min(num_beams, vocab)
+            seed_scores, seed_tokens = jax.lax.top_k(lp0[:, 0], k0)  # [G, k0]
+            scores = jnp.pad(seed_scores, ((0, 0), (0, num_beams - k0)), constant_values=-jnp.inf)
+            first_tokens = jnp.pad(seed_tokens, ((0, 0), (0, num_beams - k0)), constant_values=int(pad))
+            tok = jnp.where(done, pad, first_tokens.reshape(batch))
+            beam_done = done | ((tok == eos) if eos is not None else jnp.zeros_like(done))
+            out = jnp.full((batch, cfg.max_new_tokens), pad, jnp.int32).at[:, 0].set(tok)
+
+            def body(carry, col):
+                cache, tok, lengths, scores, beam_done, out = carry
+                # feed each beam's pending token (decode convention: positions =
+                # filled length; lengths advance after the feed)
+                hidden, cache = self._apply_fn(
+                    p, tok[:, None], lengths[:, None], cache, (~beam_done)[:, None]
+                )
+                lengths = lengths + jnp.where(beam_done, 0, 1)
+                lp = logprobs(hidden[:, 0]).reshape(groups, num_beams, vocab)
+                flat_done = beam_done.reshape(groups, num_beams)
+                # finished beams contribute exactly one frozen-score candidate
+                # (their pad continuation); active beams expand over the vocab
+                cand = scores[:, :, None] + jnp.where(flat_done[:, :, None], -jnp.inf, lp)
+                pad_cand = jnp.where(flat_done, scores, -jnp.inf)  # [G, K]
+                all_cand = jnp.concatenate([cand.reshape(groups, -1), pad_cand], axis=1)
+                top_scores, top_idx = jax.lax.top_k(all_cand, num_beams)  # [G, K]
+                is_pad_cand = top_idx >= num_beams * vocab
+                parent = jnp.where(is_pad_cand, top_idx - num_beams * vocab, top_idx // vocab)
+                token = jnp.where(is_pad_cand, pad, top_idx % vocab)
+
+                # reorder every per-beam tensor to the surviving parents
+                flat_parent = (jnp.arange(groups)[:, None] * num_beams + parent).reshape(batch)
+                cache = jax.tree_util.tree_map(lambda c: c[flat_parent], cache)
+                out = out[flat_parent]
+                lengths = lengths[flat_parent]
+                prev_done = beam_done[flat_parent]
+                tok = token.reshape(batch)
+                beam_done = prev_done | ((tok == eos) if eos is not None else jnp.zeros_like(prev_done))
+                out = jax.vmap(lambda row, t: row.at[col].set(t))(out, jnp.where(prev_done, pad, tok))
+                return (cache, tok, lengths, top_scores, beam_done, out), None
+
+            carry = (cache, tok, lengths, scores, beam_done, out)
+            steps = cfg.max_new_tokens - 1
+            if steps > 0:
+                carry, _ = jax.lax.scan(body, carry, jnp.arange(1, steps + 1))
+            cache, tok, lengths, scores, beam_done, out = carry
+            # the final cache rides along so the donated input can alias
+            return out, scores.reshape(batch), cache
+
+        return jax.jit(beam_fn, donate_argnums=(1,))
 
     def stream(self, prompts: Sequence[Sequence[int]], *, seed: int = 0, chunk_size: int = 16):
         """Incremental generation: yields ``[len(prompts), <=chunk_size]`` arrays of
@@ -366,7 +501,7 @@ class Generator:
         # the last chunk may overshoot max_new_tokens; give its cache writes room
         n_chunks = max(0, -(-(cfg.max_new_tokens - 1) // chunk_size))
         extra = n_chunks * chunk_size - (cfg.max_new_tokens - 1)
-        n, tok0, carry = self._start(prompts, seed, extra_cache=extra)
+        n, tok0, _, carry = self._start(prompts, seed, extra_cache=extra)
         yield np.asarray(tok0)[:n, None]
         produced = 1
         while produced < cfg.max_new_tokens:

@@ -204,10 +204,10 @@ class SpeculativeGenerator:
             self._round_fn = self._build_round()
 
         # prefill both models; extra cache headroom for the last round's overshoot
-        n, tok0_t, (t_cache, _, lengths, done_t, _) = self._target._start(
+        n, tok0_t, _, (t_cache, _, lengths, done_t, _) = self._target._start(
             prompts, seed, extra_cache=self.gamma + 1
         )
-        _, _, (d_cache, _, d_lengths, _, _) = self._draft._start(prompts, seed, extra_cache=self.gamma + 1)
+        _, _, _, (d_cache, _, d_lengths, _, _) = self._draft._start(prompts, seed, extra_cache=self.gamma + 1)
         del d_lengths  # same values as lengths (same prompts)
 
         batch = int(tok0_t.shape[0])
