@@ -42,6 +42,19 @@ def test_sharded_generation_matches_unsharded(spec):
     np.testing.assert_array_equal(sharded([prompts[0]]), expected[:1])
 
 
+def test_sharded_beam_search_matches_unsharded():
+    """Beam search over a TP/data mesh (beams = batch rows, cache rows gathered
+    to surviving parents under sharding) must pick the same sequences."""
+    module, params = _tiny()
+    cfg = GenerationConfig(max_new_tokens=6, temperature=0.0, prompt_buckets=(16,))
+    prompts = [[3, 1, 4, 1, 5], [9, 2, 6]]
+
+    expected = Generator(module, params, cfg).beam_search(prompts, num_beams=4)
+    mesh = MeshSpec(data=4, model=2).build()
+    sharded = Generator(module, params, cfg, mesh=mesh, partition_rules=llama_partition_rules())
+    np.testing.assert_array_equal(sharded.beam_search(prompts, num_beams=4), expected)
+
+
 def test_expert_parallel_generation_matches_unsharded():
     """MoE decoder served expert-parallel: stacked expert FFN weights sharded
     P('expert', ...) while the KV cache shards batch-over-data — tokens must

@@ -394,18 +394,21 @@ class Generator:
         if num_beams < 1:
             raise ValueError("num_beams must be >= 1")
         n = len(prompts)
-        # pad whole GROUPS (not rows) so the batch is exactly groups * num_beams
+        # pad whole GROUPS (not rows) so the batch is exactly groups * num_beams;
+        # a multiple of the data axis keeps both the prefill batch (groups) and
+        # the search batch (groups * num_beams) shardable
         groups = 1 << max(0, (n - 1).bit_length())
         if self.mesh is not None and "data" in self.mesh.axis_names:
             data = int(self.mesh.shape["data"])
-            while (groups * num_beams) % data:
-                groups *= 2
-        padded_prompts = [list(p) for p in prompts] + [[cfg.pad_id]] * (groups - n)
-        expanded = [list(p) for p in padded_prompts for _ in range(num_beams)]
-        _, _, last, (cache, _, lengths, _, _) = self._start(
-            expanded, 0, batch_override=groups * num_beams
-        )
-        done = jnp.arange(groups * num_beams) >= n * num_beams  # synthetic groups only
+            groups = int(math.ceil(groups / data) * data)
+        # prefill each UNIQUE prompt once (synthetic padding groups get _start's
+        # row_valid masking, keeping them out of routed-expert capacity), then
+        # tile every cache row to its num_beams slots — beams share the prompt
+        _, _, last, (cache, _, lengths, _, _) = self._start(prompts, 0, batch_override=groups)
+        tile = jnp.arange(groups * num_beams) // num_beams
+        cache = jax.tree_util.tree_map(lambda c: c[tile], cache)
+        last, lengths = last[tile], lengths[tile]
+        done = tile >= n  # synthetic groups only
         fn = self._beam_fns.get(num_beams)
         if fn is None:
             fn = self._build_beam_fn(num_beams)
