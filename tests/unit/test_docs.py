@@ -14,6 +14,7 @@ from build import build_site, render_markdown  # noqa: E402
 from md_to_ipynb import convert  # noqa: E402
 
 TUTORIAL = DOCS / "tutorials" / "quickstart_tutorial.md"
+GENERATION_TUTORIAL = DOCS / "tutorials" / "generation_tutorial.md"
 
 
 def test_site_builds_all_pages(tmp_path):
@@ -60,6 +61,15 @@ def test_tutorial_code_blocks_execute_end_to_end():
     namespace: dict = {}
     exec(compile("\n\n".join(blocks), str(TUTORIAL), "exec"), namespace)  # noqa: S102
     assert namespace["metrics"]["train"] > 0.9
+
+
+def test_generation_tutorial_executes_end_to_end():
+    source = GENERATION_TUTORIAL.read_text()
+    blocks = re.findall(r"```python\n(.*?)\n```", source, flags=re.DOTALL)
+    assert len(blocks) >= 5
+    namespace: dict = {}
+    exec(compile("\n\n".join(blocks), str(GENERATION_TUTORIAL), "exec"), namespace)  # noqa: S102
+    assert namespace["tokens"].shape == (2, 16)
 
 
 def test_notebook_conversion_is_deterministic():
