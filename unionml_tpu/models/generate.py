@@ -98,16 +98,16 @@ def _quantized_shardings(qparams: Any, shardings: Any, mesh: Any) -> Any:
     )
 
 
-def sample_tokens(logits: jax.Array, key: jax.Array, config: GenerationConfig) -> jax.Array:
-    """Sample next tokens from ``logits [B, V]`` under the config's decoding policy."""
-    if config.temperature == 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+def filtered_logits(logits: jax.Array, config: GenerationConfig) -> jax.Array:
+    """Apply the decoding policy's temperature/top-k/top-p filters to ``[..., V]``
+    logits (masked entries become -inf). ``softmax`` of the result IS the policy's
+    sampling distribution — speculative sampling rejects against exactly this."""
     logits = logits / config.temperature
     if config.top_k > 0:
-        kth = jnp.sort(logits, axis=-1)[:, -config.top_k][:, None]
+        kth = jnp.sort(logits, axis=-1)[..., -config.top_k][..., None]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     if config.top_p < 1.0:
-        sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
+        sorted_desc = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
         probs = jax.nn.softmax(sorted_desc, axis=-1)
         exclusive_cum = jnp.cumsum(probs, axis=-1) - probs
         # keep the smallest prefix whose mass reaches top_p; the lowest kept logit
@@ -115,7 +115,22 @@ def sample_tokens(logits: jax.Array, key: jax.Array, config: GenerationConfig) -
         dropped = exclusive_cum >= config.top_p
         min_kept = jnp.min(jnp.where(dropped, jnp.inf, sorted_desc), axis=-1, keepdims=True)
         logits = jnp.where(logits < min_kept, -jnp.inf, logits)
-    return jax.random.categorical(key, logits).astype(jnp.int32)
+    return logits
+
+
+def policy_probs(logits: jax.Array, config: GenerationConfig) -> jax.Array:
+    """The decoding policy as an explicit distribution over ``[..., V]`` — a
+    one-hot argmax for greedy, else softmax of :func:`filtered_logits`."""
+    if config.temperature == 0.0:
+        return jax.nn.one_hot(jnp.argmax(logits, axis=-1), logits.shape[-1], dtype=jnp.float32)
+    return jax.nn.softmax(filtered_logits(logits.astype(jnp.float32), config), axis=-1)
+
+
+def sample_tokens(logits: jax.Array, key: jax.Array, config: GenerationConfig) -> jax.Array:
+    """Sample next tokens from ``logits [B, V]`` under the config's decoding policy."""
+    if config.temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(key, filtered_logits(logits, config)).astype(jnp.int32)
 
 
 class Generator:

@@ -1,14 +1,19 @@
-"""Greedy speculative decoding: a small draft model proposes, the target verifies.
+"""Speculative decoding: a small draft model proposes, the target verifies.
 
-Standard draft-and-verify (Leviathan et al.-style, greedy specialization): per
-round the draft model decodes ``gamma`` tokens autoregressively (cheap — small
-model), then the target model scores all ``gamma + 1`` positions in ONE cached
-forward (the same HBM traffic as a single decode step at small batch: decode is
+Standard draft-and-verify with distribution-level rejection sampling (the
+Leviathan et al. scheme): per round the draft model decodes ``gamma`` tokens
+from the decoding policy's distribution q (cheap — small model), then the
+target model scores all ``gamma + 1`` positions in ONE cached forward (the
+same HBM traffic as a single decode step at small batch: decode is
 weight-bandwidth bound, so verifying gamma+1 tokens costs roughly one token).
-The longest prefix where draft and target argmax agree is accepted, plus the
-target's own next token as the correction/bonus — so every round emits between
-1 and gamma+1 tokens and the output is **exactly** the target-only greedy
-sequence (the oracle the tests pin).
+Draft token x is accepted with probability ``min(1, p(x)/q(x))``; on the first
+rejection the replacement is sampled from ``norm(max(p - q, 0))``, and when
+everything accepts the target's own next-position distribution supplies a
+bonus token — so every round emits 1..gamma+1 tokens and the output is
+distributed **exactly** as target-only decoding (the draft can only change
+speed, never the distribution). Greedy (``temperature == 0``) is the one-hot
+special case: acceptance degenerates to argmax prefix matching and the output
+is token-for-token the target-only greedy sequence — the oracle the tests pin.
 
 TPU-native specifics:
 
@@ -26,8 +31,10 @@ TPU-native specifics:
 - eos handling matches :class:`~unionml_tpu.models.generate.Generator`: the
   first eos in a round truncates that row's emission and marks it done.
 
-Sampling (temperature > 0) requires distribution-level rejection sampling and is
-not implemented — construct with a greedy config or use the plain Generator.
+Sampled runs are NOT key-path-compatible with the plain Generator (they consume
+randomness differently), so equality holds in distribution, not per seed —
+tests/unit/test_speculative.py checks both: exact tokens for greedy, empirical
+distribution closeness for sampling.
 """
 
 from __future__ import annotations
@@ -69,8 +76,6 @@ class SpeculativeGenerator:
         partition_rules: Optional[Any] = None,
         quantize: Optional[str] = None,
     ):
-        if config.temperature != 0.0:
-            raise NotImplementedError("speculative decoding is greedy-only; use temperature=0")
         if gamma < 1:
             raise ValueError("gamma must be >= 1")
         self.config = config
@@ -111,34 +116,70 @@ class SpeculativeGenerator:
             kernel = p["lm_head"]["kernel"]
             return (hidden @ kernel.astype(hidden.dtype)).astype(jnp.float32), cache
 
-        def spec_round(tp, dp, t_cache, d_cache, tok, lengths, done, produced, out_buf):
+        from unionml_tpu.models.generate import filtered_logits, policy_probs
 
-            # --- draft: gamma greedy steps (small-model cached decode) ---
-            def draft_body(carry, _):
+        greedy_mode = cfg.temperature == 0.0
+
+        def spec_round(tp, dp, t_cache, d_cache, tok, lengths, done, produced, out_buf, key):
+            key, draft_key, corr_key = jax.random.split(key, 3)
+            accept_keys = jax.random.split(draft_key, gamma + 1)
+
+            # --- draft: gamma policy-sampled steps (small-model cached decode) ---
+            def draft_body(carry, step_key):
                 cache, t, ln = carry
                 logits, cache = draft_apply(dp, t[:, None], ln[:, None], cache)
-                nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
-                return (cache, nxt, ln + 1), nxt
+                lg = logits[:, 0]
+                if greedy_mode:
+                    nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                else:
+                    nxt = jax.random.categorical(step_key, filtered_logits(lg, cfg)).astype(jnp.int32)
+                return (cache, nxt, ln + 1), (nxt, lg)
 
-            (d_cache, _, _), drafts = jax.lax.scan(
-                draft_body, (d_cache, tok, lengths), None, length=gamma
+            (d_cache, _, _), (drafts, draft_logits) = jax.lax.scan(
+                draft_body, (d_cache, tok, lengths), jax.random.split(accept_keys[gamma], gamma)
             )
             drafts = drafts.T  # [B, gamma]
+            draft_logits = jnp.swapaxes(draft_logits, 0, 1)  # [B, gamma, V]
 
-            # --- target: verify tok + all gamma drafts in one cached forward ---
+            # --- target: score tok + all gamma drafts in one cached forward ---
             inputs = jnp.concatenate([tok[:, None], drafts], axis=1)  # [B, gamma+1]
             positions = lengths[:, None] + jnp.arange(gamma + 1)[None]
             logits, t_cache = target_apply(tp, inputs, positions, t_cache, (~done)[:, None])
-            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, gamma+1]
 
-            # longest agreeing prefix: a[b] = #{i : drafts[b, :i+1] == greedy[b, :i+1]}
-            match = jnp.cumprod((drafts == greedy[:, :gamma]).astype(jnp.int32), axis=1)
-            accepted = match.sum(axis=1)  # [B] in [0, gamma]
+            # --- rejection sampling against the policy distributions ---
+            # (greedy is the one-hot special case: accept iff argmaxes agree, the
+            # correction/bonus is the target argmax — exactly prefix matching)
+            q = policy_probs(draft_logits, cfg)  # [B, gamma, V]
+            p = policy_probs(logits, cfg)  # [B, gamma+1, V]
+            batch = tok.shape[0]
+            still = jnp.ones((batch,), bool)
+            accepted = jnp.zeros((batch,), jnp.int32)
+            for i in range(gamma):  # gamma is small and static; unrolled
+                x = drafts[:, i : i + 1]
+                px = jnp.take_along_axis(p[:, i], x, axis=-1)[:, 0]
+                qx = jnp.take_along_axis(q[:, i], x, axis=-1)[:, 0]
+                u = jax.random.uniform(accept_keys[i], (batch,))
+                ok = u * qx < px  # u < p(x)/q(x), division-free
+                accepted = accepted + (still & ok)
+                still = still & ok
+            # correction (first rejection) / bonus (all accepted) token: sample
+            # from norm(max(p_a - q_a, 0)) — q beyond gamma is 0, so the bonus
+            # case degenerates to sampling p_gamma directly
+            p_at = jnp.take_along_axis(p, accepted[:, None, None], axis=1)[:, 0]  # [B, V]
+            q_ext = jnp.concatenate([q, jnp.zeros_like(q[:, :1])], axis=1)
+            q_at = jnp.take_along_axis(q_ext, accepted[:, None, None], axis=1)[:, 0]
+            resid = jnp.maximum(p_at - q_at, 0.0)
+            # float-edge guard: a rejected position has TV(p, q) > 0 by construction,
+            # but under f32 the residual can still round to all-zeros
+            resid = jnp.where(resid.sum(-1, keepdims=True) > 0, resid, p_at)
+            correction = jax.random.categorical(corr_key, jnp.log(resid + 1e-30)).astype(jnp.int32)
 
-            # emitted tokens this round: greedy[:, :accepted+1] then pads
+            # emitted tokens this round: accepted drafts, then the correction
             idx = jnp.arange(gamma + 1)[None]
+            drafts_ext = jnp.concatenate([drafts, jnp.full((batch, 1), pad)], axis=1)
             emit_mask = idx <= accepted[:, None]
-            emitted = jnp.where(emit_mask, greedy, pad)
+            emitted = jnp.where(idx < accepted[:, None], drafts_ext, correction[:, None])
+            emitted = jnp.where(emit_mask, emitted, pad)
             if eos is not None:
                 is_eos = (emitted == eos) & emit_mask
                 # truncate after the first eos: positions strictly beyond it emit pad
@@ -168,9 +209,9 @@ class SpeculativeGenerator:
             lengths = lengths + jnp.where(done, 0, n_emit)
             produced = produced + n_emit
             acc_count = jnp.where(done, 0, jnp.minimum(accepted, room)).sum()
-            return t_cache, d_cache, tok, lengths, new_done, produced, out_buf, acc_count
+            return t_cache, d_cache, tok, lengths, new_done, produced, out_buf, acc_count, key
 
-        def spec_loop(tp, dp, t_cache, d_cache, tok, lengths, done, produced, out_buf):
+        def spec_loop(tp, dp, t_cache, d_cache, tok, lengths, done, produced, out_buf, key):
             """The full post-prefill generation as ONE device-side while_loop —
             per-round host round trips through a remote-TPU tunnel would otherwise
             dominate the round cost (measured ~20x the compute)."""
@@ -181,13 +222,13 @@ class SpeculativeGenerator:
                 return jnp.any(~state[4])
 
             def body(state):
-                t_cache, d_cache, tok, lengths, done, produced, out_buf, rounds, acc_total = state
-                t_cache, d_cache, tok, lengths, done, produced, out_buf, acc = spec_round(
-                    tp, dp, t_cache, d_cache, tok, lengths, done, produced, out_buf
+                t_cache, d_cache, tok, lengths, done, produced, out_buf, rounds, acc_total, key = state
+                t_cache, d_cache, tok, lengths, done, produced, out_buf, acc, key = spec_round(
+                    tp, dp, t_cache, d_cache, tok, lengths, done, produced, out_buf, key
                 )
-                return (t_cache, d_cache, tok, lengths, done, produced, out_buf, rounds + 1, acc_total + acc)
+                return (t_cache, d_cache, tok, lengths, done, produced, out_buf, rounds + 1, acc_total + acc, key)
 
-            state = (t_cache, d_cache, tok, lengths, done, produced, out_buf, jnp.int32(0), jnp.int32(0))
+            state = (t_cache, d_cache, tok, lengths, done, produced, out_buf, jnp.int32(0), jnp.int32(0), key)
             state = jax.lax.while_loop(cond, body, state)
             # final caches ride along (and are dropped by the caller) so the
             # donated inputs have outputs to alias with
@@ -198,7 +239,8 @@ class SpeculativeGenerator:
     # ------------------------------------------------------------------ generate
 
     def __call__(self, prompts: Sequence[Sequence[int]], *, seed: int = 0) -> np.ndarray:
-        """Generate greedily; returns exactly what the target-only Generator would."""
+        """Generate under the config's decoding policy; greedy output is exactly
+        the target-only sequence, sampled output is target-distributed."""
         cfg = self.config
         if self._round_fn is None:
             self._round_fn = self._build_round()
@@ -222,6 +264,7 @@ class SpeculativeGenerator:
         out_buf, rounds, accepted, _, _ = self._round_fn(
             self._target.params, self._draft.params,
             t_cache, d_cache, tok, lengths, done, produced, out_buf,
+            jax.random.fold_in(jax.random.PRNGKey(seed), 1),
         )
         self.rounds += int(rounds)
         self.accepted_tokens += int(accepted)
