@@ -77,11 +77,13 @@ def test_expert_parallel_generation_matches_unsharded():
 
 
 def test_quantized_sharded_generation_matches_quantized_unsharded():
-    """int8 weights + TP mesh: the QuantizedTensor pytree (int8 q + size-1-dim
-    scales) must place under the kernel partition rules and emit the same tokens
-    as quantized single-device generation."""
+    """int8 weights + int8 KV cache + TP mesh: the QuantizedTensor pytree and
+    the cache's scale planes must place under the partition rules and emit the
+    same tokens as quantized single-device generation."""
     module, params = _tiny()
-    cfg = GenerationConfig(max_new_tokens=8, temperature=0.0, prompt_buckets=(16,))
+    cfg = GenerationConfig(
+        max_new_tokens=8, temperature=0.0, prompt_buckets=(16,), kv_cache_dtype="int8"
+    )
     prompts = [[3, 1, 4, 1, 5], [9, 2, 6], [7, 1, 8, 2], [2, 7]]
 
     expected = Generator(module, params, cfg, quantize="int8")(prompts)

@@ -96,6 +96,52 @@ def test_int8_matmul_kernel_matches_dequant_reference():
     assert out3.shape == (2, 3, 100)
 
 
+def test_int8_kv_cache_logits_stay_close():
+    """Prefill through an int8 KV cache must reproduce the fp-cache logits to
+    per-(position, head) int8 quantization error (~1%)."""
+    from unionml_tpu.models import init_cache
+
+    config = LlamaConfig.tiny(
+        vocab_size=64, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, hidden_dim=128,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    module = Llama(config)
+    params = module.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    tokens = jnp.asarray([[3, 1, 4, 1, 5, 9, 2, 6]], jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(8)[None], (1, 8))
+
+    ref, _ = module.apply(
+        {"params": params}, tokens, positions=positions, cache=init_cache(config, 1, 16)
+    )
+    out, qcache = module.apply(
+        {"params": params}, tokens, positions=positions, cache=init_cache(config, 1, 16, kv_dtype="int8")
+    )
+    assert qcache[0]["k"].dtype == jnp.int8 and qcache[0]["k_scale"].shape == (1, 16, 2, 1)
+    denom = float(jnp.abs(ref).max())
+    assert float(jnp.abs(out - ref).max()) / denom < 0.02
+
+
+def test_int8_kv_cache_generation_runs_and_composes_with_int8_weights():
+    config = LlamaConfig.tiny(
+        vocab_size=64, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, hidden_dim=128,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    module = Llama(config)
+    params = module.init(jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32))["params"]
+    gen = Generator(
+        module, params,
+        GenerationConfig(max_new_tokens=8, temperature=0.0, prompt_buckets=(16,), kv_cache_dtype="int8"),
+        quantize="int8",
+    )
+    prompts = [[5, 6, 7], [1, 2, 3, 4]]
+    out = gen(prompts)
+    assert out.shape == (2, 8)
+    np.testing.assert_array_equal(out, gen(prompts))
+    # streaming path shares the cache machinery
+    chunks = list(gen.stream(prompts, chunk_size=3))
+    assert np.concatenate(chunks, axis=1).shape[1] <= 8
+
+
 def test_unsupported_mode_rejected():
     config = LlamaConfig.tiny(dtype=jnp.float32, param_dtype=jnp.float32)
     module = Llama(config)

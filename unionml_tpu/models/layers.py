@@ -160,15 +160,38 @@ class Attention(nn.Module):
                 raise ValueError("cached attention requires per-example positions [B, L]")
             if mask is not None:
                 raise NotImplementedError("cached attention builds its own mask")
-            cache = {
-                "k": _write_cache(cache["k"], k, positions[:, 0]),
-                "v": _write_cache(cache["v"], v, positions[:, 0]),
-            }
+            starts = positions[:, 0]
+            if "k_scale" in cache:
+                # int8 KV cache: symmetric per-(position, head) quantization on
+                # write; dequant on read fuses into the attention contraction.
+                # Long-context decode streams the cache every step — int8 halves
+                # those bytes (scales are D/4x smaller than the values).
+                def quantize_rows(x: jax.Array):
+                    scale = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+                    scale = jnp.maximum(scale, 1e-8) / 127.0
+                    rows = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127)
+                    return rows.astype(jnp.int8), scale
+
+                kq, k_scale = quantize_rows(k)
+                vq, v_scale = quantize_rows(v)
+                cache = {
+                    "k": _write_cache(cache["k"], kq, starts),
+                    "v": _write_cache(cache["v"], vq, starts),
+                    "k_scale": _write_cache(cache["k_scale"], k_scale, starts),
+                    "v_scale": _write_cache(cache["v_scale"], v_scale, starts),
+                }
+                keys = (cache["k"].astype(jnp.float32) * cache["k_scale"]).astype(q.dtype)
+                values = (cache["v"].astype(jnp.float32) * cache["v_scale"]).astype(q.dtype)
+            else:
+                cache = {
+                    "k": _write_cache(cache["k"], k, starts),
+                    "v": _write_cache(cache["v"], v, starts),
+                }
+                keys = cache["k"].astype(q.dtype)
+                values = cache["v"].astype(q.dtype)
             slot = jnp.arange(cache["k"].shape[1])
             visible = slot[None, None, None, :] <= positions[:, None, :, None]  # [B,1,L,S_max]
-            out = multihead_attention(
-                q, cache["k"].astype(q.dtype), cache["v"].astype(q.dtype), causal=False, mask=visible, impl="xla"
-            )
+            out = multihead_attention(q, keys, values, causal=False, mask=visible, impl="xla")
             out = out.reshape(batch, length, self.n_heads * head_dim)
             return dense(features, "o_proj")(out), cache
 
