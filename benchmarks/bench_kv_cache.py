@@ -1,0 +1,82 @@
+"""Long-context decode: fp vs int8 KV cache.
+
+At short context decode streams mostly weights; the KV cache is the term that
+grows with context. This bench decodes at a long prompt so the cache is a
+first-class share of the per-step HBM traffic, and measures tokens/sec with
+the bf16 cache vs the int8 cache (per-(position, head) scales).
+``vs_baseline`` = int8-KV speedup over the bf16-KV run.
+
+Prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+from benchmarks.common import Timer, emit, log
+
+PROXY_LAYERS = 8
+BATCH = 8
+PROMPT_LEN = 2048
+NEW_TOKENS = 64
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from unionml_tpu.models import GenerationConfig, Generator, Llama, LlamaConfig
+
+    log(f"devices: {jax.devices()}")
+    config = LlamaConfig.llama3_8b(
+        n_layers=PROXY_LAYERS, param_dtype=jnp.bfloat16, max_seq_len=PROMPT_LEN + NEW_TOKENS
+    )
+    module = Llama(config)
+    params = jax.jit(lambda k: module.init(k, jnp.zeros((1, 8), jnp.int32))["params"])(
+        jax.random.PRNGKey(0)
+    )
+    head_dim = config.dim // config.n_heads
+    kv_gb = 2 * 2 * PROXY_LAYERS * BATCH * (PROMPT_LEN + NEW_TOKENS) * config.n_kv_heads * head_dim / 1e9
+    log(f"KV cache at full context: {kv_gb:.2f} GB bf16 (vs ~4.55 GB matmul weights)")
+
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(1, config.vocab_size, size=PROMPT_LEN)) for _ in range(BATCH)]
+
+    results = {}
+    for name, kv in (("bf16", None), ("int8", "int8")):
+        gen = Generator(
+            module,
+            params,
+            GenerationConfig(
+                max_new_tokens=NEW_TOKENS, temperature=0.0,
+                prompt_buckets=(PROMPT_LEN,), prefill_chunk=512, kv_cache_dtype=kv,
+            ),
+        )
+        with Timer() as cold:
+            gen(prompts)
+        with Timer() as warm:
+            out = gen(prompts)
+        assert out.shape == (BATCH, NEW_TOKENS)
+        results[name] = BATCH * NEW_TOKENS / warm.elapsed
+        log(f"{name} KV: {warm.elapsed*1e3:.0f} ms warm ({results[name]:.0f} tokens/s; compile {cold.elapsed:.0f}s)")
+        del gen
+
+    emit(
+        "longctx_decode_int8_kv_speedup",
+        results["int8"] / results["bf16"],
+        "x over bf16 KV",
+        results["int8"] / results["bf16"],
+        bf16_tokens_per_s=round(results["bf16"], 1),
+        int8_tokens_per_s=round(results["int8"], 1),
+        prompt_len=PROMPT_LEN,
+        batch=BATCH,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -25,6 +25,7 @@ SCRIPTS = {
     "generate": "bench_generate.py",
     "speculative": "bench_speculative.py",
     "int8_matmul": "bench_int8_matmul.py",
+    "kv_cache": "bench_kv_cache.py",
 }
 
 
