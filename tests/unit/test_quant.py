@@ -96,6 +96,38 @@ def test_int8_matmul_kernel_matches_dequant_reference():
     assert out3.shape == (2, 3, 100)
 
 
+def test_moe_int8_generation_runs_and_router_stays_fp():
+    """MoE int8: stacked [E, K, F] expert kernels quantize per output channel and
+    dequant in-jit; the (precision-sensitive, f32-by-design) router never does."""
+    from unionml_tpu.models import MoEConfig, MoETransformer
+
+    config = MoEConfig.tiny(
+        vocab_size=61, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, hidden_dim=96,
+        n_experts=4, k=2, capacity_factor=8.0, dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    module = MoETransformer(config)
+    params = module.init(jax.random.PRNGKey(2), jnp.zeros((1, 8), jnp.int32))["params"]
+
+    qparams = quantize_params(params, min_size=1)
+    flat = {
+        "/".join(str(getattr(p, "key", p)) for p in path): leaf
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+            qparams, is_leaf=lambda x: isinstance(x, QuantizedTensor)
+        )[0]
+    }
+    assert isinstance(flat["layer_0/moe/experts/wi/kernel"], QuantizedTensor)
+    assert not isinstance(flat["layer_0/moe/router/kernel"], QuantizedTensor)
+
+    gen = Generator(
+        module, params,
+        GenerationConfig(max_new_tokens=6, temperature=0.0, prompt_buckets=(16,)),
+        quantize="int8",
+    )
+    out = gen([[3, 1, 4], [1, 5, 9, 2]])
+    assert out.shape == (2, 6)
+    np.testing.assert_array_equal(out, gen([[3, 1, 4], [1, 5, 9, 2]]))
+
+
 def test_int8_kv_cache_logits_stay_close():
     """Prefill through an int8 KV cache must reproduce the fp-cache logits to
     per-(position, head) int8 quantization error (~1%)."""

@@ -83,10 +83,11 @@ def dequantize_tree(params: Any, dtype: Any = jnp.bfloat16) -> Any:
 
 
 #: default targets: large matmul kernels; embeddings stay unquantized (gather
-#: reads one row per token — quantizing saves nothing and costs accuracy) and
-#: norms/biases/low-rank adapters are too small to matter
+#: reads one row per token — quantizing saves nothing and costs accuracy),
+#: norms/biases/low-rank adapters are too small to matter, and MoE routers are
+#: precision-sensitive (they run in f32 by design, moe.py)
 _DEFAULT_INCLUDE = r"(kernel)$"
-_DEFAULT_EXCLUDE = r"(embed|embedding|norm|scale|bias|lora_a|lora_b)"
+_DEFAULT_EXCLUDE = r"(embed|embedding|norm|scale|bias|lora_a|lora_b|router)"
 
 
 def quantize_params(
