@@ -10,6 +10,16 @@ from unionml_tpu.models import GenerationConfig, Generator, Llama, LlamaConfig
 from unionml_tpu.ops.quant import QuantizedTensor, dequantize, dequantize_tree, quantize_array, quantize_params
 
 
+def _flat_by_path(tree):
+    """{'a/b/c': leaf} view of a (possibly quantized) params tree."""
+    return {
+        "/".join(str(getattr(p, "key", p)) for p in path): leaf
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+            tree, is_leaf=lambda x: isinstance(x, QuantizedTensor)
+        )[0]
+    }
+
+
 def test_roundtrip_error_bound():
     rng = np.random.default_rng(0)
     w = rng.normal(size=(256, 512)).astype(np.float32) * rng.uniform(0.01, 10, size=(1, 512))
@@ -27,12 +37,7 @@ def test_quantize_params_selects_matmul_kernels_only():
     params = module.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
     qparams = quantize_params(params, min_size=1)
 
-    flat = {
-        "/".join(str(getattr(p, "key", p)) for p in path): leaf
-        for path, leaf in jax.tree_util.tree_flatten_with_path(
-            qparams, is_leaf=lambda x: isinstance(x, QuantizedTensor)
-        )[0]
-    }
+    flat = _flat_by_path(qparams)
     assert isinstance(flat["layer_0/attn/q_proj/kernel"], QuantizedTensor)
     assert isinstance(flat["layer_0/mlp/wi/kernel"], QuantizedTensor)
     assert isinstance(flat["lm_head/kernel"], QuantizedTensor)
@@ -96,32 +101,48 @@ def test_int8_matmul_kernel_matches_dequant_reference():
     assert out3.shape == (2, 3, 100)
 
 
+def test_stacked_expert_kernels_get_per_expert_scales():
+    """[E, K, F] expert stacks reduce only the contraction axis: per-(expert,
+    channel) scales, so one outlier expert cannot crush the others' resolution."""
+    rng = np.random.default_rng(3)
+    w = rng.normal(size=(4, 32, 16)).astype(np.float32)
+    w[2] *= 100.0  # outlier expert
+    qt = quantize_array(w)
+    assert qt.scale.shape == (4, 1, 16)
+    back = np.asarray(dequantize(qt, jnp.float32))
+    # per-expert error bound: each expert's channels quantize against its own max
+    for e in range(4):
+        col_max = np.abs(w[e]).max(axis=0)
+        assert (np.abs(back[e] - w[e]) <= col_max / 254 + 1e-6).all(), e
+
+
 def test_moe_int8_generation_runs_and_router_stays_fp():
-    """MoE int8: stacked [E, K, F] expert kernels quantize per output channel and
+    """MoE int8: stacked [E, K, F] expert kernels quantize (sized above the
+    Generator's default min_size so generation really runs the int8 path) and
     dequant in-jit; the (precision-sensitive, f32-by-design) router never does."""
     from unionml_tpu.models import MoEConfig, MoETransformer
 
+    # experts wi: [4, 128, 128] = 65536 elements >= Generator's min_size
     config = MoEConfig.tiny(
-        vocab_size=61, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, hidden_dim=96,
+        vocab_size=61, dim=128, n_heads=4, n_kv_heads=2, hidden_dim=128,
         n_experts=4, k=2, capacity_factor=8.0, dtype=jnp.float32, param_dtype=jnp.float32,
     )
     module = MoETransformer(config)
     params = module.init(jax.random.PRNGKey(2), jnp.zeros((1, 8), jnp.int32))["params"]
 
-    qparams = quantize_params(params, min_size=1)
-    flat = {
-        "/".join(str(getattr(p, "key", p)) for p in path): leaf
-        for path, leaf in jax.tree_util.tree_flatten_with_path(
-            qparams, is_leaf=lambda x: isinstance(x, QuantizedTensor)
-        )[0]
-    }
+    flat = _flat_by_path(quantize_params(params))  # Generator's own defaults
     assert isinstance(flat["layer_0/moe/experts/wi/kernel"], QuantizedTensor)
+    assert flat["layer_0/moe/experts/wi/kernel"].scale.shape == (4, 1, 128)
     assert not isinstance(flat["layer_0/moe/router/kernel"], QuantizedTensor)
 
     gen = Generator(
         module, params,
         GenerationConfig(max_new_tokens=6, temperature=0.0, prompt_buckets=(16,)),
         quantize="int8",
+    )
+    assert any(
+        isinstance(leaf, QuantizedTensor)
+        for leaf in jax.tree_util.tree_leaves(gen.params, is_leaf=lambda x: isinstance(x, QuantizedTensor))
     )
     out = gen([[3, 1, 4], [1, 5, 9, 2]])
     assert out.shape == (2, 6)

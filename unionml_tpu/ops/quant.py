@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import re
-from typing import Any, Callable, Optional, Sequence
+from typing import Any, Callable, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -56,9 +56,20 @@ class QuantizedTensor:
 
 
 def quantize_array(w: Any, *, channel_axis: int = -1) -> QuantizedTensor:
-    """Quantize one weight to int8 with a per-``channel_axis`` symmetric scale."""
+    """Quantize one weight to int8 with symmetric per-channel scales.
+
+    With the default trailing ``channel_axis``, only the contraction axis (the
+    one just before the channels) is reduced — so a 2D ``[K, F]`` kernel gets
+    ``[1, F]`` per-output-channel scales, and a stacked MoE expert kernel
+    ``[E, K, F]`` gets ``[E, 1, F]`` per-(expert, channel) scales rather than
+    one scale plane shared across experts (which would let one outlier expert
+    crush the resolution of the others)."""
     w = jnp.asarray(w)
-    axes = tuple(i for i in range(w.ndim) if i != (channel_axis % w.ndim))
+    channel = channel_axis % w.ndim
+    if channel == w.ndim - 1 and w.ndim >= 2:
+        axes: Tuple[int, ...] = (w.ndim - 2,)
+    else:
+        axes = tuple(i for i in range(w.ndim) if i != channel)
     abs_max = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axes, keepdims=True)
     scale = jnp.maximum(abs_max, 1e-8) / 127.0
     q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127).astype(jnp.int8)
