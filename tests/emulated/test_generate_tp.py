@@ -42,6 +42,28 @@ def test_sharded_generation_matches_unsharded(spec):
     np.testing.assert_array_equal(sharded([prompts[0]]), expected[:1])
 
 
+@pytest.mark.parametrize("impl,axes", [("ring", dict(data=1, sequence=8)), ("ulysses", dict(data=2, sequence=4))])
+def test_sequence_parallel_prefill_matches_plain_generation(impl, axes):
+    """Long-context handoff: prefill runs the decoder sequence-parallel under
+    shard_map (ring KV rotation / ulysses all-to-all), the cache is assembled
+    from the sown per-layer K/V, and decode proceeds on the ordinary cached
+    path — tokens must equal the plain single-device engine."""
+    module, params = _tiny()
+    prompts = [[3, 1, 4, 1, 5, 9, 2, 6, 5, 3], [7, 1, 8], [2, 8, 1, 8, 2, 8], [4, 6]]
+
+    plain = Generator(
+        module, params, GenerationConfig(max_new_tokens=8, temperature=0.0, prompt_buckets=(16,))
+    )(prompts)
+
+    mesh = MeshSpec(**axes).build()
+    sp = Generator(
+        module, params,
+        GenerationConfig(max_new_tokens=8, temperature=0.0, prompt_buckets=(16,), sp_prefill=impl),
+        mesh=mesh,
+    )
+    np.testing.assert_array_equal(sp(prompts), plain)
+
+
 def test_sharded_beam_search_matches_unsharded():
     """Beam search over a TP/data mesh (beams = batch rows, cache rows gathered
     to surviving parents under sharding) must pick the same sequences."""

@@ -67,6 +67,13 @@ class GenerationConfig:
     #: halves those bytes (~0.4% logit drift on the shipped models' scale).
     #: None = compute dtype (bf16 on TPU).
     kv_cache_dtype: Optional[str] = None
+    #: "ring" / "ulysses": run prefill SEQUENCE-PARALLEL over the mesh's
+    #: ``sequence`` axis (the whole decoder under shard_map with the module's
+    #: sequence-parallel attention), then assemble the KV cache from the sown
+    #: per-layer K/V — prefill of a 100k-token prompt spreads across chips
+    #: instead of living on one. Requires a mesh with a ``sequence`` axis;
+    #: decode afterwards is the ordinary cached path.
+    sp_prefill: Optional[str] = None
 
 
 def init_cache(config: Any, batch: int, cache_len: int, kv_dtype: Optional[str] = None) -> Tuple[Any, ...]:
@@ -300,8 +307,89 @@ class Generator:
         self._apply_fn = apply  # for engines composing on top (beam search)
         self._head_fn = head
         self._beam_fns: dict = {}
+        self._sp_prefill_fn = None
 
     # ------------------------------------------------------------------ helpers
+
+    def _build_sp_prefill(self):
+        """Sequence-parallel prefill: the decoder runs under shard_map with its
+        ring/ulysses attention over the ``sequence`` axis, per-layer post-RoPE
+        K/V are sown out, and shard_map's output stitching yields the global
+        K/V to write into the cache. One jit per prompt-bucket shape."""
+        import dataclasses as _dc
+
+        from jax.sharding import PartitionSpec as P
+
+        from unionml_tpu.models.layers import quantize_kv_rows
+
+        cfg = self.config
+        mesh = self.mesh
+        sp_module = type(self.module)(_dc.replace(self.module.config, attention_impl=cfg.sp_prefill))
+        n_layers = self.module.config.n_layers
+        compute_dtype = getattr(self.module.config, "dtype", jnp.bfloat16)
+        data_axes = tuple(a for a in ("data", "fsdp") if mesh.shape.get(a, 1) > 1) or None
+
+        def local_fwd(tokens_local, p):
+            seq_idx = jax.lax.axis_index("sequence")
+            local_len = tokens_local.shape[1]
+            positions = seq_idx * local_len + jnp.arange(local_len)
+            hidden, variables = sp_module.apply(
+                {"params": p}, tokens_local, positions, return_hidden=True, mutable=["kvs"]
+            )
+            kvs = variables["kvs"]
+            ks = tuple(kvs[f"layer_{i}"]["attn"]["k"][0] for i in range(n_layers))
+            vs = tuple(kvs[f"layer_{i}"]["attn"]["v"][0] for i in range(n_layers))
+            return hidden, ks, vs
+
+        try:
+            from jax import shard_map
+        except ImportError:  # pragma: no cover - older jax
+            from jax.experimental.shard_map import shard_map
+
+        tok_spec = P(data_axes, "sequence")
+        act_spec = P(data_axes, "sequence", None)
+        kv_spec = P(data_axes, "sequence", None, None)
+        out_specs = (act_spec, (kv_spec,) * n_layers, (kv_spec,) * n_layers)
+        try:
+            wrapped = shard_map(
+                local_fwd, mesh=mesh, in_specs=(tok_spec, P()), out_specs=out_specs, check_vma=False
+            )
+        except TypeError:  # older API spells the replication-check flag differently
+            wrapped = shard_map(
+                local_fwd, mesh=mesh, in_specs=(tok_spec, P()), out_specs=out_specs, check_rep=False
+            )
+
+        def sp_prefill(p, tokens, lengths, cache, key):
+            self.prefill_traces += 1
+            p = self._dequant_params(p)
+            hidden, ks, vs = wrapped(tokens, p)
+            new_cache = []
+            for i in range(n_layers):
+                layer = cache[i]
+                if "k_scale" in layer:
+                    kq, k_scale = quantize_kv_rows(ks[i])
+                    vq, v_scale = quantize_kv_rows(vs[i])
+                    layer = {
+                        "k": jax.lax.dynamic_update_slice(layer["k"], kq, (0, 0, 0, 0)),
+                        "v": jax.lax.dynamic_update_slice(layer["v"], vq, (0, 0, 0, 0)),
+                        "k_scale": jax.lax.dynamic_update_slice(layer["k_scale"], k_scale, (0, 0, 0, 0)),
+                        "v_scale": jax.lax.dynamic_update_slice(layer["v_scale"], v_scale, (0, 0, 0, 0)),
+                    }
+                else:
+                    layer = {
+                        "k": jax.lax.dynamic_update_slice(
+                            layer["k"], ks[i].astype(layer["k"].dtype), (0, 0, 0, 0)
+                        ),
+                        "v": jax.lax.dynamic_update_slice(
+                            layer["v"], vs[i].astype(layer["v"].dtype), (0, 0, 0, 0)
+                        ),
+                    }
+                new_cache.append(layer)
+            last = jnp.take_along_axis(hidden, (lengths - 1)[:, None, None], axis=1)[:, 0]
+            tok0 = sample_tokens(self._head_fn(p, last.astype(compute_dtype)), key, cfg)
+            return tok0, tuple(new_cache), last.astype(jnp.float32)
+
+        return jax.jit(sp_prefill, donate_argnums=(3,))
 
     def _bucket(self, max_prompt: int) -> int:
         for b in sorted(self.config.prompt_buckets):
@@ -360,8 +448,18 @@ class Generator:
         all_lengths = np.ones((batch,), np.int32)
         all_lengths[:n] = lengths
 
+        sp = (
+            cfg.sp_prefill
+            and self.mesh is not None
+            and int(self.mesh.shape.get("sequence", 1)) > 1
+        )
         chunk = cfg.prefill_chunk
-        if chunk:
+        if sp:
+            seq = int(self.mesh.shape["sequence"])
+            aligned = -(-bucket // seq) * seq  # each sequence shard gets equal columns
+            tokens = np.pad(tokens, ((0, 0), (0, aligned - tokens.shape[1])), constant_values=cfg.pad_id)
+            bucket = aligned
+        elif chunk:
             bucket = -(-bucket // chunk) * chunk  # chunk-aligned; bucket shape is moot
             tokens = np.pad(tokens, ((0, 0), (0, bucket - tokens.shape[1])), constant_values=cfg.pad_id)
         cache_len = max(bucket, max(cfg.prompt_buckets, default=0)) + cfg.max_new_tokens + extra_cache
@@ -371,7 +469,13 @@ class Generator:
         key = jax.random.PRNGKey(seed)
         key, prefill_key = jax.random.split(key)
         row_valid = jnp.arange(batch) < n
-        if chunk and bucket > chunk:
+        if sp:
+            if self._sp_prefill_fn is None:
+                self._sp_prefill_fn = self._build_sp_prefill()
+            tok0, cache, last = self._sp_prefill_fn(
+                self.params, jnp.asarray(tokens), jnp.asarray(all_lengths), cache, prefill_key
+            )
+        elif chunk and bucket > chunk:
             lengths_dev = jnp.asarray(all_lengths)
             last = jnp.zeros((batch, self.module.config.dim), jnp.float32)
             for c in range(0, bucket, chunk):

@@ -38,6 +38,16 @@ def _write_cache(buffer: jax.Array, new: jax.Array, starts: jax.Array) -> jax.Ar
     )
 
 
+def quantize_kv_rows(x: jax.Array):
+    """Symmetric per-(position, head) int8 for K/V rows: ``(int8 values, f32
+    scales [..., 1])``. Shared by the int8-KV cached-attention write path and the
+    sequence-parallel prefill's cache assembly."""
+    scale = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    scale = jnp.maximum(scale, 1e-8) / 127.0
+    rows = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127)
+    return rows.astype(jnp.int8), scale
+
+
 class RMSNorm(nn.Module):
     """Root-mean-square layer norm (pre-norm default for decoder stacks)."""
 
@@ -166,14 +176,8 @@ class Attention(nn.Module):
                 # write; dequant on read fuses into the attention contraction.
                 # Long-context decode streams the cache every step — int8 halves
                 # those bytes (scales are D/4x smaller than the values).
-                def quantize_rows(x: jax.Array):
-                    scale = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
-                    scale = jnp.maximum(scale, 1e-8) / 127.0
-                    rows = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127)
-                    return rows.astype(jnp.int8), scale
-
-                kq, k_scale = quantize_rows(k)
-                vq, v_scale = quantize_rows(v)
+                kq, k_scale = quantize_kv_rows(k)
+                vq, v_scale = quantize_kv_rows(v)
                 cache = {
                     "k": _write_cache(cache["k"], kq, starts),
                     "v": _write_cache(cache["v"], vq, starts),
@@ -194,6 +198,12 @@ class Attention(nn.Module):
             out = multihead_attention(q, keys, values, causal=False, mask=visible, impl="xla")
             out = out.reshape(batch, length, self.n_heads * head_dim)
             return dense(features, "o_proj")(out), cache
+
+        # uncached forward: expose post-RoPE K/V for cache assembly (materialized
+        # only when the caller passes mutable=["kvs"], e.g. the sequence-parallel
+        # prefill; a plain apply pays nothing)
+        self.sow("kvs", "k", k)
+        self.sow("kvs", "v", v)
 
         if self.impl in ("ring", "ulysses"):
             if mask is not None:
