@@ -190,28 +190,21 @@ class Generator:
         if quantize not in (None, "int8"):
             raise ValueError(f"unsupported quantize mode {quantize!r}; expected None or 'int8'")
 
-        if mesh is not None:
-            from unionml_tpu.parallel.sharding import combine_fsdp_tp, shard_pytree, unbox_partitioned
+        from unionml_tpu.parallel.sharding import combine_fsdp_tp, shard_pytree, unbox_partitioned
 
-            # resolve shardings from the still-boxed tree so nn.Partitioned
-            # metadata keeps its precedence over regex rules / inferred FSDP,
-            # then unbox (the sharding tree matches the unboxed structure)
-            shardings = combine_fsdp_tp(params, mesh, partition_rules)
-            params = unbox_partitioned(params)
-            if quantize == "int8":
-                from unionml_tpu.ops.quant import quantize_params
+        # resolve shardings from the still-boxed tree so nn.Partitioned metadata
+        # keeps its precedence over regex rules / inferred FSDP, then unbox (the
+        # sharding tree matches the unboxed structure)
+        shardings = combine_fsdp_tp(params, mesh, partition_rules) if mesh is not None else None
+        params = unbox_partitioned(params)
+        if quantize == "int8":
+            from unionml_tpu.ops.quant import quantize_params
 
-                params = quantize_params(params)
+            params = quantize_params(params)
+            if shardings is not None:
                 shardings = _quantized_shardings(params, shardings, mesh)
+        if shardings is not None:
             params = shard_pytree(params, shardings)
-        else:
-            from unionml_tpu.parallel.sharding import unbox_partitioned
-
-            params = unbox_partitioned(params)
-            if quantize == "int8":
-                from unionml_tpu.ops.quant import quantize_params
-
-                params = quantize_params(params)
         self.params = params
 
         if quantize == "int8":

@@ -100,21 +100,15 @@ class SpeculativeGenerator:
         pad = jnp.int32(cfg.pad_id)
         eos = cfg.eos_id
 
+        # reuse each generator's jit-side apply/head closures (same fns its own
+        # prefill/decode compile) rather than re-deriving the forward here
         def draft_apply(p, tok, positions, cache):
-            hidden, cache = draft.module.apply(
-                {"params": p}, tok, positions=positions, return_hidden=True,
-                cache=cache, token_mask=None,
-            )
-            kernel = p["lm_head"]["kernel"]
-            return (hidden @ kernel.astype(hidden.dtype)).astype(jnp.float32), cache
+            hidden, cache = draft._apply_fn(p, tok, positions, cache, None)
+            return draft._head_fn(p, hidden), cache
 
         def target_apply(p, tok, positions, cache, token_mask):
-            hidden, cache = target.module.apply(
-                {"params": p}, tok, positions=positions, return_hidden=True,
-                cache=cache, token_mask=token_mask,
-            )
-            kernel = p["lm_head"]["kernel"]
-            return (hidden @ kernel.astype(hidden.dtype)).astype(jnp.float32), cache
+            hidden, cache = target._apply_fn(p, tok, positions, cache, token_mask)
+            return target._head_fn(p, hidden), cache
 
         from unionml_tpu.models.generate import filtered_logits, policy_probs
 
