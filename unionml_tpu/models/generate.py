@@ -160,6 +160,16 @@ def sample_tokens(logits: jax.Array, key: jax.Array, config: GenerationConfig) -
     return jax.random.categorical(key, filtered_logits(logits, config)).astype(jnp.int32)
 
 
+@dataclasses.dataclass(frozen=True)
+class PrefixCache:
+    """Precomputed K/V rows for a shared prompt prefix (a system prompt): built
+    once by :meth:`Generator.cache_prefix`, reused by every request that passes
+    it — the prefix's prefill cost is paid once, not per call."""
+
+    layers: Tuple[Any, ...]  # per-layer cache leaves trimmed to [1, length, ...]
+    length: int
+
+
 class Generator:
     """Batch text generation over a cached decoder.
 
@@ -409,17 +419,34 @@ class Generator:
 
     # ------------------------------------------------------------------ generate
 
+    def cache_prefix(self, prefix_tokens: Sequence[int]) -> PrefixCache:
+        """Prefill a shared prompt prefix once and return its K/V rows for reuse:
+        pass the result as ``prefix=`` to :meth:`__call__` / :meth:`stream` and
+        only the per-request suffix is prefilled — the system-prompt cost is paid
+        here, not per request."""
+        p0 = len(prefix_tokens)
+        if p0 == 0:
+            raise ValueError("prefix_tokens must be non-empty")
+        _, _, _, (cache, _, _, _, _) = self._start([list(prefix_tokens)], 0)
+        return PrefixCache(
+            layers=jax.tree_util.tree_map(lambda c: c[:1, :p0], cache), length=p0
+        )
+
     def _start(
         self,
         prompts: Sequence[Sequence[int]],
         seed: int,
         extra_cache: int = 0,
         batch_override: Optional[int] = None,
+        prefix: Optional[PrefixCache] = None,
     ):
         """Shared prefill setup: pad/bucket the prompts, allocate + place the cache,
         run prefill, and return the first sampled token, the last-token hidden
         states, and the decode carry. ``batch_override`` pins the padded batch
-        exactly (beam search needs batch == groups * num_beams)."""
+        exactly (beam search needs batch == groups * num_beams). With ``prefix``,
+        the cached prefix rows are pasted into every row's cache and only the
+        suffix is prefilled (through the chunked path, which takes a start
+        offset)."""
         cfg = self.config
         n = len(prompts)
         lengths = np.array([max(len(p), 1) for p in prompts], np.int32)
@@ -447,6 +474,12 @@ class Generator:
             and int(self.mesh.shape.get("sequence", 1)) > 1
         )
         chunk = cfg.prefill_chunk
+        if prefix is not None:
+            if sp:
+                raise NotImplementedError("sp_prefill does not compose with prefix caching yet")
+            return self._start_with_prefix(
+                prefix, tokens, lengths, batch, n, bucket, extra_cache, seed
+            )
         if sp:
             seq = int(self.mesh.shape["sequence"])
             aligned = -(-bucket // seq) * seq  # each sequence shard gets equal columns
