@@ -227,3 +227,57 @@ def test_init_cache_shapes(tiny):
     head_dim = config.dim // config.n_heads
     assert cache[0]["k"].shape == (2, 32, config.n_kv_heads, head_dim)
     assert cache[0]["v"].dtype == config.dtype
+
+
+def test_prefix_cache_matches_full_prompt(tiny):
+    """Prefix reuse must be invisible in the output: generating from (prefix +
+    suffix) as one prompt and from suffix with the prefix's cached K/V rows are
+    the same computation — RoPE positions continue at prefix.length and the
+    pasted rows are visible to every suffix/decode query."""
+    module, params, _ = tiny
+    cfg = GenerationConfig(max_new_tokens=8, temperature=0.0, prompt_buckets=(8, 32))
+    gen = Generator(module, params, cfg)
+    prefix = [7, 7, 3, 9, 1, 2]
+    suffixes = [[3, 1, 4], [9, 2, 6, 5]]
+
+    full = gen([prefix + s for s in suffixes])
+    cached = gen.cache_prefix(prefix)
+    assert cached.length == len(prefix)
+    np.testing.assert_array_equal(gen(suffixes, prefix=cached), full)
+
+
+def test_prefix_cache_stream_matches_call(tiny):
+    module, params, _ = tiny
+    cfg = GenerationConfig(max_new_tokens=9, temperature=0.0, prompt_buckets=(8, 16))
+    gen = Generator(module, params, cfg)
+    cached = gen.cache_prefix([5, 4, 3, 2])
+    suffixes = [[1, 2], [8]]
+    expected = gen(suffixes, prefix=cached)
+    chunks = list(gen.stream(suffixes, prefix=cached, chunk_size=4))
+    np.testing.assert_array_equal(np.concatenate(chunks, axis=1), expected)
+
+
+def test_prefix_cache_with_chunked_prefill_and_int8_kv(tiny):
+    """Composition: the suffix flows through the chunked path (start offset =
+    prefix length) and the int8-KV quantized rows paste losslessly (the prefix
+    rows are already quantized, so reuse introduces no extra rounding)."""
+    module, params, _ = tiny
+    prefix = list(range(1, 11))
+    suffixes = [[3, 1, 4, 1, 5], [9, 2]]
+    for kv in (None, "int8"):
+        cfg = GenerationConfig(
+            max_new_tokens=6, temperature=0.0, prompt_buckets=(16,),
+            prefill_chunk=4, kv_cache_dtype=kv,
+        )
+        gen = Generator(module, params, cfg)
+        full = gen([prefix + s for s in suffixes])
+        out = gen(suffixes, prefix=gen.cache_prefix(prefix))
+        np.testing.assert_array_equal(out, full)
+
+
+def test_prefix_cache_rejects_empty_suffix(tiny):
+    module, params, _ = tiny
+    gen = Generator(module, params, GenerationConfig(max_new_tokens=4, temperature=0.0))
+    cached = gen.cache_prefix([5, 4, 3])
+    with pytest.raises(ValueError, match="non-empty"):
+        gen([[1, 2], []], prefix=cached)

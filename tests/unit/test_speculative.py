@@ -110,3 +110,45 @@ def test_speculative_sampling_is_seed_deterministic():
     b = spec(PROMPTS, seed=11)
     np.testing.assert_array_equal(a, b)
     assert (spec(PROMPTS, seed=12) != a).any()
+
+
+def test_perfect_draft_long_horizon_acceptance():
+    """Draft-cache completeness: every accepted draft token's K/V must land in
+    the draft cache (including the last draft of an all-accept round, which the
+    scan itself never feeds). With holes, a perfect draft's acceptance decays
+    as zero-initialized slots stay visible to later queries; with a complete
+    cache the rounds count stays near the all-accept ideal."""
+    target, tp = _model(0)
+    cfg = GenerationConfig(max_new_tokens=40, temperature=0.0, prompt_buckets=(16,))
+    expected = Generator(target, tp, cfg)(PROMPTS)
+    spec = SpeculativeGenerator(target, tp, target, tp, cfg, gamma=4)
+    np.testing.assert_array_equal(spec(PROMPTS), expected)
+    # 39 post-prefill tokens at gamma=4: all-accept needs 8 rounds; leave slack
+    # only for ulp-level argmax flips between the [B,1] and [B,gamma+1] forwards
+    assert spec.rounds <= 14, spec.rounds
+
+
+def test_moe_target_verifies_with_routed_experts():
+    """The [B, gamma+1] verify forward must trace through a routed decoder: the
+    token_mask broadcasts to the verify width (a [B, 1] mask used to fail at
+    trace time), and greedy output equals the MoE target's own decode."""
+    from unionml_tpu.models import MoEConfig, MoETransformer
+
+    config = MoEConfig.tiny(
+        vocab_size=61, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, hidden_dim=96,
+        n_experts=4, k=2, capacity_factor=8.0, dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    module = MoETransformer(config)
+    params = module.init(jax.random.PRNGKey(2), jnp.zeros((1, 8), jnp.int32))["params"]
+    draft_cfg = LlamaConfig.tiny(
+        vocab_size=61, dim=32, n_layers=1, n_heads=4, n_kv_heads=2, hidden_dim=64,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    draft = Llama(draft_cfg)
+    dp = draft.init(jax.random.PRNGKey(9), jnp.zeros((1, 8), jnp.int32))["params"]
+
+    cfg = GenerationConfig(max_new_tokens=10, temperature=0.0, prompt_buckets=(16,))
+    prompts = [[3, 1, 4, 1, 5], [9, 2]]
+    expected = Generator(module, params, cfg)(prompts)
+    spec = SpeculativeGenerator(module, params, draft, dp, cfg, gamma=3)
+    np.testing.assert_array_equal(spec(prompts), expected)

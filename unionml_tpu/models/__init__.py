@@ -1,7 +1,13 @@
 """Model library: flagship flax models for the benchmark configs (BASELINE.json)."""
 
 from unionml_tpu.models.bert import BertConfig, BertEncoder, bert_partition_rules, classification_loss  # noqa: F401
-from unionml_tpu.models.generate import GenerationConfig, Generator, init_cache, sample_tokens  # noqa: F401
+from unionml_tpu.models.generate import (  # noqa: F401
+    GenerationConfig,
+    Generator,
+    PrefixCache,
+    init_cache,
+    sample_tokens,
+)
 from unionml_tpu.models.speculative import SpeculativeGenerator  # noqa: F401
 from unionml_tpu.models.llama import (  # noqa: F401
     Llama,

@@ -39,7 +39,7 @@ import jax.numpy as jnp
 
 from unionml_tpu._logging import logger
 
-__all__ = ["GenerationConfig", "Generator", "init_cache", "sample_tokens"]
+__all__ = ["GenerationConfig", "Generator", "PrefixCache", "init_cache", "sample_tokens"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -101,6 +101,21 @@ def init_cache(config: Any, batch: int, cache_len: int, kv_dtype: Optional[str] 
         {"k": jnp.zeros(shape, config.dtype), "v": jnp.zeros(shape, config.dtype)}
         for _ in range(config.n_layers)
     )
+
+
+def _paste_prefix_rows(cache: Any, prefix_layers: Any) -> Any:
+    """Broadcast a :class:`PrefixCache`'s ``[1, p0, ...]`` K/V rows into slots
+    ``[0, p0)`` of every row of a freshly allocated cache. Jitted (donating the
+    cache) so the paste is one fused dispatch, not 2 * n_layers eager ops."""
+
+    def paste(buf: jax.Array, pre: jax.Array) -> jax.Array:
+        pre = jnp.broadcast_to(pre.astype(buf.dtype), (buf.shape[0],) + pre.shape[1:])
+        return jax.lax.dynamic_update_slice(buf, pre, (0,) * buf.ndim)
+
+    return jax.tree_util.tree_map(paste, cache, prefix_layers)
+
+
+_paste_prefix_rows = jax.jit(_paste_prefix_rows, donate_argnums=(0,))
 
 
 def _quantized_shardings(qparams: Any, shardings: Any, mesh: Any) -> Any:
@@ -332,12 +347,17 @@ class Generator:
         compute_dtype = getattr(self.module.config, "dtype", jnp.bfloat16)
         data_axes = tuple(a for a in ("data", "fsdp") if mesh.shape.get(a, 1) > 1) or None
 
-        def local_fwd(tokens_local, p):
+        def local_fwd(tokens_local, mask_local, p):
             seq_idx = jax.lax.axis_index("sequence")
             local_len = tokens_local.shape[1]
             positions = seq_idx * local_len + jnp.arange(local_len)
             hidden, variables = sp_module.apply(
-                {"params": p}, tokens_local, positions, return_hidden=True, mutable=["kvs"]
+                {"params": p},
+                tokens_local,
+                positions,
+                return_hidden=True,
+                token_mask=mask_local,
+                mutable=["kvs"],
             )
             kvs = variables["kvs"]
             ks = tuple(kvs[f"layer_{i}"]["attn"]["k"][0] for i in range(n_layers))
@@ -353,19 +373,23 @@ class Generator:
         act_spec = P(data_axes, "sequence", None)
         kv_spec = P(data_axes, "sequence", None, None)
         out_specs = (act_spec, (kv_spec,) * n_layers, (kv_spec,) * n_layers)
+        in_specs = (tok_spec, tok_spec, P())
         try:
             wrapped = shard_map(
-                local_fwd, mesh=mesh, in_specs=(tok_spec, P()), out_specs=out_specs, check_vma=False
+                local_fwd, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
             )
         except TypeError:  # older API spells the replication-check flag differently
             wrapped = shard_map(
-                local_fwd, mesh=mesh, in_specs=(tok_spec, P()), out_specs=out_specs, check_rep=False
+                local_fwd, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
             )
 
-        def sp_prefill(p, tokens, lengths, cache, key):
+        def sp_prefill(p, tokens, lengths, cache, key, row_valid):
             self.prefill_traces += 1
             p = self._dequant_params(p)
-            hidden, ks, vs = wrapped(tokens, p)
+            # pad columns and synthetic batch rows must not claim routed-expert
+            # capacity — same contract as the dense prefill's token_mask
+            token_mask = (jnp.arange(tokens.shape[1])[None] < lengths[:, None]) & row_valid[:, None]
+            hidden, ks, vs = wrapped(tokens, token_mask, p)
             new_cache = []
             for i in range(n_layers):
                 layer = cache[i]
@@ -449,6 +473,12 @@ class Generator:
         offset)."""
         cfg = self.config
         n = len(prompts)
+        if prefix is not None and any(len(p) == 0 for p in prompts):
+            # an empty suffix would silently condition on prefix + [pad_id]
+            # (lengths are clamped to >= 1 below); bare continuation from a
+            # prefix would need the prefix's last-token hidden, which
+            # cache_prefix does not keep
+            raise ValueError("prompts must be non-empty when prefix= is given")
         lengths = np.array([max(len(p), 1) for p in prompts], np.int32)
         bucket = self._bucket(int(lengths.max()))
         if batch_override is not None:
@@ -477,9 +507,7 @@ class Generator:
         if prefix is not None:
             if sp:
                 raise NotImplementedError("sp_prefill does not compose with prefix caching yet")
-            return self._start_with_prefix(
-                prefix, tokens, lengths, batch, n, bucket, extra_cache, seed
-            )
+            return self._start_with_prefix(prefix, tokens, lengths, batch, n, bucket, extra_cache, seed)
         if sp:
             seq = int(self.mesh.shape["sequence"])
             aligned = -(-bucket // seq) * seq  # each sequence shard gets equal columns
@@ -499,37 +527,101 @@ class Generator:
             if self._sp_prefill_fn is None:
                 self._sp_prefill_fn = self._build_sp_prefill()
             tok0, cache, last = self._sp_prefill_fn(
-                self.params, jnp.asarray(tokens), jnp.asarray(all_lengths), cache, prefill_key
+                self.params, jnp.asarray(tokens), jnp.asarray(all_lengths), cache, prefill_key, row_valid
             )
         elif chunk and bucket > chunk:
-            lengths_dev = jnp.asarray(all_lengths)
-            last = jnp.zeros((batch, self.module.config.dim), jnp.float32)
-            for c in range(0, bucket, chunk):
-                chunk_last, has, cache = self._prefill_chunk(
-                    self.params,
-                    jnp.asarray(tokens[:, c : c + chunk]),
-                    jnp.int32(c),
-                    lengths_dev,
-                    cache,
-                    row_valid,
-                )
-                last = jnp.where(has[:, None], chunk_last, last)
+            last, cache = self._chunked_prefill_loop(
+                tokens, jnp.asarray(all_lengths), cache, row_valid, chunk
+            )
             tok0 = self._first_token(self.params, last, prefill_key)
         else:
             tok0, cache, last = self._prefill(
                 self.params, jnp.asarray(tokens), jnp.asarray(all_lengths), cache, prefill_key, row_valid
             )
-        eos = cfg.eos_id
+        return self._finish_prefill(n, tok0, last, cache, jnp.asarray(all_lengths), row_valid, key)
+
+    def _chunked_prefill_loop(self, tokens, lengths_dev, cache, row_valid, chunk: int, start: int = 0):
+        """Run right-padded ``tokens`` through the chunked prefill fn in
+        ``chunk``-column slices whose absolute positions begin at ``start``,
+        accumulating each row's last-real-token hidden state."""
+        last = jnp.zeros((tokens.shape[0], self.module.config.dim), jnp.float32)
+        for c in range(0, tokens.shape[1], chunk):
+            chunk_last, has, cache = self._prefill_chunk(
+                self.params,
+                jnp.asarray(tokens[:, c : c + chunk]),
+                jnp.int32(start + c),
+                lengths_dev,
+                cache,
+                row_valid,
+            )
+            last = jnp.where(has[:, None], chunk_last, last)
+        return last, cache
+
+    def _finish_prefill(self, n, tok0, last, cache, lengths_dev, row_valid, key):
+        eos = self.config.eos_id
         done = (tok0 == eos) if eos is not None else jnp.zeros(tok0.shape, bool)
         # synthetic batch-padding rows start done: they emit pads, never advance
         # their cache, and stay out of routed-expert capacity
         done = done | ~row_valid
-        return n, tok0, last, (cache, tok0, jnp.asarray(all_lengths), done, key)
+        return n, tok0, last, (cache, tok0, lengths_dev, done, key)
 
-    def __call__(self, prompts: Sequence[Sequence[int]], *, seed: int = 0) -> np.ndarray:
+    def _start_with_prefix(
+        self,
+        prefix: PrefixCache,
+        tokens: np.ndarray,
+        lengths: np.ndarray,
+        batch: int,
+        n: int,
+        bucket: int,
+        extra_cache: int,
+        seed: int,
+    ):
+        """Prefill only the per-request suffix: the prefix's K/V rows are pasted
+        into slots ``[0, p0)`` of every cache row and the suffix flows through the
+        chunked-prefill path with a start offset of ``p0`` (its positions — hence
+        RoPE phases and visibility — continue where the prefix left off). The
+        shared system-prompt cost was paid once in :meth:`cache_prefix`."""
+        cfg = self.config
+        p0 = prefix.length
+        chunk = cfg.prefill_chunk or bucket
+        aligned = -(-bucket // chunk) * chunk
+        if aligned > tokens.shape[1]:
+            tokens = np.pad(
+                tokens, ((0, 0), (0, aligned - tokens.shape[1])), constant_values=cfg.pad_id
+            )
+        cache_len = (
+            p0 + max(aligned, max(cfg.prompt_buckets, default=0)) + cfg.max_new_tokens + extra_cache
+        )
+        cache = self._place_cache(
+            init_cache(self.module.config, batch, cache_len, kv_dtype=cfg.kv_cache_dtype)
+        )
+        cache = _paste_prefix_rows(cache, prefix.layers)
+        key = jax.random.PRNGKey(seed)
+        key, prefill_key = jax.random.split(key)
+        row_valid = jnp.arange(batch) < n
+        # total sequence length = prefix + suffix; synthetic rows pretend one
+        # suffix token (they are masked out of the forward via row_valid anyway)
+        all_lengths = np.full((batch,), p0 + 1, np.int32)
+        all_lengths[:n] = p0 + lengths
+        lengths_dev = jnp.asarray(all_lengths)
+        last, cache = self._chunked_prefill_loop(
+            tokens, lengths_dev, cache, row_valid, chunk, start=p0
+        )
+        tok0 = self._first_token(self.params, last, prefill_key)
+        return self._finish_prefill(n, tok0, last, cache, lengths_dev, row_valid, key)
+
+    def __call__(
+        self,
+        prompts: Sequence[Sequence[int]],
+        *,
+        seed: int = 0,
+        prefix: Optional[PrefixCache] = None,
+    ) -> np.ndarray:
         """Generate ``max_new_tokens`` per prompt; returns ``[len(prompts), max_new]``
-        int32 (``pad_id`` after each example's ``eos_id``)."""
-        n, tok0, _, carry = self._start(prompts, seed)
+        int32 (``pad_id`` after each example's ``eos_id``). With ``prefix`` (from
+        :meth:`cache_prefix`), prompts are suffixes after the shared prefix and
+        only they are prefilled."""
+        n, tok0, _, carry = self._start(prompts, seed, prefix=prefix)
         steps = self.config.max_new_tokens - 1
         first = np.asarray(tok0)[:, None]
         if steps <= 0:
@@ -659,19 +751,27 @@ class Generator:
 
         return jax.jit(beam_fn, donate_argnums=(1,))
 
-    def stream(self, prompts: Sequence[Sequence[int]], *, seed: int = 0, chunk_size: int = 16):
+    def stream(
+        self,
+        prompts: Sequence[Sequence[int]],
+        *,
+        seed: int = 0,
+        chunk_size: int = 16,
+        prefix: Optional[PrefixCache] = None,
+    ):
         """Incremental generation: yields ``[len(prompts), <=chunk_size]`` arrays of
         newly decoded tokens as they materialize (the first yield is the single
         prompt-sampled token). The decode compiles once per ``chunk_size``; when
         every row has emitted ``eos_id`` the stream ends early. Total tokens across
-        yields equal ``__call__``'s output for the same seed."""
+        yields equal ``__call__``'s output for the same seed. ``prefix`` works as
+        in :meth:`__call__`."""
         cfg = self.config
         if chunk_size < 1:
             raise ValueError("chunk_size must be >= 1")
         # the last chunk may overshoot max_new_tokens; give its cache writes room
         n_chunks = max(0, -(-(cfg.max_new_tokens - 1) // chunk_size))
         extra = n_chunks * chunk_size - (cfg.max_new_tokens - 1)
-        n, tok0, _, carry = self._start(prompts, seed, extra_cache=extra)
+        n, tok0, _, carry = self._start(prompts, seed, extra_cache=extra, prefix=prefix)
         yield np.asarray(tok0)[:n, None]
         produced = 1
         while produced < cfg.max_new_tokens:

@@ -35,6 +35,14 @@ Sampled runs are NOT key-path-compatible with the plain Generator (they consume
 randomness differently), so equality holds in distribution, not per seed —
 tests/unit/test_speculative.py checks both: exact tokens for greedy, empirical
 distribution closeness for sampling.
+
+Routed-expert (MoE) targets: exactness additionally requires ample expert
+capacity. Capacity is sized per routed group, and the ``[B, gamma+1]`` verify
+forward routes ``gamma + 1`` tokens per row where target-only decode routes one
+— under a tight ``capacity_factor`` a token can be capacity-dropped in the
+verify but not in plain decode (or vice versa), perturbing its logits. Size
+``capacity_factor`` for ``B * (gamma + 1)`` tokens when serving MoE targets
+speculatively (the MoE test here uses an ample factor for this reason).
 """
 
 from __future__ import annotations
@@ -135,10 +143,24 @@ class SpeculativeGenerator:
             drafts = drafts.T  # [B, gamma]
             draft_logits = jnp.swapaxes(draft_logits, 0, 1)  # [B, gamma, V]
 
+            # --- draft-cache completeness: the scan fed [tok, drafts[:gamma-1]],
+            # so drafts[gamma-1]'s K/V slot is never written; on an all-accept
+            # round the next draft queries would attend to that zero-initialized
+            # (visible) slot and acceptance would silently degrade as holes
+            # accumulate. One extra headless feed fills it — for rows that
+            # rejected earlier the slot is beyond their length (invisible stale
+            # data, overwritten when they reach it), so the feed is always safe.
+            _, d_cache = draft._apply_fn(
+                dp, drafts[:, gamma - 1 :], (lengths + gamma)[:, None], d_cache, None
+            )
+
             # --- target: score tok + all gamma drafts in one cached forward ---
             inputs = jnp.concatenate([tok[:, None], drafts], axis=1)  # [B, gamma+1]
             positions = lengths[:, None] + jnp.arange(gamma + 1)[None]
-            logits, t_cache = target_apply(tp, inputs, positions, t_cache, (~done)[:, None])
+            # routed decoders consume the mask per token: broadcast row-done
+            # over the full [B, gamma+1] verify width
+            verify_mask = jnp.broadcast_to((~done)[:, None], inputs.shape)
+            logits, t_cache = target_apply(tp, inputs, positions, t_cache, verify_mask)
 
             # --- rejection sampling against the policy distributions ---
             # (greedy is the one-hot special case: accept iff argmaxes agree, the
