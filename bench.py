@@ -113,6 +113,32 @@ def bench_torch_cpu() -> float:
 
 
 _RESULT_TAG = "BENCH_RESULT_SAMPLES_PER_SEC"
+_PROBE_TAG = "BENCH_PROBE_OK"
+
+
+def _probe_backend(timeout_s: float = 90.0) -> str:
+    """Cheap backend-health probe in a throwaway subprocess: init the default
+    platform and FETCH one matmul scalar (a literal fetch is the only reliable
+    fence on the tunneled TPU plugin). Costs ~25-45s when the backend is
+    healthy vs 7 minutes to learn the same thing from a timed-out full bench.
+    Returns the worker's platform name ("tpu"/"cpu"/...), or "timeout"/"failed"
+    when the backend is wedged or crashing — both retry-worthy states."""
+    import os
+    import subprocess
+
+    args = [sys.executable, os.path.abspath(__file__), "--probe-worker"]
+    try:
+        proc = subprocess.run(args, stdout=subprocess.PIPE, timeout=timeout_s, text=True)
+    except subprocess.TimeoutExpired:
+        _log(f"backend probe timed out after {timeout_s:.0f}s (plugin wedged)")
+        return "timeout"
+    if proc.returncode != 0:
+        _log(f"backend probe exited rc={proc.returncode} (backend init crash)")
+        return "failed"
+    for line in reversed(proc.stdout.splitlines()):
+        if line.startswith(_PROBE_TAG):
+            return line.split()[1]
+    return "failed"
 
 
 def _run_jax_worker(platform: str | None, timeout_s: float) -> "tuple[float, str] | str":
@@ -143,17 +169,45 @@ def _run_jax_worker(platform: str | None, timeout_s: float) -> "tuple[float, str
 
 
 def main() -> None:
-    attempts, backoff_s, timeout_s = 3, 60.0, 420.0
+    """Accelerator phase: probe-gated attempts spread across a wide interval.
+
+    The tunneled TPU plugin wedges for stretches of minutes; round 2's three
+    contiguous 420s attempts all landed inside one such stretch. Instead: a
+    ~90s probe decides whether the backend is worth a full 420s bench run, and
+    failed probes sleep with growing backoff so the attempts sample DIFFERENT
+    health windows across the whole budget (default 25 min, overridable via
+    BENCH_TPU_BUDGET_S) rather than one contiguous stretch."""
+    import os
+
+    probe_timeout_s, bench_timeout_s = 90.0, 420.0
+    budget_s = float(os.environ.get("BENCH_TPU_BUDGET_S", "1500"))
+    deadline = time.monotonic() + budget_s
     result: "tuple[float, str] | str" = "timeout"
-    for attempt in range(attempts):
-        result = _run_jax_worker(None, timeout_s)  # default platform = TPU when healthy
-        if result == "failed":
-            break  # deterministic failure: retrying identically is wasted wall-clock
-        if not isinstance(result, str):
+    sleep_s = 45.0
+    attempt = 0
+    while True:
+        attempt += 1
+        probe = _probe_backend(probe_timeout_s)
+        if probe not in ("timeout", "failed"):
+            if probe == "cpu":
+                # no accelerator plugin at all: the spread-retry dance is pointless
+                _log("default platform is cpu (no TPU plugin); skipping straight to CPU run")
+                break
+            _log(f"probe healthy on platform={probe}; running full bench (attempt {attempt})")
+            result = _run_jax_worker(None, bench_timeout_s)
+            if not isinstance(result, str):
+                break
+            if result == "failed":
+                break  # crash after a healthy probe: deterministic, not a wedge
+            # timed out mid-run though the probe passed: wedged again; keep sampling
+        remaining = deadline - time.monotonic()
+        if remaining < sleep_s + probe_timeout_s:
+            _log(f"TPU budget exhausted after {attempt} probe/bench attempts")
             break
-        if attempt < attempts - 1:
-            _log(f"retrying TPU bench in {backoff_s:.0f}s (attempt {attempt + 2}/{attempts})")
-            time.sleep(backoff_s)
+        _log(f"backend unhealthy (probe={probe}); next probe in {sleep_s:.0f}s "
+             f"({remaining:.0f}s of budget left)")
+        time.sleep(sleep_s)
+        sleep_s = min(sleep_s * 1.6, 240.0)
     if isinstance(result, str):
         _log("TPU backend unavailable after retries; falling back to CPU so the bench still reports")
         result = _run_jax_worker("cpu", 900.0)
@@ -188,5 +242,12 @@ if __name__ == "__main__":
         import jax
 
         print(f"{_RESULT_TAG} {result} {jax.devices()[0].platform}", flush=True)
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--probe-worker":
+        import jax
+        import jax.numpy as jnp
+
+        x = jnp.ones((256, 256), jnp.bfloat16)
+        float((x @ x)[0, 0])  # literal scalar fetch: the only reliable fence here
+        print(f"{_PROBE_TAG} {jax.devices()[0].platform}", flush=True)
     else:
         main()

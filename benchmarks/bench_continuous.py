@@ -1,0 +1,114 @@
+"""Continuous-batching benchmark: aggregate decode tok/s vs stream concurrency.
+
+Metric: aggregate decode tokens/sec across N concurrent streams sharing decode
+dispatches through :class:`unionml_tpu.serving.ContinuousBatcher`, at the
+benchmark shape's max concurrency. ``vs_baseline`` is the scaling factor over
+ONE stream run the same way — decode is weight-bandwidth bound, so stepping S
+resident rows costs roughly one row's HBM traffic and aggregate throughput
+should scale near-linearly until the batch leaves the bandwidth-bound regime.
+
+The reference cannot express this at all: its serving path runs the user
+predictor eagerly one request at a time (unionml/fastapi.py:50-64), so
+concurrent generation requests queue serially. There is no reference number;
+the baseline is our own single-stream rate.
+
+Every printed line goes to stderr except the final JSON metric line (stdout).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+from benchmarks.common import Timer, emit, log, pin_platform
+
+import os
+
+# BENCH_SMALL=1: tiny shapes for a CPU smoke run of the harness itself
+_SMALL = os.environ.get("BENCH_SMALL") == "1"
+PROXY_LAYERS = 2 if _SMALL else 8
+PROMPT_LEN = 16 if _SMALL else 128
+NEW_TOKENS = 12 if _SMALL else 96
+CONCURRENCY = (1, 2, 4) if _SMALL else (1, 2, 4, 8)
+
+
+def run_streams(batcher, prompts) -> int:
+    """Drive len(prompts) concurrent streams to completion; returns tokens consumed."""
+    totals = [0] * len(prompts)
+
+    def worker(i: int) -> None:
+        for chunk in batcher.submit(prompts[i]):
+            totals[i] += int(np.asarray(chunk).size)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return sum(totals)
+
+
+def main() -> None:
+    pin_platform()
+    import jax
+    import jax.numpy as jnp
+
+    from unionml_tpu.models import GenerationConfig, Generator, Llama, LlamaConfig
+    from unionml_tpu.serving import ContinuousBatcher
+
+    log(f"devices: {jax.devices()}")
+    if _SMALL:
+        config = LlamaConfig.tiny(max_seq_len=PROMPT_LEN + NEW_TOKENS)
+    else:
+        config = LlamaConfig.llama3_8b(
+            n_layers=PROXY_LAYERS, param_dtype=jnp.bfloat16, max_seq_len=PROMPT_LEN + NEW_TOKENS
+        )
+    module = Llama(config)
+    params = jax.jit(
+        lambda key: module.init(key, jnp.zeros((1, 8), jnp.int32))["params"]
+    )(jax.random.PRNGKey(0))
+
+    cfg = GenerationConfig(
+        max_new_tokens=NEW_TOKENS, temperature=0.0, prompt_buckets=(PROMPT_LEN,)
+    )
+    rng = np.random.default_rng(0)
+    prompts = [
+        list(rng.integers(1, config.vocab_size, size=PROMPT_LEN)) for _ in range(max(CONCURRENCY))
+    ]
+
+    rates = {}
+    for n in CONCURRENCY:
+        batcher = ContinuousBatcher(
+            Generator(module, params, cfg), slots=max(CONCURRENCY), decode_chunk=8
+        )
+        try:
+            run_streams(batcher, prompts[:1])  # compile prefill/admit/decode
+            with Timer() as t:
+                tokens = run_streams(batcher, prompts[:n])
+            rates[n] = tokens / t.elapsed
+            log(
+                f"concurrency {n}: {tokens} tokens in {t.elapsed:.2f}s -> "
+                f"{rates[n]:.0f} tok/s aggregate ({batcher.decode_dispatches} dispatches, "
+                f"{batcher.decoded_rows / max(batcher.decode_dispatches, 1):.1f} rows/dispatch)"
+            )
+        finally:
+            batcher.close()
+
+    top = max(CONCURRENCY)
+    emit(
+        "continuous_batching_aggregate_decode",
+        rates[top],
+        "tokens/sec",
+        rates[top] / rates[1] if rates[1] > 0 else 0.0,
+        concurrency=top,
+        single_stream_tokens_per_s=round(rates[1], 1),
+    )
+
+
+if __name__ == "__main__":
+    main()

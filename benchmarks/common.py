@@ -22,6 +22,19 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def pin_platform() -> None:
+    """Honor an explicit JAX_PLATFORMS env var. The axon TPU plugin overrides
+    ``jax_platforms`` at import time (the env var alone loses); the config
+    update after import is what sticks. No-op when the var is unset — the
+    default platform (TPU when healthy) is the benchmark target."""
+    import os
+
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
 def emit(metric: str, value: float, unit: str, vs_baseline: float, **extras: Any) -> None:
     line: Dict[str, Any] = {
         "metric": metric,

@@ -24,6 +24,7 @@ SCRIPTS = {
     "serving_jit": "bench_serving_jit.py",
     "generate": "bench_generate.py",
     "speculative": "bench_speculative.py",
+    "continuous": "bench_continuous.py",
     "int8_matmul": "bench_int8_matmul.py",
     "kv_cache": "bench_kv_cache.py",
 }

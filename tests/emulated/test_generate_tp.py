@@ -114,3 +114,30 @@ def test_quantized_sharded_generation_matches_quantized_unsharded():
         module, params, cfg, mesh=mesh, partition_rules=llama_partition_rules(), quantize="int8"
     )
     np.testing.assert_array_equal(sharded(prompts), expected)
+
+
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_sp_prefix_cache_composes(impl):
+    """sp_prefill + prefix caching: the LONG shared prefix prefills
+    sequence-parallel (inside cache_prefix), per-request suffixes go through
+    the offset chunked path, and the emitted tokens equal the plain engine's
+    full-prompt run."""
+    import dataclasses
+
+    module, params = _tiny()
+    base = GenerationConfig(max_new_tokens=6, temperature=0.0, prompt_buckets=(8, 32))
+    prefix = [(i * 7) % 90 + 1 for i in range(24)]  # long enough to shard over 8
+    suffixes = [[3, 1, 4], [9, 2, 6, 5]]
+    expected = Generator(module, params, base)([prefix + s for s in suffixes])
+
+    mesh = MeshSpec(data=1, sequence=8 if impl == "ring" else 4, model=2 if impl == "ulysses" else 1).build()
+    sp_gen = Generator(
+        module,
+        params,
+        dataclasses.replace(base, sp_prefill=impl),
+        mesh=mesh,
+        partition_rules=llama_partition_rules() if impl == "ulysses" else None,
+    )
+    cached = sp_gen.cache_prefix(prefix)
+    assert cached.length == len(prefix)
+    np.testing.assert_array_equal(sp_gen(suffixes, prefix=cached), expected)

@@ -152,3 +152,50 @@ def test_moe_target_verifies_with_routed_experts():
     expected = Generator(module, params, cfg)(prompts)
     spec = SpeculativeGenerator(module, params, draft, dp, cfg, gamma=3)
     np.testing.assert_array_equal(spec(prompts), expected)
+
+
+def test_draft_spec_through_generator_facade():
+    """GenerationConfig(draft=DraftSpec(...)) routes the plain Generator façade
+    through speculative decoding — same greedy tokens, and stream() yields the
+    ragged speculative shape whose totals match __call__."""
+    from unionml_tpu.models import DraftSpec, Generator as Gen
+
+    target, tp = _model(0)
+    draft, dp = _model(7, n_layers=1, dim=32)
+    base = GenerationConfig(max_new_tokens=10, temperature=0.0, prompt_buckets=(16,))
+    expected = Generator(target, tp, base)(PROMPTS)
+
+    import dataclasses
+    cfg = dataclasses.replace(base, draft=DraftSpec(module=draft, params=dp, gamma=3))
+    gen = Gen(target, tp, cfg)
+    np.testing.assert_array_equal(gen(PROMPTS), expected)
+    assert gen._speculative().rounds >= 1
+
+    # streamed: ragged per-row chunks; concatenated totals equal __call__
+    chunks = list(gen.stream(PROMPTS, chunk_size=4))
+    totals = [np.concatenate([c[i] for c in chunks]) for i in range(len(PROMPTS))]
+    for i, row in enumerate(expected):
+        # stream stops emitting a row at its eos/budget; compare the emitted span
+        np.testing.assert_array_equal(totals[i], row[: len(totals[i])])
+        assert len(totals[i]) == base.max_new_tokens  # no eos configured: full budget
+
+
+def test_speculative_stream_matches_call_with_eos():
+    target, tp = _model(0)
+    draft, dp = _model(123, n_layers=1, dim=32)
+    free = Generator(
+        target, tp, GenerationConfig(max_new_tokens=12, temperature=0.0, prompt_buckets=(16,))
+    )(PROMPTS)
+    eos = int(free[0][2])
+    cfg = GenerationConfig(
+        max_new_tokens=12, temperature=0.0, prompt_buckets=(16,), eos_id=eos, pad_id=0
+    )
+    spec = SpeculativeGenerator(target, tp, draft, dp, cfg, gamma=4)
+    called = spec(PROMPTS)
+    chunks = list(spec.stream(PROMPTS, chunk_size=3))
+    for i in range(len(PROMPTS)):
+        total = np.concatenate([c[i] for c in chunks])
+        row = called[i]
+        hits = np.nonzero(row == eos)[0]
+        expected_row = row[: int(hits[0]) + 1] if hits.size else row
+        np.testing.assert_array_equal(total, expected_row)

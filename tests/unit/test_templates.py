@@ -91,6 +91,31 @@ def test_text_generation_template_trains_generates_and_serves(render, tmp_path):
     for i, prompt in enumerate(prompts):
         assert prompt + "".join(p[i] for p in pieces) == outputs[i]
 
+    # single-prompt streams ride the shared continuous-batching loop; two
+    # CONCURRENT streaming requests must each reassemble to their own
+    # non-streaming continuation (decode dispatches are shared, outputs exact)
+    async def consume_one(prompt):
+        status, payload, _ = await app.dispatch(
+            "POST", "/predict-stream", json.dumps({"features": [prompt]}).encode()
+        )
+        assert status == 200
+        parts = [json.loads(c.decode())[0] async for c in payload]
+        return "".join(parts)
+
+    async def concurrent():
+        return await asyncio.gather(*(consume_one(p) for p in prompts))
+
+    streamed = asyncio.run(concurrent())
+    assert [p + s for p, s in zip(prompts, streamed)] == outputs
+    batcher = module._continuous.get(id(module.model.artifact.model_object))
+    assert batcher is not None and batcher.decode_dispatches > 0
+
+    # speculative decoding through the Generator façade: greedy-exact vs the
+    # plain predictor (the half-depth draft changes speed, never tokens)
+    spec = module.speculative_generator(module.model.artifact.model_object)
+    spec_out = spec([module.encode(p) for p in prompts])
+    assert [p + module.decode(r) for p, r in zip(prompts, spec_out)] == outputs
+
 
 def test_serverless_template_trains_and_scores(render):
     render("basic-serverless")

@@ -2,6 +2,7 @@
 
 from unionml_tpu.models.bert import BertConfig, BertEncoder, bert_partition_rules, classification_loss  # noqa: F401
 from unionml_tpu.models.generate import (  # noqa: F401
+    DraftSpec,
     GenerationConfig,
     Generator,
     PrefixCache,

@@ -39,7 +39,29 @@ import jax.numpy as jnp
 
 from unionml_tpu._logging import logger
 
-__all__ = ["GenerationConfig", "Generator", "PrefixCache", "init_cache", "sample_tokens"]
+__all__ = [
+    "DraftSpec",
+    "GenerationConfig",
+    "Generator",
+    "PrefixCache",
+    "init_cache",
+    "sample_tokens",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class DraftSpec:
+    """A draft model for speculative decoding, attachable to
+    :attr:`GenerationConfig.draft`: the :class:`Generator` façade then routes
+    ``__call__``/``stream`` through a
+    :class:`~unionml_tpu.models.speculative.SpeculativeGenerator` — same output
+    law (greedy: token-exact; sampled: distribution-exact), fewer target
+    dispatches per token."""
+
+    module: Any
+    params: Any
+    gamma: int = 4
+    partition_rules: Optional[Any] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -74,6 +96,9 @@ class GenerationConfig:
     #: instead of living on one. Requires a mesh with a ``sequence`` axis;
     #: decode afterwards is the ordinary cached path.
     sp_prefill: Optional[str] = None
+    #: attach a :class:`DraftSpec` to decode speculatively through the same
+    #: Generator façade (excluded from equality/repr — it carries param trees)
+    draft: Optional["DraftSpec"] = dataclasses.field(default=None, compare=False, repr=False)
 
 
 def init_cache(config: Any, batch: int, cache_len: int, kv_dtype: Optional[str] = None) -> Tuple[Any, ...]:
@@ -326,6 +351,16 @@ class Generator:
         self._head_fn = head
         self._beam_fns: dict = {}
         self._sp_prefill_fn = None
+        self._spec_engine = None  # lazily built when config.draft is set
+
+    def _speculative(self):
+        """The internal speculative engine for ``config.draft`` — reuses THIS
+        generator (params already quantized/placed) as the verify target."""
+        if self._spec_engine is None:
+            from unionml_tpu.models.speculative import SpeculativeGenerator
+
+            self._spec_engine = SpeculativeGenerator.from_target(self, self.config.draft)
+        return self._spec_engine
 
     # ------------------------------------------------------------------ helpers
 
@@ -505,8 +540,11 @@ class Generator:
         )
         chunk = cfg.prefill_chunk
         if prefix is not None:
-            if sp:
-                raise NotImplementedError("sp_prefill does not compose with prefix caching yet")
+            # composition with sp_prefill: the LONG shared prefix was prefilled
+            # sequence-parallel inside cache_prefix (its _start call dispatches
+            # to the sp path); the short per-request suffix goes through the
+            # offset chunked path here — SP where length lives, cache reuse
+            # where repetition lives
             return self._start_with_prefix(prefix, tokens, lengths, batch, n, bucket, extra_cache, seed)
         if sp:
             seq = int(self.mesh.shape["sequence"])
@@ -620,7 +658,12 @@ class Generator:
         """Generate ``max_new_tokens`` per prompt; returns ``[len(prompts), max_new]``
         int32 (``pad_id`` after each example's ``eos_id``). With ``prefix`` (from
         :meth:`cache_prefix`), prompts are suffixes after the shared prefix and
-        only they are prefilled."""
+        only they are prefilled. With ``config.draft`` set, decoding runs
+        speculatively (same output law, fewer target dispatches)."""
+        if self.config.draft is not None:
+            if prefix is not None:
+                raise NotImplementedError("speculative decoding (config.draft) does not compose with prefix= yet")
+            return self._speculative()(prompts, seed=seed)
         n, tok0, _, carry = self._start(prompts, seed, prefix=prefix)
         steps = self.config.max_new_tokens - 1
         first = np.asarray(tok0)[:, None]
@@ -764,10 +807,17 @@ class Generator:
         prompt-sampled token). The decode compiles once per ``chunk_size``; when
         every row has emitted ``eos_id`` the stream ends early. Total tokens across
         yields equal ``__call__``'s output for the same seed. ``prefix`` works as
-        in :meth:`__call__`."""
+        in :meth:`__call__`. With ``config.draft`` set, streaming is speculative
+        and yields follow :meth:`SpeculativeGenerator.stream`'s RAGGED shape (a
+        list of per-row 1-D arrays) since rows advance at round granularity."""
         cfg = self.config
         if chunk_size < 1:
             raise ValueError("chunk_size must be >= 1")
+        if cfg.draft is not None:
+            if prefix is not None:
+                raise NotImplementedError("speculative decoding (config.draft) does not compose with prefix= yet")
+            yield from self._speculative().stream(prompts, seed=seed, chunk_size=chunk_size)
+            return
         # the last chunk may overshoot max_new_tokens; give its cache writes room
         n_chunks = max(0, -(-(cfg.max_new_tokens - 1) // chunk_size))
         extra = n_chunks * chunk_size - (cfg.max_new_tokens - 1)

@@ -84,20 +84,57 @@ class SpeculativeGenerator:
         partition_rules: Optional[Any] = None,
         quantize: Optional[str] = None,
     ):
+        import dataclasses
+
+        # strip any attached DraftSpec: the internal Generators must decode
+        # plainly (a draft-bearing config would recurse through the façade)
+        config = dataclasses.replace(config, draft=None)
+        # reuse the Generator machinery for prefill/placement/bucketing on both
+        # models; the draft runs unquantized (it is small by construction)
+        self._init_state(
+            Generator(
+                target_module, target_params, config,
+                mesh=mesh, partition_rules=partition_rules, quantize=quantize,
+            ),
+            Generator(draft_module, draft_params, config, mesh=mesh, partition_rules=partition_rules),
+            config,
+            gamma,
+        )
+
+    def _init_state(self, target: Generator, draft: Generator, config: GenerationConfig, gamma: int) -> None:
+        """The single construction body shared by ``__init__`` and
+        :meth:`from_target` — any new field must be set here, so the two paths
+        cannot drift."""
         if gamma < 1:
             raise ValueError("gamma must be >= 1")
         self.config = config
-        self.gamma = gamma
+        self.gamma = int(gamma)
         self.rounds = 0
         self.accepted_tokens = 0
-        # reuse the Generator machinery for prefill/placement/bucketing on both
-        # models; the draft runs unquantized (it is small by construction)
-        self._target = Generator(
-            target_module, target_params, config,
-            mesh=mesh, partition_rules=partition_rules, quantize=quantize,
-        )
-        self._draft = Generator(draft_module, draft_params, config, mesh=mesh, partition_rules=partition_rules)
+        self._target = target
+        self._draft = draft
         self._round_fn = None
+
+    @classmethod
+    def from_target(cls, target: Generator, draft: "Any") -> "SpeculativeGenerator":
+        """Build around an EXISTING target :class:`Generator` (whose params are
+        already quantized/sharded/placed) and a
+        :class:`~unionml_tpu.models.generate.DraftSpec` — the path behind
+        ``GenerationConfig(draft=...)`` on the Generator façade."""
+        import dataclasses
+
+        self = cls.__new__(cls)
+        config = dataclasses.replace(target.config, draft=None)
+        self._init_state(
+            target,
+            Generator(
+                draft.module, draft.params, config,
+                mesh=target.mesh, partition_rules=draft.partition_rules,
+            ),
+            config,
+            draft.gamma,
+        )
+        return self
 
     # ------------------------------------------------------------------ round
 
@@ -227,15 +264,20 @@ class SpeculativeGenerator:
             acc_count = jnp.where(done, 0, jnp.minimum(accepted, room)).sum()
             return t_cache, d_cache, tok, lengths, new_done, produced, out_buf, acc_count, key
 
-        def spec_loop(tp, dp, t_cache, d_cache, tok, lengths, done, produced, out_buf, key):
-            """The full post-prefill generation as ONE device-side while_loop —
-            per-round host round trips through a remote-TPU tunnel would otherwise
-            dominate the round cost (measured ~20x the compute)."""
+        def spec_loop(tp, dp, state, floor):
+            """Post-prefill generation as ONE device-side while_loop — per-round
+            host round trips through a remote-TPU tunnel would otherwise dominate
+            the round cost (measured ~20x the compute). ``floor``: keep rolling
+            rounds while any unfinished row has produced fewer than ``floor``
+            tokens — ``__call__`` passes max_new_tokens (run to completion),
+            :meth:`stream` passes increasing floors to surface tokens in chunks
+            without leaving the device more than once per chunk."""
             tp = target._dequant_params(tp)
             dp = draft._dequant_params(dp)
 
             def cond(state):
-                return jnp.any(~state[4])
+                done_rows, produced_rows = state[4], state[5]
+                return jnp.any(~done_rows & (produced_rows < floor))
 
             def body(state):
                 t_cache, d_cache, tok, lengths, done, produced, out_buf, rounds, acc_total, key = state
@@ -244,23 +286,21 @@ class SpeculativeGenerator:
                 )
                 return (t_cache, d_cache, tok, lengths, done, produced, out_buf, rounds + 1, acc_total + acc, key)
 
-            state = (t_cache, d_cache, tok, lengths, done, produced, out_buf, jnp.int32(0), jnp.int32(0), key)
-            state = jax.lax.while_loop(cond, body, state)
-            # final caches ride along (and are dropped by the caller) so the
-            # donated inputs have outputs to alias with
-            return state[6], state[7], state[8], state[0], state[1]
+            return jax.lax.while_loop(cond, body, state)
 
-        return jax.jit(spec_loop, donate_argnums=(2, 3))
+        # the whole state (caches, out_buf, counters) is donated and re-aliased
+        # by the returned state, so repeated stream dispatches keep ONE copy in HBM
+        return jax.jit(spec_loop, donate_argnums=(2,))
 
     # ------------------------------------------------------------------ generate
 
-    def __call__(self, prompts: Sequence[Sequence[int]], *, seed: int = 0) -> np.ndarray:
-        """Generate under the config's decoding policy; greedy output is exactly
-        the target-only sequence, sampled output is target-distributed."""
+    def _start_state(self, prompts: Sequence[Sequence[int]], seed: int):
+        """Prefill both models and assemble the device-side loop state:
+        ``(t_cache, d_cache, tok, lengths, done, produced, out_buf, rounds,
+        accepted, key)``."""
         cfg = self.config
         if self._round_fn is None:
             self._round_fn = self._build_round()
-
         # prefill both models; extra cache headroom for the last round's overshoot
         n, tok0_t, _, (t_cache, _, lengths, done_t, _) = self._target._start(
             prompts, seed, extra_cache=self.gamma + 1
@@ -275,13 +315,59 @@ class SpeculativeGenerator:
         out_buf = out_buf.at[:, 0].set(tok0_t)
         produced = jnp.ones((batch,), jnp.int32)
         done = done_t | (produced >= cfg.max_new_tokens)
-        tok = tok0_t
-
-        out_buf, rounds, accepted, _, _ = self._round_fn(
-            self._target.params, self._draft.params,
-            t_cache, d_cache, tok, lengths, done, produced, out_buf,
-            jax.random.fold_in(jax.random.PRNGKey(seed), 1),
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), 1)
+        return n, (
+            t_cache, d_cache, tok0_t, lengths, done, produced, out_buf,
+            jnp.int32(0), jnp.int32(0), key,
         )
+
+    def __call__(self, prompts: Sequence[Sequence[int]], *, seed: int = 0) -> np.ndarray:
+        """Generate under the config's decoding policy; greedy output is exactly
+        the target-only sequence, sampled output is target-distributed."""
+        cfg = self.config
+        n, state = self._start_state(prompts, seed)
+        state = self._round_fn(
+            self._target.params, self._draft.params, state, jnp.int32(cfg.max_new_tokens)
+        )
+        out_buf, rounds, accepted = state[6], state[7], state[8]
         self.rounds += int(rounds)
         self.accepted_tokens += int(accepted)
         return np.asarray(out_buf)[:n, : cfg.max_new_tokens]
+
+    def stream(self, prompts: Sequence[Sequence[int]], *, seed: int = 0, chunk_size: int = 16):
+        """Incremental speculative generation: yields a LIST of ``len(prompts)``
+        1-D int32 arrays of newly materialized tokens per row (the first yield is
+        each row's prompt-sampled token). Rows advance at round granularity
+        (1..gamma+1 tokens per round), so per-yield chunks are RAGGED — unlike
+        :meth:`Generator.stream`'s rectangular arrays. Token totals equal
+        ``__call__`` for the same seed; each dispatch rolls rounds until every
+        unfinished row has at least ``chunk_size`` more tokens, so streaming
+        leaves the device once per chunk, not per round."""
+        cfg = self.config
+        if chunk_size < 1:
+            raise ValueError("chunk_size must be >= 1")
+        n, state = self._start_state(prompts, seed)
+        prev = np.ones((n,), np.int64)
+        first = np.asarray(state[6][:n, :1])  # one fetch, not one per row
+        yield [first[i] for i in range(n)]
+        floor = 1
+        rounds = accepted = 0  # snapshots from the LAST SUCCESSFUL dispatch: the
+        # in-flight state's buffers are donated, so reading it after a failed
+        # dispatch would raise a secondary deleted-buffer error masking the cause
+        try:
+            while True:
+                done_np = np.asarray(state[4])[:n]
+                if bool(done_np.all()):
+                    return
+                floor = min(floor + chunk_size, cfg.max_new_tokens)
+                state = self._round_fn(
+                    self._target.params, self._draft.params, state, jnp.int32(floor)
+                )
+                out_np = np.asarray(state[6])
+                prod_np = np.asarray(state[5])[:n]
+                rounds, accepted = int(state[7]), int(state[8])
+                yield [out_np[i, prev[i] : prod_np[i]] for i in range(n)]
+                prev = prod_np.astype(np.int64)
+        finally:
+            self.rounds += rounds
+            self.accepted_tokens += accepted

@@ -147,12 +147,71 @@ def predictor(state: train_state.TrainState, features: List[str]) -> List[str]:
     return [p + decode(row) for p, row in zip(features, out)]
 
 
+import threading
+
+_continuous: dict = {}
+_continuous_lock = threading.Lock()
+
+
+def _continuous_for(state: train_state.TrainState):
+    """A shared ContinuousBatcher: concurrent /predict-stream requests join the
+    same fixed-slot decode loop (one device dispatch advances every resident
+    stream) instead of queueing behind each other. The lock makes concurrent
+    first requests create ONE engine (a duplicate would leak a live thread and
+    cache pool); a batcher for a replaced state drains its in-flight streams in
+    the background before stopping."""
+    from unionml_tpu.serving import ContinuousBatcher
+
+    with _continuous_lock:
+        batcher = _continuous.get(id(state))
+        if batcher is None:
+            for stale in _continuous.values():
+                stale.close(wait=False)  # graceful: residents finish, no new joins
+            _continuous.clear()
+            batcher = ContinuousBatcher(_generator_for(state), slots=4, decode_chunk=8)
+            _continuous[id(state)] = batcher
+        return batcher
+
+
 @model.stream_predictor
 def stream_predictor(state: train_state.TrainState, features: List[str]):
     """POST /predict-stream: yields per-prompt text pieces as they decode —
-    concatenating a prompt's pieces reproduces the /predict continuation."""
-    for chunk in _generator_for(state).stream(_encode_prompts(features), chunk_size=8):
+    concatenating a prompt's pieces reproduces the /predict continuation.
+    Single-prompt requests (the typical streaming call) ride the shared
+    continuous-batching loop; multi-prompt requests stream as one batch."""
+    prompts = _encode_prompts(features)
+    if len(prompts) == 1:
+        for chunk in _continuous_for(state).submit(prompts[0]):
+            yield [decode(chunk)]
+        return
+    for chunk in _generator_for(state).stream(prompts, chunk_size=8):
         yield [decode(row) for row in chunk]
+
+
+# --- speculative decoding: a half-depth draft proposes, the full model verifies.
+# Greedy output is token-for-token identical to plain decoding (the draft can
+# only change speed, never tokens) — the template test pins that oracle.
+import dataclasses
+
+draft_config = dataclasses.replace(config, n_layers=1)
+draft_module = Llama(draft_config)
+
+
+def speculative_generator(state: train_state.TrainState, draft_params=None, gamma: int = 4) -> Generator:
+    """The Generator façade with a DraftSpec attached. Pass trained
+    ``draft_params`` (e.g. a distilled copy) for real speedups; an untrained
+    draft still produces exact greedy tokens, just with low acceptance."""
+    from unionml_tpu.models import DraftSpec
+
+    if draft_params is None:
+        draft_params = draft_module.init(
+            jax.random.PRNGKey(1), jnp.zeros((1, SEQ_LEN), jnp.int32)
+        )["params"]
+    cfg = GenerationConfig(
+        max_new_tokens=NEW_TOKENS, temperature=0.0, prompt_buckets=(SEQ_LEN,),
+        draft=DraftSpec(module=draft_module, params=draft_params, gamma=gamma),
+    )
+    return Generator(module, state.params, cfg)
 
 
 if __name__ == "__main__":

@@ -1,4 +1,13 @@
-from app import CHARS, NEW_TOKENS, model, reader
+from app import (
+    CHARS,
+    NEW_TOKENS,
+    decode,
+    encode,
+    model,
+    reader,
+    speculative_generator,
+    stream_predictor,
+)
 
 
 def test_train_and_generate():
@@ -16,3 +25,15 @@ def test_train_and_generate():
 
     # greedy decoding is deterministic
     assert model.predict(features=prompts) == outputs
+
+    # single-prompt streaming rides the shared continuous-batching loop and
+    # reassembles to the same continuation
+    state = model.artifact.model_object
+    pieces = [chunk[0] for chunk in stream_predictor(state, [prompts[0]])]
+    assert prompts[0] + "".join(pieces) == outputs[0]
+
+    # speculative decoding (half-depth draft through the Generator façade) is
+    # greedy-EXACT: the draft can change speed, never tokens
+    spec = speculative_generator(state)
+    spec_out = spec([encode(p) for p in prompts])
+    assert [p + decode(row) for p, row in zip(prompts, spec_out)] == outputs
