@@ -1,0 +1,24 @@
+# Base image for unionml-tpu apps on TPU VMs / GKE (reference analog: root
+# Dockerfile:1 — the image its docker_build_push ships per app). App deploys
+# normally build FROM the deployed bundle via unionml_tpu/container.py; this
+# file builds the framework itself, for baking a TPU-VM image or a GKE base
+# layer that app images can start FROM.
+
+FROM python:3.12-slim
+
+WORKDIR /srv/unionml-tpu
+ENV PYTHONPATH=/srv/unionml-tpu
+ENV PIP_NO_CACHE_DIR=1
+
+# TPU jax wheel (libtpu via the Google releases index); CPU fallback works too
+RUN pip install "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html || \
+    pip install jax
+
+COPY pyproject.toml ./
+COPY unionml_tpu ./unionml_tpu
+RUN pip install .
+
+# serving by default; override the entrypoint for training workers
+# (python -m unionml_tpu.job_runner, env-driven — see unionml_tpu/launcher.py)
+ENTRYPOINT ["python", "-m", "unionml_tpu.cli"]
+CMD ["--help"]

@@ -207,8 +207,18 @@ def _page_title(source: str, fallback: str) -> str:
 
 
 def build_site(out_dir: Path) -> List[Path]:
+    try:
+        # regenerate the docstring-derived reference pages so they never go stale
+        from docs import gen_api  # type: ignore[import-not-found]
+    except ImportError:
+        import gen_api  # running from inside docs/
+
+    gen_api.main()
     pages = sorted(DOCS_DIR.glob("*.md")) + sorted((DOCS_DIR / "tutorials").glob("*.md"))
-    nav_order = ["index", "quickstart", "dataset", "model", "tpu-training", "parallelism", "serving", "remote", "benchmarks"]
+    nav_order = [
+        "index", "quickstart", "dataset", "model", "tpu-training", "parallelism",
+        "generation", "serving", "remote", "benchmarks", "api-reference", "cli-reference",
+    ]
     pages.sort(key=lambda p: nav_order.index(p.stem) if p.stem in nav_order else len(nav_order))
 
     nav_links = []

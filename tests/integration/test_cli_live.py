@@ -1,0 +1,59 @@
+"""CLI-booted live server: the analog of the reference's subprocess-serve test
+(/root/reference/tests/integration/test_fastapi.py:13-26) — ``unionml-tpu serve``
+runs as a real subprocess and is polled over real HTTP."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+
+def test_serve_workers_flag_boots_multiprocess_server(cli_project, tmp_path):
+    """--workers 2: the port is shared via SO_REUSEPORT and requests succeed
+    (reference serve clones uvicorn's full CLI incl. --workers, cli.py:172-205)."""
+    import cli_app
+
+    cli_app.model.train(hyperparameters={"max_iter": 500})
+    model_file = cli_project / "model.joblib"
+    cli_app.model.save(str(model_file))
+
+    with socket.socket() as probe:
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+
+    env = dict(os.environ)
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "unionml_tpu.cli", "serve", "cli_app:model",
+            "--model-path", str(model_file), "--port", str(port),
+            "--workers", "2", "--log-level", "info",
+        ],
+        cwd=cli_project,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+    )
+    try:
+        base = f"http://127.0.0.1:{port}"
+        for _ in range(150):
+            try:
+                with urllib.request.urlopen(base + "/health", timeout=1):
+                    break
+            except Exception:
+                time.sleep(0.2)
+        else:
+            raise AssertionError("server did not come up")
+        body = json.dumps({"features": [{"x0": 1.0, "x1": 2.0}]}).encode()
+        for _ in range(4):  # several requests; kernel may spread them over workers
+            req = urllib.request.Request(
+                base + "/predict", data=body, headers={"Content-Type": "application/json"}
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert resp.status == 200
+                assert len(json.loads(resp.read())) == 1
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)

@@ -1,0 +1,141 @@
+"""Per-app container images through a shim ``docker`` on PATH.
+
+Same pattern as the shim-gcloud launcher ring: the REAL
+``unionml_tpu.container`` CLI shell-outs execute against a fake binary that logs
+its argv, so deploy-time image semantics (reference remote.py:60-108 parity:
+registry-gated build+push, patch skips image work, bundle-as-context, generated
+default Dockerfile, failure propagation) are pinned without a docker daemon.
+"""
+
+import json
+import os
+import subprocess
+import textwrap
+
+import pytest
+
+from tests.unit.test_remote import APP_SOURCE
+
+_SHIM = textwrap.dedent(
+    """\
+    #!/usr/bin/env bash
+    echo "$*" >> "$DOCKER_SHIM_LOG"
+    verb="$1"
+    if [ "$verb" = "build" ] && [ -n "$DOCKER_FAIL_BUILD" ]; then
+      echo "ERROR: build failed" >&2; exit 1
+    fi
+    if [ "$verb" = "push" ] && [ -n "$DOCKER_FAIL_PUSH" ]; then
+      echo "ERROR: denied" >&2; exit 1
+    fi
+    exit 0
+    """
+)
+
+
+@pytest.fixture
+def docker_env(tmp_path, monkeypatch):
+    bin_dir = tmp_path / "shimbin"
+    bin_dir.mkdir()
+    shim = bin_dir / "docker"
+    shim.write_text(_SHIM)
+    shim.chmod(0o755)
+    log = tmp_path / "docker_calls.log"
+    log.write_text("")
+    monkeypatch.setenv("PATH", f"{bin_dir}{os.pathsep}{os.environ['PATH']}")
+    monkeypatch.setenv("DOCKER_SHIM_LOG", str(log))
+    for var in ("DOCKER_FAIL_BUILD", "DOCKER_FAIL_PUSH"):
+        monkeypatch.delenv(var, raising=False)
+
+    def calls(verb=None):
+        lines = [ln for ln in log.read_text().splitlines() if ln]
+        return lines if verb is None else [ln for ln in lines if ln.split()[0] == verb]
+
+    return calls
+
+
+@pytest.fixture
+def docker_app(tmp_path, monkeypatch):
+    app_dir = tmp_path / "appsrc"
+    app_dir.mkdir()
+    (app_dir / "remote_app.py").write_text(APP_SOURCE)
+    monkeypatch.syspath_prepend(str(app_dir))
+    monkeypatch.chdir(app_dir)
+    import importlib
+
+    import remote_app
+
+    importlib.reload(remote_app)
+    return remote_app
+
+
+def test_image_fqn_parity():
+    from unionml_tpu.container import image_fqn
+
+    # reference convention (remote.py:60-66): registry/name:model-version, _ -> -
+    assert image_fqn("my_model", "abc123", registry="gcr.io/p") == "gcr.io/p/unionml-tpu:my-model-abc123"
+    assert image_fqn("m", "v1", registry="r", image_name="custom") == "r/custom:m-v1"
+    assert image_fqn("m", "v1") == "unionml-tpu:m-v1"
+
+
+def test_registry_deploy_builds_and_pushes_from_bundle(docker_env, docker_app, tmp_path):
+    model = docker_app.model
+    model.remote(backend_store=str(tmp_path / "store"), registry="gcr.io/proj")
+    version = model.remote_deploy(app_version="img-v1")
+
+    builds, pushes = docker_env("build"), docker_env("push")
+    assert len(builds) == 1 and len(pushes) == 1
+    fqn = "gcr.io/proj/unionml-tpu:remote-model-img-v1"
+    assert fqn in builds[0] and fqn in pushes[0]
+    # build context is the deployed BUNDLE, not the working tree
+    bundle = tmp_path / "store" / "unionml-tpu" / "development" / "apps" / "remote_model" / version / "bundle"
+    assert builds[0].split()[1] == str(bundle)
+    # the app shipped no Dockerfile: the default TPU-VM one was generated into the bundle
+    assert (bundle / "Dockerfile").exists()
+    assert "jax[tpu]" in (bundle / "Dockerfile").read_text()
+    manifest = json.loads((bundle.parent / "manifest.json").read_text())
+    assert manifest["image"] == fqn
+
+
+def test_patch_deploy_skips_image_work(docker_env, docker_app, tmp_path):
+    """Reference parity: patch (fast) registration re-ships source only
+    (model.py:700-701) — no build, no push."""
+    model = docker_app.model
+    model.remote(backend_store=str(tmp_path / "store"), registry="gcr.io/proj")
+    model.remote_deploy(app_version="img-v2")
+    assert len(docker_env("build")) == 1
+
+    model.remote_deploy(app_version="img-v2b", patch=True)
+    assert len(docker_env("build")) == 1  # unchanged
+    assert len(docker_env("push")) == 1
+
+
+def test_no_registry_means_no_image(docker_env, docker_app, tmp_path):
+    model = docker_app.model
+    model.remote(backend_store=str(tmp_path / "store"))
+    version = model.remote_deploy(app_version="img-v3")
+    assert docker_env() == []
+    store = tmp_path / "store" / "unionml-tpu" / "development"
+    manifest = json.loads((store / "apps" / "remote_model" / version / "manifest.json").read_text())
+    assert manifest["image"] is None
+
+
+def test_build_failure_fails_deploy_before_registration(docker_env, docker_app, tmp_path, monkeypatch):
+    monkeypatch.setenv("DOCKER_FAIL_BUILD", "1")
+    model = docker_app.model
+    model.remote(backend_store=str(tmp_path / "store"), registry="gcr.io/proj")
+    with pytest.raises(RuntimeError, match="docker build"):
+        model.remote_deploy(app_version="img-v4")
+    # the app version is NOT registered: no manifest, so latest_app_version skips it
+    manifest = tmp_path / "store" / "unionml-tpu" / "development" / "apps" / "remote_model" / "img-v4" / "manifest.json"
+    assert not manifest.exists()
+    assert docker_env("push") == []
+
+
+def test_app_dockerfile_is_respected(docker_env, docker_app, tmp_path, monkeypatch):
+    (tmp_path / "appsrc" / "Dockerfile").write_text("FROM scratch\n# custom\n")
+    # commit state doesn't matter: explicit app_version skips the git probe
+    model = docker_app.model
+    model.remote(backend_store=str(tmp_path / "store"), registry="r")
+    version = model.remote_deploy(app_version="img-v5")
+    bundle = tmp_path / "store" / "unionml-tpu" / "development" / "apps" / "remote_model" / version / "bundle"
+    assert (bundle / "Dockerfile").read_text() == "FROM scratch\n# custom\n"

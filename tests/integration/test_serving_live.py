@@ -1,0 +1,134 @@
+"""Live-server serving tests: a real socket, real HTTP framing.
+
+The integration ring's analog of the reference's ``tests/integration/test_fastapi.py``
+(boots ``unionml serve`` as a subprocess and polls it over HTTP, :13-26): these boot
+the stdlib server on an ephemeral port in a daemon thread and speak raw HTTP to pin
+the wire contracts — chunked transfer for streaming, HTTP/1.0 close-delimited
+fallback, and keep-alive connection reuse. In-process route/dispatch tests stay in
+tests/unit/test_serving.py.
+"""
+
+import json
+import socket
+import threading
+import time
+
+from unionml_tpu.serving import serving_app
+
+
+def _boot(app):
+    """Run the app on an ephemeral port in a daemon thread; returns (host, port).
+
+    Daemon thread: asyncio.run(serve_forever) has no cross-thread stop; it dies
+    with the test process, and nothing else in the session targets the port.
+    """
+    host = "127.0.0.1"
+    with socket.socket() as probe_sock:  # ephemeral port: parallel runs can't collide
+        probe_sock.bind((host, 0))
+        port = probe_sock.getsockname()[1]
+    threading.Thread(target=lambda: app.run(host=host, port=port), daemon=True).start()
+    for _ in range(100):
+        try:
+            socket.create_connection((host, port), timeout=1).close()
+            break
+        except OSError:
+            time.sleep(0.05)
+    return host, port
+
+
+def test_predict_stream_chunked_over_socket(sklearn_model):
+    """The streaming route over a real socket: chunked transfer encoding, one
+    ND-JSON line per yielded item, arriving as separate HTTP chunks."""
+    sklearn_model.train(hyperparameters={"max_iter": 500})
+
+    @sklearn_model.stream_predictor
+    def stream_predictor(model_object, features):
+        for i in range(3):
+            yield {"piece": i, "rows": len(features)}
+
+    app = serving_app(sklearn_model)
+    host, port = _boot(app)
+
+    body = json.dumps({"features": [{"x": 1.0}]}).encode()
+    request = (
+        f"POST /predict-stream HTTP/1.1\r\nHost: x\r\nConnection: close\r\n"
+        f"Content-Length: {len(body)}\r\n\r\n"
+    ).encode() + body
+    with socket.create_connection((host, port), timeout=10) as sock:
+        sock.sendall(request)
+        raw = b""
+        while True:
+            data = sock.recv(65536)
+            if not data:
+                break
+            raw += data
+    headers, _, chunked = raw.partition(b"\r\n\r\n")
+    assert b"Transfer-Encoding: chunked" in headers
+    assert b"application/x-ndjson" in headers
+    # de-chunk
+    payload = b""
+    rest = chunked
+    while rest:
+        size_line, _, rest = rest.partition(b"\r\n")
+        size = int(size_line, 16)
+        if size == 0:
+            break
+        payload, rest = payload + rest[:size], rest[size + 2 :]
+    lines = [json.loads(line) for line in payload.decode().strip().split("\n")]
+    assert lines == [{"piece": i, "rows": 1} for i in range(3)]
+
+
+def test_predict_stream_http10_gets_unframed_body(sklearn_model):
+    """HTTP/1.0 peers cannot parse chunked framing: they get raw ND-JSON bytes
+    delimited by connection close."""
+    sklearn_model.train(hyperparameters={"max_iter": 500})
+
+    @sklearn_model.stream_predictor
+    def stream_predictor(model_object, features):
+        yield {"n": 1}
+        yield {"n": 2}
+
+    app = serving_app(sklearn_model)
+    host, port = _boot(app)
+
+    body = json.dumps({"features": [{"x": 1.0}]}).encode()
+    request = (
+        f"POST /predict-stream HTTP/1.0\r\nHost: x\r\nContent-Length: {len(body)}\r\n\r\n"
+    ).encode() + body
+    with socket.create_connection((host, port), timeout=10) as sock:
+        sock.sendall(request)
+        raw = b""
+        while True:
+            data = sock.recv(65536)
+            if not data:
+                break  # close-delimited
+            raw += data
+    headers, _, stream_body = raw.partition(b"\r\n\r\n")
+    assert b"Transfer-Encoding" not in headers
+    assert b"Connection: close" in headers
+    lines = [json.loads(line) for line in stream_body.decode().strip().split("\n")]
+    assert lines == [{"n": 1}, {"n": 2}]
+
+
+def test_http_keep_alive_serves_multiple_requests_per_connection(sklearn_model):
+    sklearn_model.train(hyperparameters={"max_iter": 500})
+    app = serving_app(sklearn_model)
+    host, port = _boot(app)
+
+    def http_get(sock, path):
+        sock.sendall(f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+        head = b""
+        while b"\r\n\r\n" not in head:
+            head += sock.recv(4096)
+        headers, _, rest = head.partition(b"\r\n\r\n")
+        length = int([line for line in headers.split(b"\r\n") if b"content-length" in line.lower()][0].split(b":")[1])
+        while len(rest) < length:
+            rest += sock.recv(4096)
+        return headers, rest
+
+    # two requests down ONE connection: the first response must be keep-alive
+    with socket.create_connection((host, port), timeout=5) as sock:
+        headers1, _ = http_get(sock, "/health")
+        assert b"Connection: keep-alive" in headers1
+        headers2, body2 = http_get(sock, "/metrics")
+        assert b"200 OK" in headers2.split(b"\r\n")[0]

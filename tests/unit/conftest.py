@@ -1,53 +1,7 @@
-"""Shared fixtures: in-memory readers + an sklearn digits-style app.
+"""Unit-ring conftest.
 
-Mirrors the reference fixture architecture (tests/unit/{dataset_fixtures,
-model_fixtures}.py): a 100-row synthetic DataFrame, a LogisticRegression
-trainer/predictor/evaluator, and no mocking of the execution substrate — local graphs
-run the real engine in-process.
+The shared app fixtures (synthetic frame + sklearn LogisticRegression app,
+``cli_project``) live in tests/conftest.py so the integration ring reuses them —
+the analog of the reference's fixture re-export conftest
+(/root/reference/tests/unit/conftest.py:1-7).
 """
-
-from typing import List
-
-import numpy as np
-import pandas as pd
-import pytest
-
-from unionml_tpu import Dataset, Model
-
-N_SAMPLES = 100
-TEST_SIZE = 0.2
-
-
-@pytest.fixture
-def simple_dataset() -> Dataset:
-    dataset = Dataset(name="test_dataset", targets=["y"], test_size=TEST_SIZE)
-
-    @dataset.reader
-    def reader(sample_frac: float = 1.0, random_state: int = 42) -> pd.DataFrame:
-        rng = np.random.default_rng(17)
-        frame = pd.DataFrame({"x1": rng.normal(size=N_SAMPLES), "x2": rng.normal(size=N_SAMPLES)})
-        frame["y"] = (frame["x1"] + frame["x2"] > 0).astype(int)
-        return frame.sample(frac=sample_frac, random_state=random_state)
-
-    return dataset
-
-
-@pytest.fixture
-def sklearn_model(simple_dataset: Dataset) -> Model:
-    from sklearn.linear_model import LogisticRegression
-
-    model = Model(name="test_model", init=LogisticRegression, dataset=simple_dataset)
-
-    @model.trainer
-    def trainer(estimator: LogisticRegression, features: pd.DataFrame, target: pd.DataFrame) -> LogisticRegression:
-        return estimator.fit(features, target.squeeze())
-
-    @model.predictor
-    def predictor(estimator: LogisticRegression, features: pd.DataFrame) -> List[float]:
-        return [float(x) for x in estimator.predict(features)]
-
-    @model.evaluator
-    def evaluator(estimator: LogisticRegression, features: pd.DataFrame, target: pd.DataFrame) -> float:
-        return float(estimator.score(features, target.squeeze()))
-
-    return model

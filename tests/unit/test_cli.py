@@ -3,10 +3,6 @@ init renders a project, deploy/train/predict/list-model-versions/fetch-model run
 remote path end-to-end against a temp backend store, and serve guards its env var."""
 
 import json
-import os
-import subprocess
-import sys
-import textwrap
 from pathlib import Path
 
 import pytest
@@ -14,60 +10,6 @@ from click.testing import CliRunner
 
 from unionml_tpu.cli import app
 from unionml_tpu.templating import list_templates, render_template, validate_app_name
-
-REPO_ROOT = Path(__file__).resolve().parents[2]
-
-APP_SOURCE = textwrap.dedent(
-    """
-    from typing import List
-
-    import pandas as pd
-    from sklearn.linear_model import LogisticRegression
-
-    from unionml_tpu import Dataset, Model
-
-    dataset = Dataset(name="ds", test_size=0.2, shuffle=True, targets=["y"])
-    model = Model(name="cli_test_model", init=LogisticRegression, dataset=dataset)
-    model.__app_module__ = "cli_app:model"
-
-
-    @dataset.reader
-    def reader(n: int = 60) -> pd.DataFrame:
-        rows = []
-        for i in range(n):
-            rows.append({"x0": float(i % 7), "x1": float((i * 3) % 5), "y": i % 2})
-        return pd.DataFrame(rows)
-
-
-    @model.trainer
-    def trainer(est: LogisticRegression, features: pd.DataFrame, target: pd.DataFrame) -> LogisticRegression:
-        return est.fit(features, target.squeeze())
-
-
-    @model.predictor
-    def predictor(est: LogisticRegression, features: pd.DataFrame) -> List[float]:
-        return [float(v) for v in est.predict(features)]
-    """
-)
-
-
-@pytest.fixture()
-def cli_project(tmp_path, monkeypatch):
-    """A committed git project containing a unionml-tpu app + an isolated backend store."""
-    (tmp_path / "cli_app.py").write_text(APP_SOURCE)
-    subprocess.run(["git", "init", "-q"], cwd=tmp_path, check=True)
-    subprocess.run(["git", "add", "."], cwd=tmp_path, check=True)
-    subprocess.run(
-        ["git", "-c", "user.email=t@t", "-c", "user.name=t", "commit", "-q", "-m", "init"],
-        cwd=tmp_path,
-        check=True,
-    )
-    monkeypatch.setenv("UNIONML_TPU_STORE", str(tmp_path / "store"))
-    monkeypatch.setenv("PYTHONPATH", os.pathsep.join([str(tmp_path), str(REPO_ROOT)]))
-    monkeypatch.chdir(tmp_path)
-    monkeypatch.syspath_prepend(str(tmp_path))
-    yield tmp_path
-    sys.modules.pop("cli_app", None)
 
 
 def test_templating_list_and_validate():
@@ -143,59 +85,6 @@ def test_serve_requires_existing_model_path(cli_project):
     result = CliRunner().invoke(app, ["serve", "cli_app:model", "--model-path", "/does/not/exist"])
     assert result.exit_code != 0
     assert "does not exist" in result.output
-
-
-def test_serve_workers_flag_boots_multiprocess_server(cli_project, tmp_path):
-    """--workers 2: the port is shared via SO_REUSEPORT and requests succeed
-    (reference serve clones uvicorn's full CLI incl. --workers, cli.py:172-205)."""
-    import json as _json
-    import socket
-    import time
-    import urllib.request
-
-    import cli_app
-
-    cli_app.model.train(hyperparameters={"max_iter": 500})
-    model_file = cli_project / "model.joblib"
-    cli_app.model.save(str(model_file))
-
-    with socket.socket() as probe:
-        probe.bind(("127.0.0.1", 0))
-        port = probe.getsockname()[1]
-
-    env = dict(os.environ)
-    proc = subprocess.Popen(
-        [
-            sys.executable, "-m", "unionml_tpu.cli", "serve", "cli_app:model",
-            "--model-path", str(model_file), "--port", str(port),
-            "--workers", "2", "--log-level", "info",
-        ],
-        cwd=cli_project,
-        env=env,
-        stdout=subprocess.PIPE,
-        stderr=subprocess.STDOUT,
-    )
-    try:
-        base = f"http://127.0.0.1:{port}"
-        for _ in range(150):
-            try:
-                with urllib.request.urlopen(base + "/health", timeout=1):
-                    break
-            except Exception:
-                time.sleep(0.2)
-        else:
-            raise AssertionError("server did not come up")
-        body = _json.dumps({"features": [{"x0": 1.0, "x1": 2.0}]}).encode()
-        for _ in range(4):  # several requests; kernel may spread them over workers
-            req = urllib.request.Request(
-                base + "/predict", data=body, headers={"Content-Type": "application/json"}
-            )
-            with urllib.request.urlopen(req, timeout=10) as resp:
-                assert resp.status == 200
-                assert len(_json.loads(resp.read())) == 1
-    finally:
-        proc.terminate()
-        proc.wait(timeout=10)
 
 
 def test_app_source_files_snapshot(cli_project):

@@ -205,3 +205,27 @@ def test_close_drains_residents_and_rejects_new(tiny_gen):
     ]
     assert results == expected  # residents drained to completion, not truncated
     batcher.close()  # idempotent
+
+
+def test_per_request_budget_and_int8_kv(tiny_gen):
+    """Composition: per-request max_new_tokens caps below the config budget
+    (the truncated stream is a prefix of the full one), and the int8 KV cache
+    flows through admission/decode (quantized rows paste + stream)."""
+    module, params = tiny_gen
+    cfg = GenerationConfig(
+        max_new_tokens=10, temperature=0.0, prompt_buckets=(16,), kv_cache_dtype="int8"
+    )
+    expected = _sequential_expected(module, params, cfg, PROMPTS[:2])
+
+    batcher = ContinuousBatcher(Generator(module, params, cfg), slots=2, decode_chunk=3)
+    try:
+        full = _drain(batcher.submit(PROMPTS[0]))
+        assert full == expected[0]
+        short = _drain(batcher.submit(PROMPTS[1], max_new_tokens=4))
+        assert short == expected[1][:4]
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            batcher.submit(PROMPTS[0], max_new_tokens=11)
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            batcher.submit(PROMPTS[0], max_new_tokens=0)
+    finally:
+        batcher.close()

@@ -172,7 +172,20 @@ class TPUVMLauncher(Launcher):
             *self._gcloud_args(),
         ]
         logger.info(f"provisioning TPU slice: {' '.join(command)}")
-        subprocess.run(command, check=True)
+        try:
+            subprocess.run(command, check=True)
+        except subprocess.CalledProcessError as exc:
+            # a failed create can still leave a half-provisioned (billed!) node
+            # behind; clean it up best-effort so the retry's create doesn't hit
+            # "already exists" — then surface the original failure
+            logger.warning(f"TPU slice create failed (rc={exc.returncode}); cleaning up {node}")
+            try:
+                self._gcloud_delete(node)
+            except Exception as cleanup_exc:
+                logger.warning(f"cleanup of partially created node {node} also failed: {cleanup_exc}")
+            raise RuntimeError(
+                f"provisioning TPU slice {node} ({accelerator}) failed with rc={exc.returncode}"
+            ) from exc
         return node
 
     def _gcloud_ssh(
@@ -226,10 +239,22 @@ class TPUVMLauncher(Launcher):
     def _gcloud_delete(self, node: str) -> None:
         command = ["gcloud", "compute", "tpus", "tpu-vm", "delete", node, "--quiet", *self._gcloud_args()]
         logger.info(f"tearing down TPU slice: {' '.join(command)}")
-        subprocess.run(command, check=False)
+        proc = subprocess.run(command, check=False)
+        if proc.returncode != 0:
+            # a silently swallowed delete failure leaks a billed slice; raise so
+            # teardown's caller knows the node still exists
+            raise RuntimeError(f"deleting TPU slice {node} failed with rc={proc.returncode}")
 
     def teardown(self, execution_path: str) -> None:
-        """Delete the slice provisioned for an execution (no-op if none/unknown)."""
+        """Delete the slice provisioned for an execution (no-op if none/unknown).
+
+        On deprovision failure the node stays registered under its execution, so
+        a later :meth:`teardown` retry targets it again instead of leaking it."""
         node = self._nodes.pop(execution_path, None)
-        if node is not None:
+        if node is None:
+            return
+        try:
             self._deprovisioner(node)
+        except Exception:
+            self._nodes[execution_path] = node  # keep it addressable for a retry
+            raise

@@ -73,10 +73,17 @@ def _flash_fwd_kernel(
         acc_scratch[:] = jnp.zeros_like(acc_scratch)
 
     def _compute():
-        q = q_ref[0, :, 0, :].astype(jnp.float32) * scale  # [block_q, D]
-        k = k_ref[0, :, 0, :].astype(jnp.float32)  # [block_k, D]
-        v = v_ref[0, :, 0, :].astype(jnp.float32)  # [block_k, D]
-        scores = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [block_q, block_k]
+        # matmuls run in the INPUT dtype with f32 accumulation: a bf16 QK^T /
+        # PV hits the MXU's native rate, while an up-front f32 cast would halve
+        # it — the whole reason the hand kernel can beat XLA's fused attention.
+        # Scale is applied to the f32 scores, not the bf16 operands.
+        q = q_ref[0, :, 0, :]  # [block_q, D]
+        k = k_ref[0, :, 0, :]  # [block_k, D]
+        v = v_ref[0, :, 0, :]  # [block_k, D]
+        scores = (
+            jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+            * scale
+        )  # [block_q, block_k] f32
 
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0)
@@ -91,7 +98,10 @@ def _flash_fwd_kernel(
         p = jnp.exp(scores - m_next)
 
         l_next = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_scratch[:] = acc_scratch[:] * alpha + jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())))
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        acc_scratch[:] = acc_scratch[:] * alpha + pv
         m_scratch[:] = jnp.broadcast_to(m_next, m_scratch.shape)
         l_scratch[:] = jnp.broadcast_to(l_next, l_scratch.shape)
 
@@ -117,14 +127,17 @@ def _flash_fwd_kernel(
 
 
 def _flash_forward(
-    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool, interpret: bool
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool, interpret: bool, blocks=None
 ) -> "tuple[jax.Array, jax.Array]":
     batch, q_len, n_heads, head_dim = q.shape
     k_len, n_kv = k.shape[1], k.shape[2]
     if n_heads % n_kv:
         raise ValueError(f"query heads ({n_heads}) must be a multiple of KV heads ({n_kv})")
-    block_q = min(DEFAULT_BLOCK_Q, q_len)
-    block_k = min(DEFAULT_BLOCK_K, k_len)
+    block_q = min((blocks or (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K))[0], q_len)
+    block_k = min((blocks or (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K))[1], k_len)
+    if q_len % block_q or k_len % block_k:
+        # a silently floor-divided grid would leave tail rows unwritten
+        raise ValueError(f"blocks ({block_q}, {block_k}) do not tile lengths ({q_len}, {k_len})")
     scale = head_dim**-0.5
 
     if pltpu is None:  # pragma: no cover
@@ -179,22 +192,25 @@ def _compiler_params(interpret: bool):
 
 def _bwd_recompute(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki, *, causal, block_q, block_k, scale, offset):
     """Shared backward prologue: recompute P = exp(S - lse) for one (qi, ki) tile
-    and return (q, k, ds, p, do) in f32 — the dq and dk/dv kernels consume the
-    same quantities, so masking/recompute fixes land in exactly one place."""
-    q = q_ref[0, :, 0, :].astype(jnp.float32)
-    k = k_ref[0, :, 0, :].astype(jnp.float32)
-    v = v_ref[0, :, 0, :].astype(jnp.float32)
-    do = do_ref[0, :, 0, :].astype(jnp.float32)
+    and return (q, k, ds, p, do) — operands in the input dtype (MXU-native),
+    p/ds in f32 — the dq and dk/dv kernels consume the same quantities, so
+    masking/recompute fixes land in exactly one place."""
+    q = q_ref[0, :, 0, :]
+    k = k_ref[0, :, 0, :]
+    v = v_ref[0, :, 0, :]
+    do = do_ref[0, :, 0, :]
     lse = lse_ref[0, 0, :][:, None]  # [block_q, 1]
     delta = delta_ref[0, 0, :][:, None]
 
-    scores = scale * jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))
+    scores = scale * jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
     if causal:
         q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0)
         k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
         scores = jnp.where(q_pos + offset >= k_pos, scores, _NEG_INF)
-    p = jnp.exp(scores - lse)  # [block_q, block_k]; 0 for masked/empty rows (lse=BIG)
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))
+    p = jnp.exp(scores - lse)  # [block_q, block_k] f32; 0 for masked rows (lse=BIG)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
     ds = p * (dp - delta)
     return q, k, ds, p, do
 
@@ -215,7 +231,9 @@ def _flash_bwd_dq_kernel(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki,
             causal=causal, block_q=block_q, block_k=block_k, scale=scale, offset=offset,
         )
-        dq_acc[:] += scale * jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())))
+        dq_acc[:] += scale * jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
 
     if causal:
         @pl.when(ki * block_k <= qi * block_q + block_q - 1 + offset)
@@ -247,8 +265,12 @@ def _flash_bwd_dkv_kernel(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki,
             causal=causal, block_q=block_q, block_k=block_k, scale=scale, offset=offset,
         )
-        dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())))
-        dk_acc[:] += scale * jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())))
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dk_acc[:] += scale * jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
 
     if causal:
         # skip q blocks entirely above this k block's (offset-shifted) diagonal
@@ -352,18 +374,18 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, interpret: bool):
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash(q, k, v, causal, interpret):
-    out, _ = _flash_forward(q, k, v, causal, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, interpret, blocks):
+    out, _ = _flash_forward(q, k, v, causal, interpret, blocks)
     return out
 
 
-def _flash_fwd_rule(q, k, v, causal, interpret):
-    out, lse = _flash_forward(q, k, v, causal, interpret)
+def _flash_fwd_rule(q, k, v, causal, interpret, blocks):
+    out, lse = _flash_forward(q, k, v, causal, interpret, blocks)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd_rule(causal, interpret, residuals, g):
+def _flash_bwd_rule(causal, interpret, blocks, residuals, g):
     q, k, v, out, lse = residuals
     return _flash_backward(q, k, v, out, lse, g, causal, interpret)
 
@@ -372,9 +394,17 @@ _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
 def flash_attention(
-    q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = False, interpret: bool = False
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    interpret: bool = False,
+    blocks: "tuple[int, int] | None" = None,
 ) -> jax.Array:
     """Flash attention entry point. ``interpret=True`` runs the kernel in the pallas
     interpreter (CPU) — used by the test ring. Accepts grouped-query KV
-    (``k/v: [B, Lk, Hkv, D]`` with ``Hkv`` dividing the query head count)."""
-    return _flash(q, k, v, causal, interpret)
+    (``k/v: [B, Lk, Hkv, D]`` with ``Hkv`` dividing the query head count).
+    ``blocks=(block_q, block_k)`` overrides the forward tile sizes (the shootout
+    benchmark sweeps them; lengths must divide evenly)."""
+    return _flash(q, k, v, causal, interpret, blocks)

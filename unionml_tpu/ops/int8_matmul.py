@@ -72,21 +72,28 @@ def int8_matmul(
     *,
     out_dtype: Any = None,
     interpret: bool = False,
+    block_m: Optional[int] = None,
+    block_k: Optional[int] = None,
+    block_f: Optional[int] = None,
 ) -> jax.Array:
     """``[M, K] @ int8 [K, F] * f32 [1, F] -> [M, F]`` via the pallas kernel.
 
     Requires K and F to admit a block tiling (see module docstring); M is padded
     to the block size here (x is small — the weight is never padded or copied).
+    Explicit ``block_*`` override the defaults (the shootout benchmark sweeps
+    them; dims must divide evenly).
     """
     m, k_dim = x.shape
     _, f_dim = q.shape
     out_dtype = out_dtype or x.dtype
-    block_k = _pick_block(k_dim, _K_CANDIDATES)
-    block_f = _pick_block(f_dim, _F_CANDIDATES)
+    block_k = block_k or _pick_block(k_dim, _K_CANDIDATES)
+    block_f = block_f or _pick_block(f_dim, _F_CANDIDATES)
     if block_k is None or block_f is None:
         raise ValueError(f"no block tiling for weight shape {(k_dim, f_dim)}")
+    if k_dim % block_k or f_dim % block_f:
+        raise ValueError(f"blocks ({block_k}, {block_f}) do not tile weight {(k_dim, f_dim)}")
 
-    block_m = min(_BLOCK_M, 1 << (max(m - 1, 0)).bit_length() if m > 1 else 1)
+    block_m = block_m or min(_BLOCK_M, 1 << (max(m - 1, 0)).bit_length() if m > 1 else 1)
     padded_m = -(-m // block_m) * block_m
     if padded_m != m:
         x = jnp.pad(x, ((0, padded_m - m), (0, 0)))

@@ -204,6 +204,26 @@ class Backend:
 
         shutil.copytree(source_dir, bundle, ignore=ignore)
 
+        # per-app container image, built FROM the bundle so image content ==
+        # deployed source (reference remote.py:91-108; patch deploys skip image
+        # work exactly like the reference's fast registration, model.py:700-701)
+        image = None
+        if self.config.registry and not patch:
+            from unionml_tpu.container import build_image, image_fqn, push_image
+
+            image = image_fqn(
+                model.name, app_version, registry=self.config.registry, image_name=self.config.image_name
+            )
+            try:
+                build_image(bundle, image, dockerfile=self.config.dockerfile)
+                push_image(image)
+            except Exception:
+                # a manifest-less bundle dir must not linger: latest_app_version
+                # could hand it out and every consumer would crash on the
+                # missing manifest
+                shutil.rmtree(app_dir, ignore_errors=True)
+                raise
+
         app_module = _infer_app_module(model)
         manifest = {
             "model_name": model.name,
@@ -215,6 +235,7 @@ class Backend:
                 model.predict_from_features_workflow_name,
             ],
             "accelerator": self.config.accelerator,
+            "image": image,
             "deployed_at": time.time(),
         }
         (app_dir / "manifest.json").write_text(json.dumps(manifest, indent=2))

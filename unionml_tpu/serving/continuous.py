@@ -68,6 +68,7 @@ class _Session:
 
     slot: int
     out: "queue.Queue[Any]"
+    max_new: int  # this request's token budget (<= config.max_new_tokens)
     produced: int = 0  # tokens emitted so far (includes the prefill token)
     finished: bool = False
 
@@ -177,13 +178,24 @@ class ContinuousBatcher:
 
     # ------------------------------------------------------------------ public API
 
-    def submit(self, prompt: Sequence[int]) -> Iterator[np.ndarray]:
+    def submit(
+        self, prompt: Sequence[int], *, max_new_tokens: Optional[int] = None
+    ) -> Iterator[np.ndarray]:
         """Enqueue a prompt; returns an iterator of 1-D int32 arrays of new
         tokens (first item is the prompt-sampled token). Blocks-free: the
-        iterator blocks its consumer, not the engine. Safe from any thread."""
+        iterator blocks its consumer, not the engine. Safe from any thread.
+        ``max_new_tokens`` caps THIS request below the config budget (the cache
+        is sized for the config's budget, so larger values are rejected)."""
         if len(prompt) == 0:
             raise ValueError("prompt must be non-empty")
-        session = _Session(slot=-1, out=queue.Queue())
+        budget = self.gen.config.max_new_tokens
+        if max_new_tokens is not None:
+            if not (1 <= max_new_tokens <= budget):
+                raise ValueError(
+                    f"max_new_tokens must be in [1, {budget}] (the config budget the cache is sized for)"
+                )
+            budget = max_new_tokens
+        session = _Session(slot=-1, out=queue.Queue(), max_new=budget)
         with self._lock:
             if self._closed:
                 raise RuntimeError("ContinuousBatcher is closed")
@@ -290,7 +302,7 @@ class ContinuousBatcher:
                 session.produced = 1
                 self._sessions[slot] = session
                 hit_eos = cfg.eos_id is not None and int(first[0]) == cfg.eos_id
-                if session.produced >= cfg.max_new_tokens or hit_eos:
+                if session.produced >= session.max_new or hit_eos:
                     # device_done=False even for eos: the decode body only flags
                     # done on tokens IT samples — the prompt-sampled tok0 is not
                     # one of them, so without explicit masking the freed slot
@@ -324,7 +336,7 @@ class ContinuousBatcher:
             for slot in list(self._sessions):
                 session = self._sessions[slot]
                 row = toks_np[slot]
-                take = min(self.decode_chunk, cfg.max_new_tokens - session.produced)
+                take = min(self.decode_chunk, session.max_new - session.produced)
                 if cfg.eos_id is not None:
                     hits = np.nonzero(row[:take] == cfg.eos_id)[0]
                     if hits.size:
@@ -333,5 +345,5 @@ class ContinuousBatcher:
                     session.out.put(row[:take].copy())
                     session.produced += take
                 device_done = bool(done_np[slot])
-                if session.produced >= cfg.max_new_tokens or device_done:
+                if session.produced >= session.max_new or device_done:
                     self._finish_locked(slot, device_done=device_done)
