@@ -368,3 +368,30 @@ def test_evaluate_keeps_existing_placement_of_trained_state(monkeypatch):
     metrics = evaluate(result.state, eval_step, _make_data(), batch_size=128, mesh=mesh_spec)
     assert metrics["accuracy"] > 0.9
     assert captured["kernel_spec"] == trained_spec  # placed onto its OWN sharding
+
+
+def test_fit_dcn_data_outer_axis_matches_flat_dp():
+    """Cross-slice layout: a 2-slice ``dcn_data`` outer axis wrapping an
+    intra-slice data*fsdp mesh must train to the same loss trajectory as flat
+    DP over the same 8 devices — only the gradient all-reduce spans the outer
+    axis, params/optimizer state replicate over it (mesh.py's scaling-book
+    recipe)."""
+    module, state = _make_state()
+    step = make_train_step(_loss(module))
+    data = _make_data()
+
+    flat = fit(state, step, data, TrainerConfig(epochs=1, batch_size=128, mesh=MeshSpec(data=-1)))
+    _, state2 = _make_state()
+    dcn = fit(
+        state2,
+        step,
+        data,
+        TrainerConfig(
+            epochs=1, batch_size=128,
+            mesh=MeshSpec(dcn_data=2, data=2, fsdp=2), fsdp_min_weight_size=256,
+        ),
+    )
+    assert dcn.steps == flat.steps
+    np.testing.assert_allclose(
+        dcn.history[-1]["loss"], flat.history[-1]["loss"], rtol=1e-4
+    )

@@ -229,3 +229,93 @@ def test_per_request_budget_and_int8_kv(tiny_gen):
             batcher.submit(PROMPTS[0], max_new_tokens=0)
     finally:
         batcher.close()
+
+
+def test_shared_prefix_across_slots(tiny_gen):
+    """A server-wide prefix (system prompt) composes with continuous batching:
+    every admitted suffix decodes as if prefilled with (prefix + suffix), and
+    the prefix's prefill was paid once in cache_prefix."""
+    module, params = tiny_gen
+    cfg = GenerationConfig(max_new_tokens=8, temperature=0.0, prompt_buckets=(8, 32))
+    prefix = [7, 7, 3, 9, 1, 2]
+    suffixes = [[3, 1, 4], [9, 2, 6, 5], [8]]
+    expected = _sequential_expected(module, params, cfg, [prefix + s for s in suffixes])
+
+    gen = Generator(module, params, cfg)
+    batcher = ContinuousBatcher(gen, slots=2, decode_chunk=3, prefix=gen.cache_prefix(prefix))
+    try:
+        results = [_drain(batcher.submit(s)) for s in suffixes]
+        assert results == expected
+    finally:
+        batcher.close()
+
+
+def _draft_for(vocab):
+    cfg = LlamaConfig.tiny(
+        vocab_size=vocab, dim=32, n_layers=1, n_heads=4, n_kv_heads=2, hidden_dim=64,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    module = Llama(cfg)
+    return module, module.init(jax.random.PRNGKey(9), jnp.zeros((1, 8), jnp.int32))["params"]
+
+
+def test_speculative_continuous_streams_match_sequential(tiny_gen):
+    """Speculative continuous batching: resident rows advance by shared
+    draft-and-verify rounds with per-row floors, yet each greedy stream equals
+    the plain sequential Generator run — the exactness oracle survives both
+    compositions at once."""
+    import dataclasses
+
+    from unionml_tpu.models import DraftSpec
+
+    module, params = tiny_gen
+    base = GenerationConfig(max_new_tokens=10, temperature=0.0, prompt_buckets=(16,))
+    expected = _sequential_expected(module, params, base, PROMPTS)
+
+    draft, dp = _draft_for(97)
+    cfg = dataclasses.replace(base, draft=DraftSpec(module=draft, params=dp, gamma=3))
+    batcher = ContinuousBatcher(Generator(module, params, cfg), slots=3, decode_chunk=4)
+    try:
+        results = [None] * len(PROMPTS)
+
+        def worker(i):
+            results[i] = _drain(batcher.submit(PROMPTS[i]))
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(len(PROMPTS))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert results == expected
+        assert batcher.decoded_rows > batcher.decode_dispatches  # rounds were shared
+    finally:
+        batcher.close()
+
+
+def test_speculative_continuous_eos_and_budget(tiny_gen):
+    import dataclasses
+
+    from unionml_tpu.models import DraftSpec
+
+    module, params = tiny_gen
+    probe = Generator(
+        module, params, GenerationConfig(max_new_tokens=12, temperature=0.0, prompt_buckets=(16,))
+    )(PROMPTS[:1])
+    eos = int(probe[0][4])
+    base = GenerationConfig(
+        max_new_tokens=12, temperature=0.0, prompt_buckets=(16,), eos_id=eos, pad_id=0
+    )
+    expected = _sequential_expected(module, params, base, PROMPTS[:3])
+
+    draft, dp = _draft_for(97)
+    cfg = dataclasses.replace(base, draft=DraftSpec(module=draft, params=dp, gamma=4))
+    batcher = ContinuousBatcher(Generator(module, params, cfg), slots=1, decode_chunk=5)
+    try:
+        # slots=1 forces strict slot reuse; eos exits must free it
+        results = [_drain(batcher.submit(p)) for p in PROMPTS[:3]]
+        assert results == expected
+        # per-request budget caps below eos
+        short = _drain(batcher.submit(PROMPTS[1], max_new_tokens=2))
+        assert short == expected[1][:2]
+    finally:
+        batcher.close()

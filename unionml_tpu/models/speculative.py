@@ -159,7 +159,7 @@ class SpeculativeGenerator:
 
         greedy_mode = cfg.temperature == 0.0
 
-        def spec_round(tp, dp, t_cache, d_cache, tok, lengths, done, produced, out_buf, key):
+        def spec_round(tp, dp, t_cache, d_cache, tok, lengths, done, produced, out_buf, key, budget):
             key, draft_key, corr_key = jax.random.split(key, 3)
             accept_keys = jax.random.split(draft_key, gamma + 1)
 
@@ -245,8 +245,9 @@ class SpeculativeGenerator:
             emitted = jnp.where(done[:, None], pad, emitted)
             n_emit = jnp.where(done, 0, emit_mask.sum(axis=1))
 
-            # clip to the generation budget
-            room = jnp.maximum(cfg.max_new_tokens - produced, 0)
+            # clip to each row's generation budget (per-row: continuous batching
+            # admits requests with different caps into one resident batch)
+            room = jnp.maximum(budget - produced, 0)
             n_emit = jnp.minimum(n_emit, room)
             emitted = jnp.where(idx < n_emit[:, None], emitted, pad)
 
@@ -254,7 +255,7 @@ class SpeculativeGenerator:
                 lambda buf, row, start: jax.lax.dynamic_update_slice(buf, row, (start,))
             )(out_buf, emitted, produced)
 
-            new_done = done | row_hits_eos | (produced + n_emit >= cfg.max_new_tokens)
+            new_done = done | row_hits_eos | (produced + n_emit >= budget)
             # next round continues after the last emitted token; finished rows freeze
             tok = jnp.where(
                 new_done, tok, jnp.take_along_axis(emitted, jnp.maximum(n_emit - 1, 0)[:, None], axis=1)[:, 0]
@@ -264,14 +265,15 @@ class SpeculativeGenerator:
             acc_count = jnp.where(done, 0, jnp.minimum(accepted, room)).sum()
             return t_cache, d_cache, tok, lengths, new_done, produced, out_buf, acc_count, key
 
-        def spec_loop(tp, dp, state, floor):
+        def spec_loop(tp, dp, state, floor, budget):
             """Post-prefill generation as ONE device-side while_loop — per-round
             host round trips through a remote-TPU tunnel would otherwise dominate
-            the round cost (measured ~20x the compute). ``floor``: keep rolling
-            rounds while any unfinished row has produced fewer than ``floor``
-            tokens — ``__call__`` passes max_new_tokens (run to completion),
-            :meth:`stream` passes increasing floors to surface tokens in chunks
-            without leaving the device more than once per chunk."""
+            the round cost (measured ~20x the compute). ``floor`` ([B] int32):
+            keep rolling rounds while any unfinished row has produced fewer than
+            its floor — ``__call__`` passes the budget (run to completion),
+            :meth:`stream` and the continuous batcher pass ``produced + chunk``
+            so tokens surface chunkwise with one device exit per chunk.
+            ``budget`` ([B] int32) is each row's max_new_tokens cap."""
             tp = target._dequant_params(tp)
             dp = draft._dequant_params(dp)
 
@@ -282,7 +284,7 @@ class SpeculativeGenerator:
             def body(state):
                 t_cache, d_cache, tok, lengths, done, produced, out_buf, rounds, acc_total, key = state
                 t_cache, d_cache, tok, lengths, done, produced, out_buf, acc, key = spec_round(
-                    tp, dp, t_cache, d_cache, tok, lengths, done, produced, out_buf, key
+                    tp, dp, t_cache, d_cache, tok, lengths, done, produced, out_buf, key, budget
                 )
                 return (t_cache, d_cache, tok, lengths, done, produced, out_buf, rounds + 1, acc_total + acc, key)
 
@@ -326,9 +328,8 @@ class SpeculativeGenerator:
         the target-only sequence, sampled output is target-distributed."""
         cfg = self.config
         n, state = self._start_state(prompts, seed)
-        state = self._round_fn(
-            self._target.params, self._draft.params, state, jnp.int32(cfg.max_new_tokens)
-        )
+        budget = jnp.full(state[2].shape, cfg.max_new_tokens, jnp.int32)
+        state = self._round_fn(self._target.params, self._draft.params, state, budget, budget)
         out_buf, rounds, accepted = state[6], state[7], state[8]
         self.rounds += int(rounds)
         self.accepted_tokens += int(accepted)
@@ -350,7 +351,7 @@ class SpeculativeGenerator:
         prev = np.ones((n,), np.int64)
         first = np.asarray(state[6][:n, :1])  # one fetch, not one per row
         yield [first[i] for i in range(n)]
-        floor = 1
+        budget = jnp.full(state[2].shape, cfg.max_new_tokens, jnp.int32)
         rounds = accepted = 0  # snapshots from the LAST SUCCESSFUL dispatch: the
         # in-flight state's buffers are donated, so reading it after a failed
         # dispatch would raise a secondary deleted-buffer error masking the cause
@@ -359,9 +360,10 @@ class SpeculativeGenerator:
                 done_np = np.asarray(state[4])[:n]
                 if bool(done_np.all()):
                     return
-                floor = min(floor + chunk_size, cfg.max_new_tokens)
+                # per-row floor: each unfinished row gains >= chunk_size tokens
+                floor = jnp.minimum(state[5] + chunk_size, cfg.max_new_tokens)
                 state = self._round_fn(
-                    self._target.params, self._draft.params, state, jnp.int32(floor)
+                    self._target.params, self._draft.params, state, floor, budget
                 )
                 out_np = np.asarray(state[6])
                 prod_np = np.asarray(state[5])[:n]

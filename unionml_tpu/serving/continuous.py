@@ -55,7 +55,7 @@ import jax
 import jax.numpy as jnp
 
 from unionml_tpu._logging import logger
-from unionml_tpu.models.generate import Generator, init_cache
+from unionml_tpu.models.generate import Generator, PrefixCache, _paste_prefix_rows, init_cache
 
 __all__ = ["ContinuousBatcher"]
 
@@ -85,10 +85,21 @@ class ContinuousBatcher:
     (FIFO). ``decode_chunk`` is the scan length per shared dispatch — smaller
     chunks mean lower time-to-next-token and more frequent admission points,
     larger chunks amortize per-dispatch overhead (which dominates through a
-    remote-TPU tunnel).
+    remote-TPU tunnel). ``prefix`` (a :class:`~unionml_tpu.models.generate.PrefixCache`
+    from ``generator.cache_prefix``) is a server-wide shared prompt prefix — a
+    system prompt — whose K/V rows are pasted into every admission, so its
+    prefill cost is paid once at ``cache_prefix`` time, not per request; every
+    submitted prompt is then a suffix after it.
     """
 
-    def __init__(self, generator: Generator, *, slots: int = 4, decode_chunk: int = 8):
+    def __init__(
+        self,
+        generator: Generator,
+        *,
+        slots: int = 4,
+        decode_chunk: int = 8,
+        prefix: Optional[PrefixCache] = None,
+    ):
         if slots < 1:
             raise ValueError("slots must be >= 1")
         if decode_chunk < 1:
@@ -96,18 +107,29 @@ class ContinuousBatcher:
         cfg = generator.config
         if cfg.sp_prefill:
             raise ValueError("continuous batching does not compose with sp_prefill yet")
-        if cfg.draft is not None:
-            # the engine drives gen._prefill/_decode directly, which would
-            # silently bypass the configured speculative routing — refuse
-            # rather than quietly downgrade the user's latency expectations
-            raise ValueError("continuous batching does not compose with config.draft (speculative) yet")
         self.gen = generator
+        #: speculative mode: with ``config.draft`` set, resident rows advance by
+        #: draft-and-verify ROUNDS instead of single decode steps — the engine
+        #: drives the SpeculativeGenerator's batch round loop (per-row floors
+        #: and budgets), so concurrent streams share draft+verify dispatches
+        #: and each greedy stream still equals its solo target-only run
+        self._spec = generator._speculative() if cfg.draft is not None else None
+        if self._spec is not None and prefix is not None:
+            raise ValueError("speculative continuous batching does not compose with prefix= yet")
+        if prefix is not None and not isinstance(prefix, PrefixCache):
+            raise TypeError(f"prefix must be a PrefixCache (from generator.cache_prefix), got {type(prefix).__name__}")
         self.slots = slots
         self.decode_chunk = decode_chunk
-        #: room for every bucketed prompt plus the full budget, plus one chunk of
-        #: overshoot (the last chunk's cache writes may pass max_new_tokens)
+        self.prefix = prefix
+        #: room for the shared prefix, every bucketed prompt, the full budget,
+        #: plus overshoot: one chunk of decode, or one round's gamma+1 verify
+        #: writes in speculative mode (which never runs the plain decode)
+        overshoot = (self._spec.gamma + 1) if self._spec is not None else decode_chunk
         self.cache_len = (
-            max(cfg.prompt_buckets, default=64) + cfg.max_new_tokens + decode_chunk
+            (prefix.length if prefix is not None else 0)
+            + max(cfg.prompt_buckets, default=64)
+            + cfg.max_new_tokens
+            + overshoot
         )
         self._lock = threading.Condition()
         self._pending: "List[tuple]" = []  # (prompt, session) awaiting a free slot
@@ -117,12 +139,17 @@ class ContinuousBatcher:
         self._carry: Optional[tuple] = None  # (cache, tok, lengths, done, key)
         self._seed = 0
         self._thread: Optional[threading.Thread] = None
-        # donate only the pool cache: the [1, ...] row cache can't alias any
-        # output shape, so donating it would just trigger unusable-buffer warnings
+        # donate only the pool-side buffers: the [1, ...] row caches can't alias
+        # any output shape, so donating them would just trigger warnings
         self._admit_fn = jax.jit(self._admit_impl, donate_argnums=(0,))
+        self._spec_admit_fn = jax.jit(self._spec_admit_impl, donate_argnums=(0, 1, 2))
         #: dispatch/utilization counters for benchmarks and /metrics
         self.decode_dispatches = 0
         self.decoded_rows = 0
+        # high-water marks of the carry's ride-along counters, so the spec
+        # engine's rounds/accepted_tokens telemetry gets per-dispatch deltas
+        self._spec_rounds_seen = 0
+        self._spec_accepted_seen = 0
 
     # ------------------------------------------------------------------ device fns
 
@@ -142,6 +169,28 @@ class ContinuousBatcher:
         done = jax.lax.dynamic_update_slice(done, jnp.zeros((1,), bool), (slot,))
         return cache, tok, lengths, done
 
+    @classmethod
+    def _spec_admit_impl(cls, t_cache, d_cache, out_buf, t_row, d_row, tok, lengths, done,
+                         produced, slot, row_tok, row_len, row_done, pad):
+        """Speculative-mode admission: the shared paste/activate body
+        (:meth:`_admit_impl`) handles the target cache and carry entries; this
+        adds the draft cache row, the out_buf row reset (pad everywhere, tok0 at
+        0), the produced counter, and an explicit start-done flag (a tok0 that
+        is already eos, or a budget of 1)."""
+        t_cache, tok, lengths, done = cls._admit_impl(
+            t_cache, t_row, tok, lengths, done, slot, row_tok, row_len
+        )
+        def paste(buf: jax.Array, row: jax.Array) -> jax.Array:
+            start = (slot,) + (0,) * (buf.ndim - 1)
+            return jax.lax.dynamic_update_slice(buf, row.astype(buf.dtype), start)
+
+        d_cache = jax.tree_util.tree_map(paste, d_cache, d_row)
+        row = jnp.full((out_buf.shape[1],), pad, out_buf.dtype).at[0].set(row_tok[0])
+        out_buf = jax.lax.dynamic_update_slice(out_buf, row[None], (slot, 0))
+        done = jax.lax.dynamic_update_slice(done, row_done, (slot,))
+        produced = jax.lax.dynamic_update_slice(produced, jnp.ones((1,), produced.dtype), (slot,))
+        return t_cache, d_cache, out_buf, tok, lengths, done, produced
+
     def _init_carry(self) -> tuple:
         cfg = self.gen.config
         cache = self.gen._place_cache(
@@ -151,29 +200,57 @@ class ContinuousBatcher:
         lengths = jnp.ones((self.slots,), jnp.int32)
         done = jnp.ones((self.slots,), bool)  # every slot starts free (= masked out)
         key = jax.random.PRNGKey(self._seed)
-        return (cache, tok, lengths, done, key)
+        if self._spec is None:
+            return (cache, tok, lengths, done, key)
+        draft_gen = self._spec._draft
+        d_cache = draft_gen._place_cache(
+            init_cache(draft_gen.module.config, self.slots, self.cache_len, kv_dtype=cfg.kv_cache_dtype)
+        )
+        cap = cfg.max_new_tokens + self._spec.gamma + 1
+        out_buf = jnp.full((self.slots, cap), cfg.pad_id, jnp.int32)
+        produced = jnp.zeros((self.slots,), jnp.int32)
+        # spec-loop state layout (speculative.py): rounds/accepted counters ride along
+        return (cache, d_cache, tok, lengths, done, produced, out_buf,
+                jnp.int32(0), jnp.int32(0), key)
 
-    def _prefill_row(self, prompt: Sequence[int], seed: int):
+    def _prefill_row(self, prompt: Sequence[int], seed: int, gen: Optional[Generator] = None):
         """Prefill one prompt at batch 1 into a fresh [1, cache_len] cache using
-        the Generator's own jitted prefill — identical numerics and the same
-        bounded set of prefill compiles (one per bucket at batch 1)."""
-        gen, cfg = self.gen, self.gen.config
+        the Generator's own jitted machinery — identical numerics and the same
+        bounded set of prefill compiles (one per bucket at batch 1). With a
+        shared ``prefix``, its rows are pasted at slots [0, p0) and the prompt
+        (a suffix) flows through the offset chunked path, exactly like
+        ``Generator.__call__(..., prefix=...)``. ``gen`` overrides the model
+        (speculative mode prefills the draft's row too)."""
+        gen, cfg = gen or self.gen, self.gen.config
+        p0 = self.prefix.length if self.prefix is not None else 0
         bucket = gen._bucket(max(len(prompt), 1))
-        if bucket + cfg.max_new_tokens > self.cache_len:
+        if p0 + bucket + cfg.max_new_tokens > self.cache_len:
             raise ValueError(
-                f"prompt of length {len(prompt)} needs bucket {bucket} + "
+                f"prompt of length {len(prompt)} needs prefix {p0} + bucket {bucket} + "
                 f"{cfg.max_new_tokens} new tokens > cache_len {self.cache_len}"
             )
         tokens = np.full((1, bucket), cfg.pad_id, np.int32)
         tokens[0, : len(prompt)] = np.asarray(prompt, np.int32)
-        lengths = jnp.asarray([max(len(prompt), 1)], jnp.int32)
+        lengths = jnp.asarray([p0 + max(len(prompt), 1)], jnp.int32)
         row_cache = gen._place_cache(
             init_cache(gen.module.config, 1, self.cache_len, kv_dtype=cfg.kv_cache_dtype)
         )
         key = jax.random.fold_in(jax.random.PRNGKey(self._seed), seed)
-        tok0, row_cache, _ = gen._prefill(
-            gen.params, jnp.asarray(tokens), lengths, row_cache, key, jnp.ones((1,), bool)
-        )
+        row_valid = jnp.ones((1,), bool)
+        if self.prefix is not None:
+            chunk = cfg.prefill_chunk or bucket
+            aligned = -(-bucket // chunk) * chunk  # ragged tails would cost one
+            if aligned > bucket:  # extra prefill compile per bucket remainder
+                tokens = np.pad(tokens, ((0, 0), (0, aligned - bucket)), constant_values=cfg.pad_id)
+            row_cache = _paste_prefix_rows(row_cache, self.prefix.layers)
+            last, row_cache = gen._chunked_prefill_loop(
+                tokens, lengths, row_cache, row_valid, chunk, start=p0
+            )
+            tok0 = gen._first_token(gen.params, last, key)
+        else:
+            tok0, row_cache, _ = gen._prefill(
+                gen.params, jnp.asarray(tokens), lengths, row_cache, key, row_valid
+            )
         return tok0, lengths, row_cache
 
     # ------------------------------------------------------------------ public API
@@ -281,6 +358,11 @@ class ContinuousBatcher:
                 seed = self._seed
             try:
                 tok0, row_len, row_cache = self._prefill_row(prompt, seed)
+                if self._spec is not None:
+                    # the draft's cache row: same prompt through the draft model
+                    # (its prompt-sampled token is discarded — emission #1 is the
+                    # target's, exactly as in SpeculativeGenerator._start_state)
+                    _, _, d_row = self._prefill_row(prompt, seed, gen=self._spec._draft)
             except ValueError as exc:
                 # a bad prompt (e.g. longer than the cache can hold) fails its
                 # own stream; the engine and other residents keep going
@@ -291,40 +373,59 @@ class ContinuousBatcher:
                 continue
             if self._carry is None:
                 self._carry = self._init_carry()
-            cache, tok, lengths, done, key = self._carry
-            cache, tok, lengths, done = self._admit_fn(
-                cache, row_cache, tok, lengths, done, jnp.int32(slot), tok0, row_len
-            )
-            self._carry = (cache, tok, lengths, done, key)
             first = np.asarray(tok0)
+            hit_eos = cfg.eos_id is not None and int(first[0]) == cfg.eos_id
+            start_done = hit_eos or 1 >= session.max_new
+            if self._spec is None:
+                cache, tok, lengths, done, key = self._carry
+                cache, tok, lengths, done = self._admit_fn(
+                    cache, row_cache, tok, lengths, done, jnp.int32(slot), tok0, row_len
+                )
+                self._carry = (cache, tok, lengths, done, key)
+            else:
+                t_cache, d_cache, tok, lengths, done, produced, out_buf, rounds, acc, key = self._carry
+                t_cache, d_cache, out_buf, tok, lengths, done, produced = self._spec_admit_fn(
+                    t_cache, d_cache, out_buf, row_cache, d_row, tok, lengths, done, produced,
+                    jnp.int32(slot), tok0, row_len, jnp.asarray([start_done]),
+                    jnp.int32(cfg.pad_id),
+                )
+                self._carry = (t_cache, d_cache, tok, lengths, done, produced, out_buf, rounds, acc, key)
             with self._lock:
                 session.out.put(first)
                 session.produced = 1
                 self._sessions[slot] = session
-                hit_eos = cfg.eos_id is not None and int(first[0]) == cfg.eos_id
-                if session.produced >= session.max_new or hit_eos:
-                    # device_done=False even for eos: the decode body only flags
-                    # done on tokens IT samples — the prompt-sampled tok0 is not
-                    # one of them, so without explicit masking the freed slot
-                    # would keep decoding as a zombie row (and claim
-                    # routed-expert capacity)
-                    self._finish_locked(slot, device_done=False)
+                if start_done:
+                    # speculative mode already marked the row done on device
+                    # (row_done); plain mode must mask it here — the decode body
+                    # only flags done on tokens IT samples, and the
+                    # prompt-sampled tok0 is not one of them, so without masking
+                    # the freed slot would keep decoding as a zombie row (and
+                    # claim routed-expert capacity)
+                    self._finish_locked(slot, device_done=self._spec is not None)
+
+    def _mask_slot_done(self, slot: int) -> None:
+        """Set the device-side done flag of a slot (engine thread only)."""
+        if self._carry is None:
+            return
+        state = list(self._carry)
+        done_idx = 3 if self._spec is None else 4
+        state[done_idx] = state[done_idx].at[slot].set(True)
+        self._carry = tuple(state)
 
     def _finish_locked(self, slot: int, *, device_done: bool) -> None:
         session = self._sessions.pop(slot)
         session.finished = True
         self._free.append(slot)
-        if not device_done and self._carry is not None:
+        if not device_done:
             # finished without the device knowing (budget exhausted, or the
             # prompt-sampled token was eos): mask the row out of future chunks
-            cache, tok, lengths, done, key = self._carry
-            self._carry = (cache, tok, lengths, done.at[slot].set(True), key)
+            self._mask_slot_done(slot)
         # sentinel last: once the consumer wakes, the engine state is consistent
         session.out.put(_SENTINEL)
 
     def _decode_chunk(self) -> None:
-        """One shared dispatch: advance every resident row by decode_chunk steps,
-        then route tokens and free finished slots."""
+        if self._spec is not None:
+            return self._spec_chunk()
         cfg = self.gen.config
         toks, carry = self.gen._decode(self.gen.params, *self._carry, self.decode_chunk)
         self._carry = carry
@@ -347,3 +448,44 @@ class ContinuousBatcher:
                 device_done = bool(done_np[slot])
                 if session.produced >= session.max_new or device_done:
                     self._finish_locked(slot, device_done=device_done)
+
+    def _spec_chunk(self) -> None:
+        """Speculative shared dispatch: one floor-driven round loop (draft gamma
+        tokens, verify in one target forward, accept/reject) advances every
+        resident row by >= decode_chunk tokens or to completion — concurrent
+        streams share BOTH the draft and the verify dispatches."""
+        spec = self._spec
+        if spec._round_fn is None:
+            spec._round_fn = spec._build_round()
+        with self._lock:
+            budget_np = np.zeros((self.slots,), np.int32)
+            for slot, session in self._sessions.items():
+                budget_np[slot] = session.max_new
+        budget = jnp.asarray(budget_np)
+        # per-row floor: every unfinished row gains >= decode_chunk tokens this
+        # dispatch (capped by its budget); free slots are done and ignored
+        floor = jnp.minimum(self._carry[5] + self.decode_chunk, budget)
+        state = spec._round_fn(
+            spec._target.params, spec._draft.params, self._carry, floor, budget
+        )
+        self._carry = state
+        out_np = np.asarray(state[6])  # also fences the dispatch
+        prod_np = np.asarray(state[5])
+        done_np = np.asarray(state[4])
+        # fold the ride-along counters into the engine's acceptance telemetry
+        # (they accumulate across dispatches inside the carry; add the delta)
+        rounds_total, accepted_total = int(state[7]), int(state[8])
+        spec.rounds += rounds_total - self._spec_rounds_seen
+        spec.accepted_tokens += accepted_total - self._spec_accepted_seen
+        self._spec_rounds_seen, self._spec_accepted_seen = rounds_total, accepted_total
+        with self._lock:
+            self.decode_dispatches += 1
+            self.decoded_rows += len(self._sessions)
+            for slot in list(self._sessions):
+                session = self._sessions[slot]
+                new = out_np[slot, session.produced : prod_np[slot]]
+                if new.size:
+                    session.out.put(new.copy())
+                    session.produced = int(prod_np[slot])
+                if bool(done_np[slot]):
+                    self._finish_locked(slot, device_done=True)
