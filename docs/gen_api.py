@@ -70,10 +70,15 @@ def _first_paragraph(doc: str | None) -> str:
 
 
 def _signature(obj) -> str:
+    import re
+
     try:
-        return str(inspect.signature(obj))
+        sig = str(inspect.signature(obj))
     except (ValueError, TypeError):
         return "(...)"
+    # default values whose repr embeds a memory address (bound methods, object
+    # instances) would re-churn the generated page on every rebuild
+    return re.sub(r" at 0x[0-9a-f]+", "", sig)
 
 
 def _public_members(module):
@@ -139,7 +144,12 @@ def generate_api_page() -> str:
             doc = _first_paragraph(func.__doc__)
             if doc:
                 lines += [doc, ""]
-    return "\n".join(lines).rstrip() + "\n"
+    import re
+
+    # addresses can also arrive through docstrings (flax injects attribute docs
+    # containing default-object reprs); scrub the whole page so rebuilds are
+    # byte-stable
+    return re.sub(r" at 0x[0-9a-f]+", "", "\n".join(lines).rstrip() + "\n")
 
 
 def generate_cli_page() -> str:

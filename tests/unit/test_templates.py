@@ -110,6 +110,13 @@ def test_text_generation_template_trains_generates_and_serves(render, tmp_path):
     batcher = module._continuous.get(id(module.model.artifact.model_object))
     assert batcher is not None and batcher.decode_dispatches > 0
 
+    # /metrics surfaces the shared batcher's utilization
+    status, metrics_payload, _ = asyncio.run(app.dispatch("GET", "/metrics"))
+    assert status == 200
+    generation = metrics_payload["generation"]
+    assert generation["slots"] == 4 and generation["decode_dispatches"] > 0
+    assert generation["speculative"] is False
+
     # speculative decoding through the Generator façade: greedy-exact vs the
     # plain predictor (the half-depth draft changes speed, never tokens)
     spec = module.speculative_generator(module.model.artifact.model_object)

@@ -172,6 +172,12 @@ class ServingApp:
             # makes the bounded-compile guarantee observable: traces must stay at
             # len(buckets) no matter how many request shapes arrive
             snapshot["predictor"] = {"traces": compiled.traces, "eager_fallback": compiled._eager}
+        # generation serving: apps that set model.generation_batcher (e.g. the
+        # text-generation template's shared ContinuousBatcher) surface slot
+        # utilization, shared-dispatch counts, and speculative acceptance here
+        batcher = getattr(self.model, "generation_batcher", None)
+        if batcher is not None and hasattr(batcher, "stats"):
+            snapshot["generation"] = batcher.stats()
         return 200, snapshot, "application/json"
 
     async def _predict(self, body: bytes):

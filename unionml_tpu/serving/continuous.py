@@ -293,6 +293,26 @@ class ContinuousBatcher:
 
         return tokens()
 
+    def stats(self) -> Dict[str, Any]:
+        """Utilization snapshot for ``/metrics``: resident/waiting streams,
+        shared-dispatch counters, and (speculative mode) realized acceptance."""
+        with self._lock:
+            snapshot: Dict[str, Any] = {
+                "slots": self.slots,
+                "resident": len(self._sessions),
+                "waiting": len(self._pending),
+                "decode_dispatches": self.decode_dispatches,
+                "rows_per_dispatch": round(
+                    self.decoded_rows / self.decode_dispatches, 3
+                ) if self.decode_dispatches else None,
+                "speculative": self._spec is not None,
+            }
+            if self._spec is not None and self._spec.rounds:
+                snapshot["acceptance_rate"] = round(
+                    self._spec.accepted_tokens / (self._spec.rounds * self._spec.gamma), 3
+                )
+            return snapshot
+
     def close(self, wait: bool = True) -> None:
         """Stop admitting new requests, DRAIN resident streams to completion,
         then stop the engine. Never-admitted pending requests get a clean
@@ -472,13 +492,14 @@ class ContinuousBatcher:
         out_np = np.asarray(state[6])  # also fences the dispatch
         prod_np = np.asarray(state[5])
         done_np = np.asarray(state[4])
-        # fold the ride-along counters into the engine's acceptance telemetry
-        # (they accumulate across dispatches inside the carry; add the delta)
         rounds_total, accepted_total = int(state[7]), int(state[8])
-        spec.rounds += rounds_total - self._spec_rounds_seen
-        spec.accepted_tokens += accepted_total - self._spec_accepted_seen
-        self._spec_rounds_seen, self._spec_accepted_seen = rounds_total, accepted_total
         with self._lock:
+            # fold the ride-along counters into the engine's acceptance
+            # telemetry under the lock, so a concurrent stats() snapshot never
+            # sees rounds advanced without the matching accepted count
+            spec.rounds += rounds_total - self._spec_rounds_seen
+            spec.accepted_tokens += accepted_total - self._spec_accepted_seen
+            self._spec_rounds_seen, self._spec_accepted_seen = rounds_total, accepted_total
             self.decode_dispatches += 1
             self.decoded_rows += len(self._sessions)
             for slot in list(self._sessions):

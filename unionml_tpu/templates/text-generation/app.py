@@ -170,6 +170,7 @@ def _continuous_for(state: train_state.TrainState):
             _continuous.clear()
             batcher = ContinuousBatcher(_generator_for(state), slots=4, decode_chunk=8)
             _continuous[id(state)] = batcher
+            model.generation_batcher = batcher  # surfaces utilization on /metrics
         return batcher
 
 
