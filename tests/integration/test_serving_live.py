@@ -132,3 +132,61 @@ def test_http_keep_alive_serves_multiple_requests_per_connection(sklearn_model):
         assert b"Connection: keep-alive" in headers1
         headers2, body2 = http_get(sock, "/metrics")
         assert b"200 OK" in headers2.split(b"\r\n")[0]
+
+
+def test_client_disconnect_releases_continuous_slot(sklearn_model):
+    """A client that drops its /predict-stream connection mid-generation must
+    release its ContinuousBatcher slot (the server acloses the payload, the
+    route closes the predictor iterator, the engine frees the slot) — otherwise
+    a single flaky client permanently burns a decode slot."""
+    import jax
+    import jax.numpy as jnp
+
+    from unionml_tpu.models import GenerationConfig, Generator, Llama, LlamaConfig
+    from unionml_tpu.serving import ContinuousBatcher
+
+    config = LlamaConfig.tiny(
+        vocab_size=61, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, hidden_dim=128,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    module = Llama(config)
+    params = module.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    gen = Generator(
+        module, params,
+        GenerationConfig(max_new_tokens=512, temperature=0.0, prompt_buckets=(16,)),
+    )
+    batcher = ContinuousBatcher(gen, slots=1, decode_chunk=2)
+
+    sklearn_model.train(hyperparameters={"max_iter": 200})
+
+    @sklearn_model.stream_predictor
+    def stream_predictor(model_object, features):
+        for chunk in batcher.submit([3, 1, 4, 1, 5]):
+            yield chunk.tolist()
+
+    sklearn_model.generation_batcher = batcher
+    app = serving_app(sklearn_model)
+    host, port = _boot(app)
+    try:
+        body = json.dumps({"features": [{"x": 1.0}]}).encode()
+        request = (
+            f"POST /predict-stream HTTP/1.1\r\nHost: x\r\nConnection: close\r\n"
+            f"Content-Length: {len(body)}\r\n\r\n"
+        ).encode() + body
+        sock = socket.create_connection((host, port), timeout=10)
+        sock.sendall(request)
+        sock.recv(4096)  # headers + first chunk(s): generation is underway
+        assert batcher.stats()["resident"] == 1
+        sock.close()  # client walks away mid-stream (budget 512 ~= forever)
+
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if batcher.stats()["resident"] == 0:
+                break
+            time.sleep(0.2)
+        assert batcher.stats()["resident"] == 0, "slot leaked after disconnect"
+        # the freed slot admits new work
+        out = list(batcher.submit([9, 2], max_new_tokens=4))
+        assert sum(len(c) for c in out) == 4
+    finally:
+        batcher.close()

@@ -319,3 +319,96 @@ def test_speculative_continuous_eos_and_budget(tiny_gen):
         assert short == expected[1][:2]
     finally:
         batcher.close()
+
+
+def test_cancelled_stream_frees_slot_for_waiters(tiny_gen):
+    """Closing a stream's iterator (the client-disconnect path) releases its
+    slot at the next chunk boundary; a queued request takes it and the
+    remaining streams are unaffected."""
+    module, params = tiny_gen
+    cfg = GenerationConfig(max_new_tokens=24, temperature=0.0, prompt_buckets=(16,))
+    expected = _sequential_expected(module, params, cfg, PROMPTS[:3])
+
+    batcher = ContinuousBatcher(Generator(module, params, cfg), slots=1, decode_chunk=2)
+    try:
+        doomed = batcher.submit(PROMPTS[0])
+        next(doomed)  # ensure it is admitted and producing
+        doomed.close()  # consumer walks away mid-generation
+        # the slot must come back: these would hang forever if it leaked
+        out1 = _drain(batcher.submit(PROMPTS[1]))
+        out2 = _drain(batcher.submit(PROMPTS[2]))
+        assert [out1, out2] == expected[1:3]
+        # the cancelled session is gone from the books
+        stats = batcher.stats()
+        assert stats["resident"] == 0 and stats["waiting"] == 0
+    finally:
+        batcher.close()
+
+
+def test_cancel_while_pending_dequeues(tiny_gen):
+    """close() on a stream abandoned BEFORE admission (never nexted — the
+    generator-close blind spot _TokenStream exists for) dequeues it: it is
+    never admitted, never decodes to a dead queue, and drains as an empty
+    stream."""
+    module, params = tiny_gen
+    cfg = GenerationConfig(max_new_tokens=16, temperature=0.0, prompt_buckets=(16,))
+    expected = _sequential_expected(module, params, cfg, PROMPTS[:2])
+    batcher = ContinuousBatcher(Generator(module, params, cfg), slots=1, decode_chunk=2)
+    try:
+        first = batcher.submit(PROMPTS[0])
+        next(first)  # occupies the single slot
+        queued = batcher.submit(PROMPTS[1])  # waits for the slot
+        assert batcher.stats()["waiting"] == 1
+        queued.close()  # abandoned before admission, without a single next()
+        assert batcher.stats()["waiting"] == 0  # dequeued immediately
+        assert _drain(queued) == []  # ends cleanly, no tokens
+        rest = _drain(first)
+        # the abandoned request was never admitted: after `first` finishes the
+        # engine goes idle instead of decoding the ghost
+        assert batcher.stats()["resident"] == 0
+        import time as _time
+
+        idle_dispatches = batcher.decode_dispatches
+        _time.sleep(1.0)
+        assert batcher.decode_dispatches == idle_dispatches  # no ghost decoding
+        again = _drain(batcher.submit(PROMPTS[1]))
+        assert again == expected[1]
+    finally:
+        batcher.close()
+
+
+def test_cancel_during_prefill_window_returns_slot(tiny_gen):
+    """A cancel landing while the engine is inside the UNLOCKED prefill (the
+    session is neither pending nor resident) must not register the dead
+    session: the freshly activated row is masked back out and the slot is
+    immediately reusable."""
+    import time as _time
+
+    module, params = tiny_gen
+    cfg = GenerationConfig(max_new_tokens=16, temperature=0.0, prompt_buckets=(16,))
+    expected = _sequential_expected(module, params, cfg, PROMPTS[:2])
+    batcher = ContinuousBatcher(Generator(module, params, cfg), slots=1, decode_chunk=2)
+    try:
+        entered, gate = threading.Event(), threading.Event()
+        orig = batcher._prefill_row
+
+        def slow_prefill(prompt, seed, gen=None):
+            entered.set()
+            gate.wait(timeout=30)
+            return orig(prompt, seed, gen=gen)
+
+        batcher._prefill_row = slow_prefill
+        stream = batcher.submit(PROMPTS[0])
+        assert entered.wait(timeout=30)  # engine is inside the prefill window
+        stream.close()  # cancel lands while neither pending nor resident
+        gate.set()
+        assert _drain(stream) == []
+        batcher._prefill_row = orig
+
+        # the slot came back and serves a fresh request exactly
+        out = _drain(batcher.submit(PROMPTS[1]))
+        assert out == expected[1]
+        stats = batcher.stats()
+        assert stats["resident"] == 0 and stats["waiting"] == 0
+    finally:
+        batcher.close()

@@ -268,10 +268,23 @@ class ServingApp:
             raise HTTPError(500, f"stream predictor failed: {type(exc).__name__}: {exc}")
 
         async def chunks():
-            item = first
-            while item is not sentinel:
-                yield (json.dumps(_to_jsonable(item), default=str) + "\n").encode()
-                item = await loop.run_in_executor(None, next, iterator, sentinel)
+            completed = False
+            try:
+                item = first
+                while item is not sentinel:
+                    yield (json.dumps(_to_jsonable(item), default=str) + "\n").encode()
+                    item = await loop.run_in_executor(None, next, iterator, sentinel)
+                completed = True
+            finally:
+                # the server acloses this generator when the client goes away;
+                # closing the underlying iterator releases the producer (e.g. a
+                # ContinuousBatcher slot stops decoding to a dead connection).
+                # A normally-exhausted iterator needs no close — skip the
+                # executor round-trip on the happy path.
+                if not completed:
+                    close = getattr(iterator, "close", None)
+                    if close is not None:
+                        await loop.run_in_executor(None, _close_iterator, close)
 
         return 200, chunks(), "application/x-ndjson"
 
@@ -286,6 +299,32 @@ class ServingApp:
         """In-process request dispatch — the test-client surface."""
         self.startup()
         return await self.server.dispatch(method, path, body)
+
+
+def _close_iterator(close) -> None:
+    """Close a stream-predictor iterator, tolerating an in-flight ``next()``:
+    a disconnect can race the executor thread still blocked on the next chunk,
+    in which case a GENERATOR's ``close()`` raises "already executing" — retry
+    until that call returns. The wait is bounded by the producer's chunk
+    cadence, which through a tunneled TPU backend can include a multi-minute
+    first-dispatch compile, hence the generous cap. (ContinuousBatcher streams
+    are plain objects whose close works immediately — no retry needed.)"""
+    import time
+
+    for _ in range(600):
+        try:
+            close()
+            return
+        # CPython raises ValueError("generator already executing") from
+        # gen.close() against a generator blocked in next() on another thread
+        # (RuntimeError kept for alternative iterator implementations)
+        except (RuntimeError, ValueError) as exc:
+            if "already executing" not in str(exc):
+                # a cleanup failure, not the in-flight race: retrying won't help
+                logger.warning(f"stream iterator close failed: {exc}")
+                return
+            time.sleep(0.2)
+    logger.warning("could not close stream iterator after disconnect; producer may leak")
 
 
 def _to_jsonable(obj: Any) -> Any:

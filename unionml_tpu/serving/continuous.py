@@ -73,6 +73,42 @@ class _Session:
     finished: bool = False
 
 
+class _TokenStream:
+    """The iterator :meth:`ContinuousBatcher.submit` returns.
+
+    A plain class rather than a generator on purpose: generator ``close()``
+    cannot reach a request abandoned before its first ``next()`` (the body
+    never ran) and raises "already executing" against one blocked mid-``next``
+    — this ``close`` is callable from any thread at any time and cancels the
+    session directly. Dropping the last reference also cancels (``__del__``),
+    so streams abandoned inside wrapping generators are released by refcount.
+    """
+
+    def __init__(self, batcher: "ContinuousBatcher", session: _Session):
+        self._batcher = batcher
+        self._session = session
+
+    def __iter__(self) -> "Iterator[np.ndarray]":
+        return self
+
+    def __next__(self) -> np.ndarray:
+        item = self._session.out.get()
+        if item is _SENTINEL:
+            raise StopIteration
+        if isinstance(item, BaseException):
+            raise item
+        return item
+
+    def close(self) -> None:
+        self._batcher._cancel(self._session)
+
+    def __del__(self):  # pragma: no cover - refcount backstop
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
 class ContinuousBatcher:
     """Share decode dispatches across concurrent generation requests.
 
@@ -135,6 +171,7 @@ class ContinuousBatcher:
         self._pending: "List[tuple]" = []  # (prompt, session) awaiting a free slot
         self._sessions: Dict[int, _Session] = {}
         self._free = list(range(slots))
+        self._cancelled: "List[_Session]" = []  # resident sessions whose consumer went away
         self._closed = False
         self._carry: Optional[tuple] = None  # (cache, tok, lengths, done, key)
         self._seed = 0
@@ -282,16 +319,36 @@ class ContinuousBatcher:
                 self._thread.start()
             self._lock.notify_all()
 
-        def tokens() -> Iterator[np.ndarray]:
-            while True:
-                item = session.out.get()
-                if item is _SENTINEL:
-                    return
-                if isinstance(item, BaseException):
-                    raise item
-                yield item
+        return _TokenStream(self, session)
 
-        return tokens()
+    def _cancel(self, session: _Session) -> None:
+        """Stop producing for a session whose consumer went away. Safe from any
+        thread and at any lifecycle point: pending sessions are dequeued here;
+        RESIDENT slots are flagged and the engine (sole device-state owner)
+        frees + masks them at the next chunk boundary. A sentinel is pushed so
+        a reader blocked in ``__next__`` returns promptly."""
+        with self._lock:
+            if session.finished:
+                return
+            session.finished = True
+            if any(s is session for _, s in self._pending):
+                self._pending = [(p, s) for p, s in self._pending if s is not session]
+            elif session.slot >= 0 and self._sessions.get(session.slot) is session:
+                self._cancelled.append(session)
+            session.out.put(_SENTINEL)
+            self._lock.notify_all()
+
+    def _apply_cancellations_locked(self) -> None:
+        """Engine thread: free and done-mask slots whose consumers disconnected
+        (caller holds the lock). Identity-checked against the resident session —
+        a slot that meanwhile finished normally and was re-admitted to a new
+        request must not have its new tenant evicted by the stale cancel."""
+        cancelled, self._cancelled = self._cancelled, []
+        for session in cancelled:
+            if self._sessions.get(session.slot) is session:
+                self._sessions.pop(session.slot)
+                self._free.append(session.slot)
+                self._mask_slot_done(session.slot)
 
     def stats(self) -> Dict[str, Any]:
         """Utilization snapshot for ``/metrics``: resident/waiting streams,
@@ -332,6 +389,7 @@ class ContinuousBatcher:
                 with self._lock:
                     while not self._closed and not self._pending and not self._sessions:
                         self._lock.wait()
+                    self._apply_cancellations_locked()
                     if self._closed:
                         # no new admissions; residents drain to completion
                         for _, session in self._pending:
@@ -411,6 +469,14 @@ class ContinuousBatcher:
                 )
                 self._carry = (t_cache, d_cache, tok, lengths, done, produced, out_buf, rounds, acc, key)
             with self._lock:
+                if session.finished:
+                    # cancelled during the unlocked prefill window (neither
+                    # pending nor resident at _cancel time): the device row was
+                    # just activated — mask it back out and return the slot
+                    # instead of decoding a full budget to a dead queue
+                    self._free.append(slot)
+                    self._mask_slot_done(slot)
+                    continue
                 session.out.put(first)
                 session.produced = 1
                 self._sessions[slot] = session
