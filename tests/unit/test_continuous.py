@@ -412,3 +412,29 @@ def test_cancel_during_prefill_window_returns_slot(tiny_gen):
         assert stats["resident"] == 0 and stats["waiting"] == 0
     finally:
         batcher.close()
+
+
+def test_warmup_compiles_every_bucket_then_serves_exactly(tiny_gen):
+    """warmup() drives a bucket-FILLING request through each prompt bucket plus
+    one decode chunk and resets the counters; real traffic afterwards is exact,
+    starts from clean metrics, and — the point — triggers NO new prefill or
+    decode traces in any bucket."""
+    module, params = tiny_gen
+    cfg = GenerationConfig(max_new_tokens=8, temperature=0.0, prompt_buckets=(8, 16))
+    prompts = [PROMPTS[0], [5] * 12]  # land in bucket 8 and bucket 16
+    expected = _sequential_expected(module, params, cfg, prompts)
+    gen = Generator(module, params, cfg)
+    batcher = ContinuousBatcher(gen, slots=2, decode_chunk=3)
+    try:
+        batcher.warmup()
+        stats = batcher.stats()
+        assert stats["decode_dispatches"] == 0 and stats["resident"] == 0
+        prefill_traces = gen.prefill_traces
+        decode_traces = gen.decode_traces
+        results = [_drain(batcher.submit(p)) for p in prompts]
+        assert results == expected
+        assert batcher.decode_dispatches > 0
+        assert gen.prefill_traces == prefill_traces  # both buckets pre-compiled
+        assert gen.decode_traces == decode_traces  # decode chunk pre-compiled
+    finally:
+        batcher.close()

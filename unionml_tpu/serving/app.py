@@ -111,16 +111,23 @@ class ServingApp:
         at startup keeps request p50 flat.
         """
         config = getattr(self.model, "_predictor_config", None)
-        if not isinstance(config, ServingConfig) or not config.warmup:
-            return
-        warmup_fn = getattr(self.model, "_predictor_warmup", None)
-        if warmup_fn is None:
-            return
-        for bucket in config.buckets():
+        if isinstance(config, ServingConfig) and config.warmup:
+            warmup_fn = getattr(self.model, "_predictor_warmup", None)
+            if warmup_fn is not None:
+                for bucket in config.buckets():
+                    try:
+                        warmup_fn(bucket)
+                    except Exception as exc:  # warmup is best-effort
+                        logger.warning(f"predictor warmup failed for bucket {bucket}: {exc}")
+        # generation apps register a callable (e.g. building + warming their
+        # ContinuousBatcher) to run once at startup, after the artifact loads —
+        # first streams then skip the cold compiles
+        gen_warmup = getattr(self.model, "generation_warmup", None)
+        if callable(gen_warmup):
             try:
-                warmup_fn(bucket)
+                gen_warmup()
             except Exception as exc:  # warmup is best-effort
-                logger.warning(f"predictor warmup failed for bucket {bucket}: {exc}")
+                logger.warning(f"generation warmup failed: {exc}")
 
     _FEATURES_ENVELOPE = re.compile(rb'\A\s*\{\s*"features"\s*:\s*(?=\[)')
 

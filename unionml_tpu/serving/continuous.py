@@ -236,7 +236,10 @@ class ContinuousBatcher:
         tok = jnp.zeros((self.slots,), jnp.int32)
         lengths = jnp.ones((self.slots,), jnp.int32)
         done = jnp.ones((self.slots,), bool)  # every slot starts free (= masked out)
-        key = jax.random.PRNGKey(self._seed)
+        # built inside jit so the key's sharding provenance matches the decode
+        # outputs it cycles through (an eager key carries SingleDeviceSharding,
+        # jit outputs NamedSharding)
+        key = jax.jit(jax.random.PRNGKey)(self._seed)
         if self._spec is None:
             return (cache, tok, lengths, done, key)
         draft_gen = self._spec._draft
@@ -349,6 +352,52 @@ class ContinuousBatcher:
                 self._sessions.pop(session.slot)
                 self._free.append(session.slot)
                 self._mask_slot_done(session.slot)
+
+    def warmup(self) -> None:
+        """AOT-compile the admission/prefill/decode programs before traffic
+        arrives, so the first real request never pays a cold XLA compile (tens
+        of seconds on TPU — the same rationale as CompiledPredictor's startup
+        warmup). A bucket-FILLING request runs through each prompt bucket
+        (budget 1: admission only — each bucket is its own prefill shape), then
+        a short request exercises one decode/round chunk (the decode program is
+        bucket-independent). Counters are reset afterwards so ``/metrics``
+        reflects real traffic only."""
+        cfg = self.gen.config
+        for bucket in sorted(cfg.prompt_buckets):
+            # length == bucket: _bucket() maps shorter prompts to the smallest
+            # fitting bucket, which would leave the larger shapes cold
+            prompt = [cfg.pad_id + 1] * bucket
+            for _ in self.submit(prompt, max_new_tokens=1):
+                pass
+        if cfg.max_new_tokens >= 2:
+            # an eos-emitting model can finish a junk prompt at admission
+            # (start_done) without ever decoding — vary the prompt a few times.
+            # TWO decode dispatches are needed: the very first runs on the
+            # freshly initialized carry, whose jit signature differs subtly
+            # from the steady-state (decode-output) carry and compiles
+            # separately; the second covers what real traffic sees.
+            vocab = int(getattr(self.gen.module.config, "vocab_size", 2))
+            for salt in range(6):
+                if self.decode_dispatches >= 2:
+                    break
+                tok = 1 + (cfg.pad_id + salt) % max(vocab - 1, 1)
+                for _ in self.submit([tok], max_new_tokens=2):
+                    pass
+            if self.decode_dispatches < 2:
+                logger.warning(
+                    "warmup never reached the steady-state decode program (eos "
+                    "at admission for every probe prompt); the first streams "
+                    "may pay a compile"
+                )
+        with self._lock:
+            self.decode_dispatches = 0
+            self.decoded_rows = 0
+            if self._spec is not None:
+                # the carry's device-side ride-along counters are NOT reset;
+                # the high-water marks already equal them, so future deltas
+                # accumulate onto the zeroed telemetry correctly
+                self._spec.rounds = 0
+                self._spec.accepted_tokens = 0
 
     def stats(self) -> Dict[str, Any]:
         """Utilization snapshot for ``/metrics``: resident/waiting streams,

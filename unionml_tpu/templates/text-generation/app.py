@@ -174,6 +174,16 @@ def _continuous_for(state: train_state.TrainState):
         return batcher
 
 
+def _generation_warmup() -> None:
+    """Startup hook (run by model.serve() after the artifact loads): build the
+    shared batcher and AOT-compile its prefill/admission/decode programs so the
+    first real stream never pays the cold XLA compile."""
+    _continuous_for(model.artifact.model_object).warmup()
+
+
+model.generation_warmup = _generation_warmup
+
+
 @model.stream_predictor
 def stream_predictor(state: train_state.TrainState, features: List[str]):
     """POST /predict-stream: yields per-prompt text pieces as they decode —
