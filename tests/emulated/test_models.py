@@ -57,6 +57,21 @@ def test_flash_attention_gradients_match():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
 
 
+def test_flash_attention_custom_blocks_gradients_match():
+    """blocks= threads through the BACKWARD too: q_len=192 tiles under (64, 64)
+    but not under the defaults, so a backward that ignored the override would
+    either leave tail rows unwritten (round-3 behavior) or now raise — the
+    gradients must match the XLA reference across the full length."""
+    q, k, v = (jax.random.normal(jax.random.PRNGKey(i), (1, 192, 2, 128)) for i in range(3))
+    g = jax.grad(
+        lambda *a: flash_attention(*a, causal=True, interpret=True, blocks=(64, 64)).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    g_ref = jax.grad(lambda *a: dot_product_attention(*a, causal=True).sum(), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
 def test_flash_attention_gqa_gradients_group_sum():
     """The fused backward computes dk/dv at query-head resolution then group-sums
     for GQA (repeat's transpose); gradients must match the head-repeating XLA

@@ -250,6 +250,31 @@ def test_shared_prefix_across_slots(tiny_gen):
         batcher.close()
 
 
+def test_prefix_with_oversized_prefill_chunk(tiny_gen):
+    """cache_len must cover the chunk-ALIGNED prefill width: with prefill_chunk
+    larger than bucket + budget + decode_chunk, the offset chunked prefill
+    writes [p0, p0 + aligned) — round-3 sizing stopped at the budget tail, so
+    dynamic_update_slice clamping silently corrupted earlier cache positions
+    (ADVICE r3). The oracle would catch the corruption; the sizing assert pins
+    the fix directly."""
+    module, params = tiny_gen
+    cfg = GenerationConfig(
+        max_new_tokens=6, temperature=0.0, prompt_buckets=(8,), prefill_chunk=32
+    )
+    prefix = [7, 7]
+    suffixes = [[3, 1, 4], [9, 2, 6, 5, 8, 1]]
+    expected = _sequential_expected(module, params, cfg, [prefix + s for s in suffixes])
+
+    gen = Generator(module, params, cfg)
+    batcher = ContinuousBatcher(gen, slots=2, decode_chunk=3, prefix=gen.cache_prefix(prefix))
+    try:
+        assert batcher.cache_len >= len(prefix) + 32  # the aligned write fits
+        results = [_drain(batcher.submit(s)) for s in suffixes]
+        assert results == expected
+    finally:
+        batcher.close()
+
+
 def _draft_for(vocab):
     cfg = LlamaConfig.tiny(
         vocab_size=vocab, dim=32, n_layers=1, n_heads=4, n_kv_heads=2, hidden_dim=64,

@@ -101,6 +101,15 @@ class GenerationConfig:
     draft: Optional["DraftSpec"] = dataclasses.field(default=None, compare=False, repr=False)
 
 
+def chunk_aligned(length: int, chunk: int) -> int:
+    """Round ``length`` up to a multiple of ``chunk`` — the width a chunked
+    prefill actually pads to and writes. Every cache sized to receive a chunked
+    prefill must use THIS width (not the raw bucket), so the sizing rule lives
+    in one place (round 3 had a hand-copied variant drift and clamp-corrupt
+    cache rows in continuous batching)."""
+    return -(-length // chunk) * chunk
+
+
 def init_cache(config: Any, batch: int, cache_len: int, kv_dtype: Optional[str] = None) -> Tuple[Any, ...]:
     """Zeroed per-layer KV buffers for a decoder with ``config.n_layers`` layers,
     ``config.n_kv_heads`` KV heads and head_dim ``dim // n_heads``, stored in the
@@ -548,11 +557,11 @@ class Generator:
             return self._start_with_prefix(prefix, tokens, lengths, batch, n, bucket, extra_cache, seed)
         if sp:
             seq = int(self.mesh.shape["sequence"])
-            aligned = -(-bucket // seq) * seq  # each sequence shard gets equal columns
+            aligned = chunk_aligned(bucket, seq)  # each sequence shard gets equal columns
             tokens = np.pad(tokens, ((0, 0), (0, aligned - tokens.shape[1])), constant_values=cfg.pad_id)
             bucket = aligned
         elif chunk:
-            bucket = -(-bucket // chunk) * chunk  # chunk-aligned; bucket shape is moot
+            bucket = chunk_aligned(bucket, chunk)  # bucket shape is moot once chunked
             tokens = np.pad(tokens, ((0, 0), (0, bucket - tokens.shape[1])), constant_values=cfg.pad_id)
         cache_len = max(bucket, max(cfg.prompt_buckets, default=0)) + cfg.max_new_tokens + extra_cache
         cache = self._place_cache(
@@ -622,7 +631,7 @@ class Generator:
         cfg = self.config
         p0 = prefix.length
         chunk = cfg.prefill_chunk or bucket
-        aligned = -(-bucket // chunk) * chunk
+        aligned = chunk_aligned(bucket, chunk)
         if aligned > tokens.shape[1]:
             tokens = np.pad(
                 tokens, ((0, 0), (0, aligned - tokens.shape[1])), constant_values=cfg.pad_id

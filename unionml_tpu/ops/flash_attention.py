@@ -286,14 +286,18 @@ def _flash_bwd_dkv_kernel(
         dv_ref[0, :, 0, :] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, out, lse, g, causal: bool, interpret: bool):
+def _flash_backward(q, k, v, out, lse, g, causal: bool, interpret: bool, blocks=None):
     """FlashAttention-2-style fused backward: scores recomputed blockwise from the
     saved logsumexp — the [L, L] matrix never touches HBM (the XLA autodiff
-    fallback materializes it, erasing the forward's memory win for training)."""
+    fallback materializes it, erasing the forward's memory win for training).
+    ``blocks`` follows the forward's override so a shape legal under custom
+    forward tiles can never leave backward tail rows unwritten."""
     batch, q_len, n_heads, head_dim = q.shape
     k_len, n_kv = k.shape[1], k.shape[2]
-    block_q = min(DEFAULT_BLOCK_Q, q_len)
-    block_k = min(DEFAULT_BLOCK_K, k_len)
+    block_q = min((blocks or (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K))[0], q_len)
+    block_k = min((blocks or (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K))[1], k_len)
+    if q_len % block_q or k_len % block_k:
+        raise ValueError(f"blocks ({block_q}, {block_k}) do not tile lengths ({q_len}, {k_len})")
     scale = head_dim**-0.5
     offset = k_len - q_len
 
@@ -387,7 +391,7 @@ def _flash_fwd_rule(q, k, v, causal, interpret, blocks):
 
 def _flash_bwd_rule(causal, interpret, blocks, residuals, g):
     q, k, v, out, lse = residuals
-    return _flash_backward(q, k, v, out, lse, g, causal, interpret)
+    return _flash_backward(q, k, v, out, lse, g, causal, interpret, blocks)
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)

@@ -291,7 +291,7 @@ class ServingApp:
                 if not completed:
                     close = getattr(iterator, "close", None)
                     if close is not None:
-                        await loop.run_in_executor(None, _close_iterator, close)
+                        await _close_iterator(loop, close)
 
         return 200, chunks(), "application/x-ndjson"
 
@@ -308,19 +308,24 @@ class ServingApp:
         return await self.server.dispatch(method, path, body)
 
 
-def _close_iterator(close) -> None:
+async def _close_iterator(loop, close) -> None:
     """Close a stream-predictor iterator, tolerating an in-flight ``next()``:
     a disconnect can race the executor thread still blocked on the next chunk,
     in which case a GENERATOR's ``close()`` raises "already executing" — retry
     until that call returns. The wait is bounded by the producer's chunk
     cadence, which through a tunneled TPU backend can include a multi-minute
-    first-dispatch compile, hence the generous cap. (ContinuousBatcher streams
-    are plain objects whose close works immediately — no retry needed.)"""
-    import time
-
-    for _ in range(600):
+    first-dispatch compile — the exponential backoff (0.2s doubling to 5s,
+    ~20 min total) outlives even that worst case, so a disconnect during the
+    compile window still releases the producer. Each ``close()`` attempt is a
+    fast executor call and every wait happens on the EVENT LOOP, so no executor
+    thread is parked for the duration — a pile-up of disconnected clients can't
+    starve the shared default executor that live streams advance on.
+    (ContinuousBatcher streams are plain objects whose close works immediately
+    — no retry needed.)"""
+    delay, waited = 0.2, 0.0
+    while True:
         try:
-            close()
+            await loop.run_in_executor(None, close)
             return
         # CPython raises ValueError("generator already executing") from
         # gen.close() against a generator blocked in next() on another thread
@@ -330,7 +335,11 @@ def _close_iterator(close) -> None:
                 # a cleanup failure, not the in-flight race: retrying won't help
                 logger.warning(f"stream iterator close failed: {exc}")
                 return
-            time.sleep(0.2)
+            if waited >= 1200.0:
+                break
+            await asyncio.sleep(delay)
+            waited += delay
+            delay = min(delay * 2, 5.0)
     logger.warning("could not close stream iterator after disconnect; producer may leak")
 
 

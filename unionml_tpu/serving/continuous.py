@@ -55,7 +55,13 @@ import jax
 import jax.numpy as jnp
 
 from unionml_tpu._logging import logger
-from unionml_tpu.models.generate import Generator, PrefixCache, _paste_prefix_rows, init_cache
+from unionml_tpu.models.generate import (
+    Generator,
+    PrefixCache,
+    _paste_prefix_rows,
+    chunk_aligned,
+    init_cache,
+)
 
 __all__ = ["ContinuousBatcher"]
 
@@ -161,12 +167,19 @@ class ContinuousBatcher:
         #: plus overshoot: one chunk of decode, or one round's gamma+1 verify
         #: writes in speculative mode (which never runs the plain decode)
         overshoot = (self._spec.gamma + 1) if self._spec is not None else decode_chunk
-        self.cache_len = (
-            (prefix.length if prefix is not None else 0)
-            + max(cfg.prompt_buckets, default=64)
-            + cfg.max_new_tokens
-            + overshoot
-        )
+        p0 = prefix.length if prefix is not None else 0
+        widest = max(cfg.prompt_buckets, default=64)
+        self.cache_len = p0 + widest + cfg.max_new_tokens + overshoot
+        if prefix is not None and cfg.prefill_chunk:
+            # the offset chunked prefill pads each bucket to a chunk multiple and
+            # writes that full aligned width at [p0, p0+aligned) — with a large
+            # prefill_chunk that can reach past the budget-sized tail, so size
+            # for the widest aligned bucket too (the same rule
+            # Generator._start_with_prefix applies to its own cache_len)
+            aligned = max(
+                chunk_aligned(b, cfg.prefill_chunk) for b in (cfg.prompt_buckets or (widest,))
+            )
+            self.cache_len = max(self.cache_len, p0 + aligned)
         self._lock = threading.Condition()
         self._pending: "List[tuple]" = []  # (prompt, session) awaiting a free slot
         self._sessions: Dict[int, _Session] = {}
@@ -279,7 +292,11 @@ class ContinuousBatcher:
         row_valid = jnp.ones((1,), bool)
         if self.prefix is not None:
             chunk = cfg.prefill_chunk or bucket
-            aligned = -(-bucket // chunk) * chunk  # ragged tails would cost one
+            aligned = chunk_aligned(bucket, chunk)  # ragged tails would cost one
+            if p0 + aligned > self.cache_len:  # __init__ sizes for every bucket;
+                raise ValueError(  # this guards out-of-set prompt widths
+                    f"chunk-aligned prefill width {aligned} + prefix {p0} exceeds cache_len {self.cache_len}"
+                )
             if aligned > bucket:  # extra prefill compile per bucket remainder
                 tokens = np.pad(tokens, ((0, 0), (0, aligned - bucket)), constant_values=cfg.pad_id)
             row_cache = _paste_prefix_rows(row_cache, self.prefix.layers)
@@ -492,11 +509,15 @@ class ContinuousBatcher:
                     _, _, d_row = self._prefill_row(prompt, seed, gen=self._spec._draft)
             except ValueError as exc:
                 # a bad prompt (e.g. longer than the cache can hold) fails its
-                # own stream; the engine and other residents keep going
+                # own stream; the engine and other residents keep going. The
+                # finished flip + enqueue happen under the lock, mirroring
+                # _cancel's guarded pattern — otherwise a concurrent _cancel
+                # could interleave its sentinel before (or instead of) the error
                 with self._lock:
                     self._free.append(slot)
-                session.finished = True
-                session.out.put(exc)
+                    if not session.finished:
+                        session.finished = True
+                        session.out.put(exc)
                 continue
             if self._carry is None:
                 self._carry = self._init_carry()

@@ -125,7 +125,10 @@ _generators: dict = {}
 
 
 def _generator_for(state: train_state.TrainState) -> Generator:
-    gen = _generators.get(id(state))
+    # Keyed on id(state) but storing (state, gen): the strong ref keeps the
+    # TrainState alive so a freed state's id can never alias a new one.
+    entry = _generators.get(id(state))
+    gen = entry[1] if entry is not None and entry[0] is state else None
     if gen is None:
         gen = Generator(
             module,
@@ -133,7 +136,7 @@ def _generator_for(state: train_state.TrainState) -> Generator:
             GenerationConfig(max_new_tokens=NEW_TOKENS, temperature=0.0, prompt_buckets=(SEQ_LEN,)),
         )
         _generators.clear()  # one live state at a time; drop stale compiled engines
-        _generators[id(state)] = gen
+        _generators[id(state)] = (state, gen)
     return gen
 
 
@@ -163,13 +166,16 @@ def _continuous_for(state: train_state.TrainState):
     from unionml_tpu.serving import ContinuousBatcher
 
     with _continuous_lock:
-        batcher = _continuous.get(id(state))
+        # (state, batcher) pairs: holding the state reference pins its id, so a
+        # replaced-and-collected TrainState can never alias a cache hit.
+        entry = _continuous.get(id(state))
+        batcher = entry[1] if entry is not None and entry[0] is state else None
         if batcher is None:
-            for stale in _continuous.values():
+            for _, stale in _continuous.values():
                 stale.close(wait=False)  # graceful: residents finish, no new joins
             _continuous.clear()
             batcher = ContinuousBatcher(_generator_for(state), slots=4, decode_chunk=8)
-            _continuous[id(state)] = batcher
+            _continuous[id(state)] = (state, batcher)
             model.generation_batcher = batcher  # surfaces utilization on /metrics
         return batcher
 
