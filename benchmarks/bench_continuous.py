@@ -37,12 +37,13 @@ NEW_TOKENS = 12 if _SMALL else 96
 CONCURRENCY = (1, 2, 4) if _SMALL else (1, 2, 4, 8)
 
 
-def run_streams(batcher, prompts) -> int:
+def run_streams(batcher, prompts, budgets=None) -> int:
     """Drive len(prompts) concurrent streams to completion; returns tokens consumed."""
     totals = [0] * len(prompts)
 
     def worker(i: int) -> None:
-        for chunk in batcher.submit(prompts[i]):
+        budget = budgets[i] if budgets is not None else None
+        for chunk in batcher.submit(prompts[i], max_new_tokens=budget):
             totals[i] += int(np.asarray(chunk).size)
 
     threads = [threading.Thread(target=worker, args=(i,)) for i in range(len(prompts))]
@@ -100,6 +101,43 @@ def main() -> None:
             batcher.close()
 
     top = max(CONCURRENCY)
+
+    # ---- paged KV capacity: a realistic mixed workload (half the streams are
+    # short prompts, half use a quarter of the budget) with the pool sized to
+    # the requests' ACTUAL need. Dense slots reserve top x cache_len positions
+    # regardless; the paged pool holds only what the workload uses —
+    # paged_kv_fraction is that ratio, and paged tok/s shows the indirection's
+    # throughput cost (gather/scatter vs contiguous rows).
+    block = 16
+    budgets = [NEW_TOKENS if i % 2 == 0 else max(NEW_TOKENS // 4, 1) for i in range(top)]
+    mixed_prompts = [
+        p if i % 2 == 0 else p[: max(PROMPT_LEN // 8, 1)] for i, p in enumerate(prompts)
+    ]
+    sizer = ContinuousBatcher(
+        Generator(module, params, cfg), slots=top, decode_chunk=8, block_size=block
+    )
+    pool = max(
+        sum(sizer._blocks_needed(mixed_prompts[i], budgets[i]) for i in range(top)),
+        sizer.max_blocks,
+    )
+    dense_kv_positions = top * sizer.cache_len
+    sizer.close()
+    batcher = ContinuousBatcher(
+        Generator(module, params, cfg), slots=top, decode_chunk=8, block_size=block, pool_blocks=pool
+    )
+    try:
+        run_streams(batcher, mixed_prompts[:1])  # compile the paged admit/decode programs
+        with Timer() as t:
+            tokens = run_streams(batcher, mixed_prompts[:top], budgets)
+        paged_rate = tokens / t.elapsed
+        paged_fraction = pool * block / dense_kv_positions
+        log(
+            f"paged: {tokens} tokens in {t.elapsed:.2f}s -> {paged_rate:.0f} tok/s with "
+            f"{pool} blocks of {block} = {paged_fraction:.2f}x the dense KV footprint"
+        )
+    finally:
+        batcher.close()
+
     emit(
         "continuous_batching_aggregate_decode",
         rates[top],
@@ -107,6 +145,8 @@ def main() -> None:
         rates[top] / rates[1] if rates[1] > 0 else 0.0,
         concurrency=top,
         single_stream_tokens_per_s=round(rates[1], 1),
+        paged_tokens_per_s=round(paged_rate, 1),
+        paged_kv_fraction=round(paged_fraction, 3),
     )
 
 

@@ -191,14 +191,28 @@ def generate_cli_page() -> str:
 
 def main() -> None:
     import os
+    import sys
 
     if os.environ.get("JAX_PLATFORMS"):
         import jax
 
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-    (DOCS_DIR / "api-reference.md").write_text(generate_api_page())
-    (DOCS_DIR / "cli-reference.md").write_text(generate_cli_page())
-    print(f"wrote {DOCS_DIR / 'api-reference.md'} and {DOCS_DIR / 'cli-reference.md'}")
+    pages = {
+        DOCS_DIR / "api-reference.md": generate_api_page(),
+        DOCS_DIR / "cli-reference.md": generate_cli_page(),
+    }
+    if "--check" in sys.argv:
+        # freshness gate (pre-commit / CI): the committed pages must match what
+        # the current docstrings generate — drift fails instead of shipping
+        stale = [p.name for p, text in pages.items() if not p.exists() or p.read_text() != text]
+        if stale:
+            print(f"generated docs out of date: {', '.join(stale)} (run: python docs/gen_api.py)")
+            raise SystemExit(1)
+        print("generated docs up to date")
+        return
+    for path, text in pages.items():
+        path.write_text(text)
+    print(f"wrote {' and '.join(str(p) for p in pages)}")
 
 
 if __name__ == "__main__":

@@ -346,6 +346,145 @@ def test_speculative_continuous_eos_and_budget(tiny_gen):
         batcher.close()
 
 
+def test_paged_kv_matches_sequential_with_undersized_pool(tiny_gen):
+    """Paged KV capacity win: requests with small budgets are allocated only the
+    blocks they need, so a pool FAR smaller than slots x worst-case admits a
+    full house concurrently — and every stream is still token-exact against the
+    sequential dense run (paged == contiguous == sequential)."""
+    module, params = tiny_gen
+    cfg = GenerationConfig(max_new_tokens=12, temperature=0.0, prompt_buckets=(16,))
+    expected = _sequential_expected(module, params, cfg, PROMPTS[:4])
+
+    batcher = ContinuousBatcher(
+        Generator(module, params, cfg), slots=4, decode_chunk=4, block_size=8, pool_blocks=10
+    )
+    try:
+        # worst-case sizing would need slots * max_blocks; the pool is smaller
+        assert batcher.pool_blocks < batcher.slots * batcher.max_blocks
+        # every request (budget 4) needs few enough blocks that all 4 fit at once
+        assert 4 * batcher._blocks_needed(PROMPTS[0], 4) <= batcher.pool_blocks
+        results = [None] * 4
+
+        def worker(i):
+            results[i] = _drain(batcher.submit(PROMPTS[i], max_new_tokens=4))
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        assert results == [e[:4] for e in expected]
+        assert batcher.decoded_rows > batcher.decode_dispatches  # dispatches were shared
+        stats = batcher.stats()["kv_blocks"]
+        assert stats == {"total": 10, "used": 0, "block_size": 8}  # all freed
+    finally:
+        batcher.close()
+
+
+def test_paged_kv_pressure_waits_and_stays_exact(tiny_gen):
+    """Pool pressure: with room for only ~2 resident requests, the third waits
+    at the FIFO head until blocks free up — every stream still exact, and the
+    allocator ends balanced."""
+    module, params = tiny_gen
+    cfg = GenerationConfig(max_new_tokens=10, temperature=0.0, prompt_buckets=(16,))
+    expected = _sequential_expected(module, params, cfg, PROMPTS)
+
+    gen = Generator(module, params, cfg)
+    probe = ContinuousBatcher(gen, slots=3, decode_chunk=3, block_size=8)
+    min_pool = probe.max_blocks  # the smallest legal pool: one worst-case request
+    probe.close()
+    batcher = ContinuousBatcher(gen, slots=3, decode_chunk=3, block_size=8, pool_blocks=min_pool)
+    try:
+        results = [None] * len(PROMPTS)
+
+        def worker(i):
+            results[i] = _drain(batcher.submit(PROMPTS[i]))
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(len(PROMPTS))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert results == expected
+        assert batcher.stats()["kv_blocks"]["used"] == 0
+    finally:
+        batcher.close()
+
+
+def test_paged_kv_with_prefix_and_int8(tiny_gen):
+    """Paged KV composes with the shared prefix (prefix rows scatter into each
+    admission's blocks) and the int8 KV cache (quantized pools + scale pools)."""
+    module, params = tiny_gen
+    cfg = GenerationConfig(
+        max_new_tokens=8, temperature=0.0, prompt_buckets=(8, 16), kv_cache_dtype="int8"
+    )
+    prefix = [7, 7, 3, 9, 1, 2]
+    suffixes = [[3, 1, 4], [9, 2, 6, 5], [8]]
+    expected = _sequential_expected(module, params, cfg, [prefix + s for s in suffixes])
+
+    gen = Generator(module, params, cfg)
+    batcher = ContinuousBatcher(
+        gen, slots=2, decode_chunk=3, prefix=gen.cache_prefix(prefix), block_size=8
+    )
+    try:
+        results = [_drain(batcher.submit(s)) for s in suffixes]
+        assert results == expected
+    finally:
+        batcher.close()
+
+
+def test_paged_kv_oversized_prompt_fails_cleanly(tiny_gen):
+    """A prompt whose block need exceeds a table row fails ITS stream without
+    wedging the FIFO; later requests proceed."""
+    module, params = tiny_gen
+    cfg = GenerationConfig(max_new_tokens=6, temperature=0.0, prompt_buckets=(16,))
+    expected = _sequential_expected(module, params, cfg, PROMPTS[:1])
+
+    batcher = ContinuousBatcher(Generator(module, params, cfg), slots=2, decode_chunk=3, block_size=8)
+    try:
+        doomed = batcher.submit(list(range(1, 40)))  # buckets to 64 > cache_len
+        ok = batcher.submit(PROMPTS[0])
+        with pytest.raises(ValueError, match="blocks"):
+            _drain(doomed)
+        assert _drain(ok) == expected[0]
+    finally:
+        batcher.close()
+
+
+def test_speculative_continuous_with_shared_prefix(tiny_gen):
+    """The production trifecta — system prompt (prefix=) + draft model
+    (speculative) + continuous batching — in one engine: every greedy stream
+    equals the sequential plain-Generator run on (prefix + suffix)."""
+    import dataclasses
+
+    from unionml_tpu.models import DraftSpec
+
+    module, params = tiny_gen
+    base = GenerationConfig(max_new_tokens=8, temperature=0.0, prompt_buckets=(8, 16))
+    prefix = [7, 7, 3, 9, 1, 2]
+    suffixes = [[3, 1, 4], [9, 2, 6, 5], [8], [2, 2]]
+    expected = _sequential_expected(module, params, base, [prefix + s for s in suffixes])
+
+    draft, dp = _draft_for(97)
+    cfg = dataclasses.replace(base, draft=DraftSpec(module=draft, params=dp, gamma=3))
+    gen = Generator(module, params, cfg)
+    batcher = ContinuousBatcher(gen, slots=2, decode_chunk=3, prefix=gen.cache_prefix(prefix))
+    try:
+        results = [None] * len(suffixes)
+
+        def worker(i):
+            results[i] = _drain(batcher.submit(suffixes[i]))
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(len(suffixes))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert results == expected
+    finally:
+        batcher.close()
+
+
 def test_cancelled_stream_frees_slot_for_waiters(tiny_gen):
     """Closing a stream's iterator (the client-disconnect path) releases its
     slot at the next chunk boundary; a queued request takes it and the

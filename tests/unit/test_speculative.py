@@ -199,3 +199,41 @@ def test_speculative_stream_matches_call_with_eos():
         hits = np.nonzero(row == eos)[0]
         expected_row = row[: int(hits[0]) + 1] if hits.size else row
         np.testing.assert_array_equal(total, expected_row)
+
+
+def test_speculative_with_prefix_is_exact():
+    """prefix= composes with speculative decoding: both models carry the shared
+    prefix in their caches, and greedy output equals the plain Generator run on
+    the FULL (prefix + suffix) prompts — through the engine and the façade."""
+    import dataclasses
+
+    from unionml_tpu.models import DraftSpec, PrefixCache
+
+    target, tp = _model(0)
+    draft, dp = _model(7, n_layers=1, dim=32)
+    base = GenerationConfig(max_new_tokens=10, temperature=0.0, prompt_buckets=(8, 16))
+    prefix_toks = [5, 11, 2, 9]
+    suffixes = [[3, 1, 4], [9, 2, 6, 5], [8]]
+    expected = Generator(target, tp, base)([prefix_toks + s for s in suffixes])
+
+    spec = SpeculativeGenerator(target, tp, draft, dp, base, gamma=3)
+    prefix = spec._target.cache_prefix(prefix_toks)
+    np.testing.assert_array_equal(spec(suffixes, prefix=prefix), expected)
+    # memoized draft prefix: a second call must not re-prefill the draft
+    built = spec.draft_prefix(prefix)
+    assert spec.draft_prefix(prefix) is built
+
+    # façade: config.draft + prefix= in __call__ AND stream
+    cfg = dataclasses.replace(base, draft=DraftSpec(module=draft, params=dp, gamma=3))
+    gen = Generator(target, tp, cfg)
+    fprefix = gen.cache_prefix(prefix_toks)
+    np.testing.assert_array_equal(gen(suffixes, prefix=fprefix), expected)
+    chunks = list(gen.stream(suffixes, chunk_size=4, prefix=fprefix))
+    totals = [np.concatenate([c[i] for c in chunks]) for i in range(len(suffixes))]
+    for i, row in enumerate(expected):
+        np.testing.assert_array_equal(totals[i], row[: len(totals[i])])
+
+    # a hand-built PrefixCache (no token ids) cannot feed the draft
+    bare = PrefixCache(layers=fprefix.layers, length=fprefix.length)
+    with pytest.raises(ValueError, match="token ids"):
+        gen(suffixes, prefix=bare)

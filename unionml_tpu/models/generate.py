@@ -45,6 +45,7 @@ __all__ = [
     "Generator",
     "PrefixCache",
     "init_cache",
+    "init_paged_cache",
     "sample_tokens",
 ]
 
@@ -137,6 +138,54 @@ def init_cache(config: Any, batch: int, cache_len: int, kv_dtype: Optional[str] 
     )
 
 
+def init_paged_cache(
+    config: Any,
+    slots: int,
+    n_blocks: int,
+    block_size: int,
+    max_blocks: int,
+    kv_dtype: Optional[str] = None,
+    *,
+    fill_block: int,
+) -> Tuple[Any, ...]:
+    """Per-layer PAGED KV buffers: a shared pool of ``n_blocks`` blocks of
+    ``block_size`` positions (``[n_blocks, block_size, H_kv, D]``) plus a
+    ``[slots, max_blocks]`` block table initialized to ``fill_block``.
+    ``fill_block`` is REQUIRED and must be a reserved scratch block (allocate
+    ``n_blocks = real + 1`` and pass ``fill_block = real``, as
+    ``ContinuousBatcher._init_carry`` does): free and finished slots keep
+    issuing one ride-along K/V write per step through their table row, and a
+    default of 0 would scatter that garbage into live block 0. The layer dicts
+    follow :func:`init_cache`'s int8 convention, with the table riding in each
+    layer (same values; a few hundred bytes). See
+    :meth:`unionml_tpu.models.layers.Attention._paged_cached_attention` for the
+    read/write contract; HBM scales with the pool, not slots x worst-case."""
+    head_dim = config.dim // config.n_heads
+    shape = (n_blocks, block_size, config.n_kv_heads, head_dim)
+    # one table PER layer (same values): the cache is donated through admission
+    # and decode, and donating an array aliased across layers is an XLA error
+    # ("donate the same buffer twice"); the duplication is a few hundred bytes
+    table = lambda: jnp.full((slots, max_blocks), fill_block, jnp.int32)  # noqa: E731
+    if kv_dtype == "int8":
+        scale_shape = (n_blocks, block_size, config.n_kv_heads, 1)
+        return tuple(
+            {
+                "k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "k_scale": jnp.zeros(scale_shape, jnp.float32),
+                "v_scale": jnp.zeros(scale_shape, jnp.float32),
+                "table": table(),
+            }
+            for _ in range(config.n_layers)
+        )
+    if kv_dtype is not None:
+        raise ValueError(f"unsupported kv_cache_dtype {kv_dtype!r}; expected None or 'int8'")
+    return tuple(
+        {"k": jnp.zeros(shape, config.dtype), "v": jnp.zeros(shape, config.dtype), "table": table()}
+        for _ in range(config.n_layers)
+    )
+
+
 def _paste_prefix_rows(cache: Any, prefix_layers: Any) -> Any:
     """Broadcast a :class:`PrefixCache`'s ``[1, p0, ...]`` K/V rows into slots
     ``[0, p0)`` of every row of a freshly allocated cache. Jitted (donating the
@@ -217,6 +266,10 @@ class PrefixCache:
 
     layers: Tuple[Any, ...]  # per-layer cache leaves trimmed to [1, length, ...]
     length: int
+    #: the prefix's token ids — kept so engines with a SECOND model (speculative
+    #: decoding's draft) can prefill the same prefix through it; None for
+    #: hand-built caches, which then can't compose with a draft
+    tokens: Optional[Tuple[int, ...]] = None
 
 
 class Generator:
@@ -497,7 +550,9 @@ class Generator:
             raise ValueError("prefix_tokens must be non-empty")
         _, _, _, (cache, _, _, _, _) = self._start([list(prefix_tokens)], 0)
         return PrefixCache(
-            layers=jax.tree_util.tree_map(lambda c: c[:1, :p0], cache), length=p0
+            layers=jax.tree_util.tree_map(lambda c: c[:1, :p0], cache),
+            length=p0,
+            tokens=tuple(int(t) for t in prefix_tokens),
         )
 
     def _start(
@@ -670,9 +725,7 @@ class Generator:
         only they are prefilled. With ``config.draft`` set, decoding runs
         speculatively (same output law, fewer target dispatches)."""
         if self.config.draft is not None:
-            if prefix is not None:
-                raise NotImplementedError("speculative decoding (config.draft) does not compose with prefix= yet")
-            return self._speculative()(prompts, seed=seed)
+            return self._speculative()(prompts, seed=seed, prefix=prefix)
         n, tok0, _, carry = self._start(prompts, seed, prefix=prefix)
         steps = self.config.max_new_tokens - 1
         first = np.asarray(tok0)[:, None]
@@ -823,9 +876,9 @@ class Generator:
         if chunk_size < 1:
             raise ValueError("chunk_size must be >= 1")
         if cfg.draft is not None:
-            if prefix is not None:
-                raise NotImplementedError("speculative decoding (config.draft) does not compose with prefix= yet")
-            yield from self._speculative().stream(prompts, seed=seed, chunk_size=chunk_size)
+            yield from self._speculative().stream(
+                prompts, seed=seed, chunk_size=chunk_size, prefix=prefix
+            )
             return
         # the last chunk may overshoot max_new_tokens; give its cache writes room
         n_chunks = max(0, -(-(cfg.max_new_tokens - 1) // chunk_size))

@@ -170,6 +170,22 @@ class Attention(nn.Module):
                 raise ValueError("cached attention requires per-example positions [B, L]")
             if mask is not None:
                 raise NotImplementedError("cached attention builds its own mask")
+            if "table" in cache:
+                # Paged KV (vLLM-style, static-shape): K/V live in a SHARED pool
+                # of fixed-size blocks ([n_blocks, block_size, H_kv, D]) and each
+                # batch row owns a block-table row mapping its logical positions
+                # to pool blocks — HBM scales with the pool, not with
+                # batch x worst-case length. Writes scatter through the table
+                # (position p -> block table[b, p // bs], offset p % bs); reads
+                # gather pool[table] back into the logical [B, MB * bs] layout,
+                # so the visibility mask — and therefore the numerics — are
+                # IDENTICAL to the contiguous branch below. Table rows of
+                # finished/free slots are repointed to a scratch block by the
+                # engine that owns the pool (see serving/continuous.py), which
+                # is what makes their ride-along writes harmless.
+                out, cache = self._paged_cached_attention(q, k, v, positions, cache)
+                out = out.reshape(batch, length, self.n_heads * head_dim)
+                return dense(features, "o_proj")(out), cache
             starts = positions[:, 0]
             if "k_scale" in cache:
                 # int8 KV cache: symmetric per-(position, head) quantization on
@@ -217,6 +233,45 @@ class Attention(nn.Module):
 
         out = out.reshape(batch, length, self.n_heads * head_dim)
         return dense(features, "o_proj")(out)
+
+    def _paged_cached_attention(self, q, k, v, positions, cache):
+        """The paged write+read: scatter new rows through the block table, gather
+        the pool back into the logical per-row layout, attend under the same
+        ``slot <= position`` visibility mask as the contiguous branch. Scatter
+        indices collide only on the scratch block (finished rows), where the
+        winning value is irrelevant — real slots own disjoint blocks."""
+        table = cache["table"]  # [B, max_blocks] int32
+        block_size = cache["k"].shape[1]
+        blk = jnp.take_along_axis(table, positions // block_size, axis=1)  # [B, L]
+        off = positions % block_size
+
+        def scatter(pool: jax.Array, rows: jax.Array) -> jax.Array:
+            return pool.at[blk, off].set(rows.astype(pool.dtype))
+
+        def logical(pool: jax.Array) -> jax.Array:
+            rows = pool[table]  # [B, MB, bs, H_kv, last]
+            return rows.reshape(rows.shape[0], -1, *rows.shape[3:])
+
+        if "k_scale" in cache:
+            kq, k_scale = quantize_kv_rows(k)
+            vq, v_scale = quantize_kv_rows(v)
+            cache = {
+                "k": scatter(cache["k"], kq),
+                "v": scatter(cache["v"], vq),
+                "k_scale": scatter(cache["k_scale"], k_scale),
+                "v_scale": scatter(cache["v_scale"], v_scale),
+                "table": table,
+            }
+            keys = (logical(cache["k"]).astype(jnp.float32) * logical(cache["k_scale"])).astype(q.dtype)
+            values = (logical(cache["v"]).astype(jnp.float32) * logical(cache["v_scale"])).astype(q.dtype)
+        else:
+            cache = {"k": scatter(cache["k"], k), "v": scatter(cache["v"], v), "table": table}
+            keys = logical(cache["k"]).astype(q.dtype)
+            values = logical(cache["v"]).astype(q.dtype)
+        visible = (
+            jnp.arange(keys.shape[1])[None, None, None, :] <= positions[:, None, :, None]
+        )  # [B, 1, L, MB * bs]
+        return multihead_attention(q, keys, values, causal=False, mask=visible, impl="xla"), cache
 
 
 class MLP(nn.Module):

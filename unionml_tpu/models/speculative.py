@@ -54,7 +54,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from unionml_tpu.models.generate import GenerationConfig, Generator
+from unionml_tpu.models.generate import GenerationConfig, Generator, PrefixCache
 
 __all__ = ["SpeculativeGenerator"]
 
@@ -114,6 +114,11 @@ class SpeculativeGenerator:
         self._target = target
         self._draft = draft
         self._round_fn = None
+        # (weakref-to-prefix, draft_prefix) keyed on id(prefix); a finalizer
+        # drops the entry when the PrefixCache is collected, so per-tenant
+        # prefixes can't accumulate both models' KV forever, and the identity
+        # check guards the window before a recycled id's finalizer runs
+        self._draft_prefixes: dict = {}
 
     @classmethod
     def from_target(cls, target: Generator, draft: "Any") -> "SpeculativeGenerator":
@@ -296,19 +301,48 @@ class SpeculativeGenerator:
 
     # ------------------------------------------------------------------ generate
 
-    def _start_state(self, prompts: Sequence[Sequence[int]], seed: int):
+    def draft_prefix(self, prefix: PrefixCache) -> PrefixCache:
+        """The DRAFT model's cache rows for a shared prefix: speculative
+        decoding needs the system prompt resident in BOTH caches (the draft
+        proposes conditioned on it, the target verifies conditioned on it), and
+        their layer shapes differ — so the draft prefills the same token ids
+        once here and the result is memoized per target-side PrefixCache."""
+        import weakref
+
+        entry = self._draft_prefixes.get(id(prefix))
+        if entry is not None and entry[0]() is prefix:
+            return entry[1]
+        if prefix.tokens is None:
+            raise ValueError(
+                "prefix= with speculative decoding needs the prefix's token ids "
+                "(build it with cache_prefix(...); hand-built PrefixCaches "
+                "cannot be prefilled through the draft model)"
+            )
+        built = self._draft.cache_prefix(list(prefix.tokens))
+        self._draft_prefixes[id(prefix)] = (weakref.ref(prefix), built)
+        weakref.finalize(prefix, self._draft_prefixes.pop, id(prefix), None)
+        return built
+
+    def _start_state(
+        self, prompts: Sequence[Sequence[int]], seed: int, prefix: Optional[PrefixCache] = None
+    ):
         """Prefill both models and assemble the device-side loop state:
         ``(t_cache, d_cache, tok, lengths, done, produced, out_buf, rounds,
-        accepted, key)``."""
+        accepted, key)``. With ``prefix``, both models get their own prefix rows
+        pasted and prefill only the suffix at a ``p0`` offset — lengths then
+        include the prefix, so the round loop needs no changes."""
         cfg = self.config
         if self._round_fn is None:
             self._round_fn = self._build_round()
         # prefill both models; extra cache headroom for the last round's overshoot
         n, tok0_t, _, (t_cache, _, lengths, done_t, _) = self._target._start(
-            prompts, seed, extra_cache=self.gamma + 1
+            prompts, seed, extra_cache=self.gamma + 1, prefix=prefix
         )
-        _, _, _, (d_cache, _, d_lengths, _, _) = self._draft._start(prompts, seed, extra_cache=self.gamma + 1)
-        del d_lengths  # same values as lengths (same prompts)
+        _, _, _, (d_cache, _, d_lengths, _, _) = self._draft._start(
+            prompts, seed, extra_cache=self.gamma + 1,
+            prefix=self.draft_prefix(prefix) if prefix is not None else None,
+        )
+        del d_lengths  # same values as lengths (same prompts, same prefix length)
 
         batch = int(tok0_t.shape[0])
         cap = cfg.max_new_tokens + self.gamma + 1
@@ -323,11 +357,19 @@ class SpeculativeGenerator:
             jnp.int32(0), jnp.int32(0), key,
         )
 
-    def __call__(self, prompts: Sequence[Sequence[int]], *, seed: int = 0) -> np.ndarray:
+    def __call__(
+        self,
+        prompts: Sequence[Sequence[int]],
+        *,
+        seed: int = 0,
+        prefix: Optional[PrefixCache] = None,
+    ) -> np.ndarray:
         """Generate under the config's decoding policy; greedy output is exactly
-        the target-only sequence, sampled output is target-distributed."""
+        the target-only sequence, sampled output is target-distributed. With
+        ``prefix`` (from the target's ``cache_prefix``), prompts are suffixes
+        after the shared prefix in BOTH models."""
         cfg = self.config
-        n, state = self._start_state(prompts, seed)
+        n, state = self._start_state(prompts, seed, prefix=prefix)
         budget = jnp.full(state[2].shape, cfg.max_new_tokens, jnp.int32)
         state = self._round_fn(self._target.params, self._draft.params, state, budget, budget)
         out_buf, rounds, accepted = state[6], state[7], state[8]
@@ -335,7 +377,14 @@ class SpeculativeGenerator:
         self.accepted_tokens += int(accepted)
         return np.asarray(out_buf)[:n, : cfg.max_new_tokens]
 
-    def stream(self, prompts: Sequence[Sequence[int]], *, seed: int = 0, chunk_size: int = 16):
+    def stream(
+        self,
+        prompts: Sequence[Sequence[int]],
+        *,
+        seed: int = 0,
+        chunk_size: int = 16,
+        prefix: Optional[PrefixCache] = None,
+    ):
         """Incremental speculative generation: yields a LIST of ``len(prompts)``
         1-D int32 arrays of newly materialized tokens per row (the first yield is
         each row's prompt-sampled token). Rows advance at round granularity
@@ -347,7 +396,7 @@ class SpeculativeGenerator:
         cfg = self.config
         if chunk_size < 1:
             raise ValueError("chunk_size must be >= 1")
-        n, state = self._start_state(prompts, seed)
+        n, state = self._start_state(prompts, seed, prefix=prefix)
         prev = np.ones((n,), np.int64)
         first = np.asarray(state[6][:n, :1])  # one fetch, not one per row
         yield [first[i] for i in range(n)]

@@ -291,7 +291,13 @@ class ServingApp:
                 if not completed:
                     close = getattr(iterator, "close", None)
                     if close is not None:
-                        await _close_iterator(loop, close)
+                        # DETACHED task: the server may cancel this handler
+                        # while acloseing it, and a cancelled await here would
+                        # abandon the retry loop with the producer still
+                        # decoding — the release must outlive the handler
+                        task = loop.create_task(_close_iterator(loop, close))
+                        _pending_closes.add(task)
+                        task.add_done_callback(_pending_closes.discard)
 
         return 200, chunks(), "application/x-ndjson"
 
@@ -306,6 +312,10 @@ class ServingApp:
         """In-process request dispatch — the test-client surface."""
         self.startup()
         return await self.server.dispatch(method, path, body)
+
+
+#: strong refs to in-flight detached close tasks (the loop only holds weak ones)
+_pending_closes: set = set()
 
 
 async def _close_iterator(loop, close) -> None:

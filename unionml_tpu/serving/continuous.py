@@ -61,6 +61,7 @@ from unionml_tpu.models.generate import (
     _paste_prefix_rows,
     chunk_aligned,
     init_cache,
+    init_paged_cache,
 )
 
 __all__ = ["ContinuousBatcher"]
@@ -132,6 +133,17 @@ class ContinuousBatcher:
     system prompt — whose K/V rows are pasted into every admission, so its
     prefill cost is paid once at ``cache_prefix`` time, not per request; every
     submitted prompt is then a suffix after it.
+
+    ``block_size`` switches the KV cache to PAGED mode: instead of every slot
+    owning a worst-case ``[cache_len]`` row, K/V live in a shared pool of
+    ``pool_blocks`` blocks of ``block_size`` positions and each admission is
+    allocated only the blocks ITS prompt + budget need — HBM scales with
+    resident tokens, so a pool far smaller than ``slots x cache_len`` still
+    admits a full house of typical requests (vLLM's insight, expressed in
+    static XLA shapes; no reference analog). Admission blocks FIFO while the
+    pool is exhausted and resumes as residents finish; ``stats()`` reports
+    occupancy. Decoded tokens are exactly the dense path's (the test ring pins
+    paged == contiguous == sequential).
     """
 
     def __init__(
@@ -141,11 +153,15 @@ class ContinuousBatcher:
         slots: int = 4,
         decode_chunk: int = 8,
         prefix: Optional[PrefixCache] = None,
+        block_size: Optional[int] = None,
+        pool_blocks: Optional[int] = None,
     ):
         if slots < 1:
             raise ValueError("slots must be >= 1")
         if decode_chunk < 1:
             raise ValueError("decode_chunk must be >= 1")
+        if block_size is not None and block_size < 1:
+            raise ValueError("block_size must be >= 1")
         cfg = generator.config
         if cfg.sp_prefill:
             raise ValueError("continuous batching does not compose with sp_prefill yet")
@@ -156,10 +172,14 @@ class ContinuousBatcher:
         #: and budgets), so concurrent streams share draft+verify dispatches
         #: and each greedy stream still equals its solo target-only run
         self._spec = generator._speculative() if cfg.draft is not None else None
-        if self._spec is not None and prefix is not None:
-            raise ValueError("speculative continuous batching does not compose with prefix= yet")
         if prefix is not None and not isinstance(prefix, PrefixCache):
             raise TypeError(f"prefix must be a PrefixCache (from generator.cache_prefix), got {type(prefix).__name__}")
+        #: speculative × prefix: the draft model needs the system prompt in ITS
+        #: cache too — built once here from the prefix's token ids (paid at
+        #: construction, like cache_prefix itself)
+        self._draft_prefix = (
+            self._spec.draft_prefix(prefix) if self._spec is not None and prefix is not None else None
+        )
         self.slots = slots
         self.decode_chunk = decode_chunk
         self.prefix = prefix
@@ -180,6 +200,39 @@ class ContinuousBatcher:
                 chunk_aligned(b, cfg.prefill_chunk) for b in (cfg.prompt_buckets or (widest,))
             )
             self.cache_len = max(self.cache_len, p0 + aligned)
+        #: paged-KV mode (block_size set): a host-side allocator hands pool
+        #: blocks to admissions; block index ``pool_blocks`` is the SCRATCH
+        #: block — unused/finished table entries point there, so their
+        #: ride-along writes land harmlessly outside every live allocation
+        if generator.mesh is not None:
+            # TP (model-axis) serving is supported: params and KV heads shard,
+            # XLA inserts the collectives, and admission's batch-1 row prefill
+            # replicates trivially. Batch-axis sharding is not: a [1, ...] row
+            # cache cannot split over a >1 data/fsdp axis
+            for axis in ("data", "fsdp"):
+                if int(generator.mesh.shape.get(axis, 1)) > 1:
+                    raise ValueError(
+                        f"continuous batching shards over model/TP axes only; mesh has {axis}="
+                        f"{int(generator.mesh.shape[axis])} (batch-1 admission prefills cannot split a batch axis)"
+                    )
+        self.block_size = block_size
+        if block_size is not None:
+            if self._spec is not None:
+                raise ValueError("paged KV does not compose with speculative decoding yet")
+            if generator.mesh is not None:
+                raise ValueError("paged KV does not compose with a sharded Generator yet")
+            self.max_blocks = -(-self.cache_len // block_size)
+            self.pool_blocks = pool_blocks if pool_blocks is not None else slots * self.max_blocks
+            if self.pool_blocks < self.max_blocks:
+                raise ValueError(
+                    f"pool_blocks ({self.pool_blocks}) must cover one worst-case request "
+                    f"({self.max_blocks} blocks of {block_size}) or admission could deadlock"
+                )
+            self._scratch_block = self.pool_blocks
+            self._free_blocks: "List[int]" = list(range(self.pool_blocks))
+            self._slot_blocks: Dict[int, "List[int]"] = {}
+        elif pool_blocks is not None:
+            raise ValueError("pool_blocks requires block_size (paged mode)")
         self._lock = threading.Condition()
         self._pending: "List[tuple]" = []  # (prompt, session) awaiting a free slot
         self._sessions: Dict[int, _Session] = {}
@@ -193,6 +246,7 @@ class ContinuousBatcher:
         # any output shape, so donating them would just trigger warnings
         self._admit_fn = jax.jit(self._admit_impl, donate_argnums=(0,))
         self._spec_admit_fn = jax.jit(self._spec_admit_impl, donate_argnums=(0, 1, 2))
+        self._paged_admit_fn = jax.jit(self._paged_admit_impl, donate_argnums=(0,))
         #: dispatch/utilization counters for benchmarks and /metrics
         self.decode_dispatches = 0
         self.decoded_rows = 0
@@ -219,6 +273,27 @@ class ContinuousBatcher:
         done = jax.lax.dynamic_update_slice(done, jnp.zeros((1,), bool), (slot,))
         return cache, tok, lengths, done
 
+    @staticmethod
+    def _paged_admit_impl(cache, row_cache, tok, lengths, done, slot, row_tok, row_len, blocks_row):
+        """Paged admission: point slot ``slot``'s table row at ``blocks_row`` in
+        every layer and scatter the dense ``[1, cache_len]`` prefilled row into
+        those blocks. ``blocks_row`` ([max_blocks] int32) is scratch-padded past
+        the request's allocation, so the dense row's unused tail lands in the
+        scratch block, never in another request's pages."""
+        block_size = cache[0]["k"].shape[1]
+        new_layers = []
+        for layer, row in zip(cache, row_cache):
+            pos = jnp.arange(row["k"].shape[1])
+            blk, off = blocks_row[pos // block_size], pos % block_size
+            new_layer = {"table": jax.lax.dynamic_update_slice(layer["table"], blocks_row[None], (slot, 0))}
+            for name in row:
+                new_layer[name] = layer[name].at[blk, off].set(row[name][0].astype(layer[name].dtype))
+            new_layers.append(new_layer)
+        tok = jax.lax.dynamic_update_slice(tok, row_tok.astype(tok.dtype), (slot,))
+        lengths = jax.lax.dynamic_update_slice(lengths, row_len.astype(lengths.dtype), (slot,))
+        done = jax.lax.dynamic_update_slice(done, jnp.zeros((1,), bool), (slot,))
+        return tuple(new_layers), tok, lengths, done
+
     @classmethod
     def _spec_admit_impl(cls, t_cache, d_cache, out_buf, t_row, d_row, tok, lengths, done,
                          produced, slot, row_tok, row_len, row_done, pad):
@@ -243,9 +318,18 @@ class ContinuousBatcher:
 
     def _init_carry(self) -> tuple:
         cfg = self.gen.config
-        cache = self.gen._place_cache(
-            init_cache(self.gen.module.config, self.slots, self.cache_len, kv_dtype=cfg.kv_cache_dtype)
-        )
+        if self.block_size is not None:
+            # pool_blocks + 1: the extra block is scratch (see __init__); tables
+            # start all-scratch so never-admitted slots' ride-along writes are
+            # harmless from the first dispatch
+            cache = init_paged_cache(
+                self.gen.module.config, self.slots, self.pool_blocks + 1, self.block_size,
+                self.max_blocks, kv_dtype=cfg.kv_cache_dtype, fill_block=self._scratch_block,
+            )
+        else:
+            cache = self.gen._place_cache(
+                init_cache(self.gen.module.config, self.slots, self.cache_len, kv_dtype=cfg.kv_cache_dtype)
+            )
         tok = jnp.zeros((self.slots,), jnp.int32)
         lengths = jnp.ones((self.slots,), jnp.int32)
         done = jnp.ones((self.slots,), bool)  # every slot starts free (= masked out)
@@ -266,15 +350,25 @@ class ContinuousBatcher:
         return (cache, d_cache, tok, lengths, done, produced, out_buf,
                 jnp.int32(0), jnp.int32(0), key)
 
-    def _prefill_row(self, prompt: Sequence[int], seed: int, gen: Optional[Generator] = None):
+    def _prefill_row(
+        self,
+        prompt: Sequence[int],
+        seed: int,
+        gen: Optional[Generator] = None,
+        prefix: Optional[PrefixCache] = None,
+    ):
         """Prefill one prompt at batch 1 into a fresh [1, cache_len] cache using
         the Generator's own jitted machinery — identical numerics and the same
         bounded set of prefill compiles (one per bucket at batch 1). With a
         shared ``prefix``, its rows are pasted at slots [0, p0) and the prompt
         (a suffix) flows through the offset chunked path, exactly like
-        ``Generator.__call__(..., prefix=...)``. ``gen`` overrides the model
-        (speculative mode prefills the draft's row too)."""
-        gen, cfg = gen or self.gen, self.gen.config
+        ``Generator.__call__(..., prefix=...)``. ``gen``/``prefix`` override the
+        model and its prefix rows (speculative mode prefills the draft's row
+        with the DRAFT's prefix)."""
+        cfg = self.gen.config
+        if gen is None:
+            gen, prefix = self.gen, self.prefix
+        # draft and target prefixes have the same length (same token ids)
         p0 = self.prefix.length if self.prefix is not None else 0
         bucket = gen._bucket(max(len(prompt), 1))
         if p0 + bucket + cfg.max_new_tokens > self.cache_len:
@@ -290,7 +384,7 @@ class ContinuousBatcher:
         )
         key = jax.random.fold_in(jax.random.PRNGKey(self._seed), seed)
         row_valid = jnp.ones((1,), bool)
-        if self.prefix is not None:
+        if prefix is not None:
             chunk = cfg.prefill_chunk or bucket
             aligned = chunk_aligned(bucket, chunk)  # ragged tails would cost one
             if p0 + aligned > self.cache_len:  # __init__ sizes for every bucket;
@@ -299,7 +393,7 @@ class ContinuousBatcher:
                 )
             if aligned > bucket:  # extra prefill compile per bucket remainder
                 tokens = np.pad(tokens, ((0, 0), (0, aligned - bucket)), constant_values=cfg.pad_id)
-            row_cache = _paste_prefix_rows(row_cache, self.prefix.layers)
+            row_cache = _paste_prefix_rows(row_cache, prefix.layers)
             last, row_cache = gen._chunked_prefill_loop(
                 tokens, lengths, row_cache, row_valid, chunk, start=p0
             )
@@ -309,6 +403,19 @@ class ContinuousBatcher:
                 gen.params, jnp.asarray(tokens), lengths, row_cache, key, row_valid
             )
         return tok0, lengths, row_cache
+
+    def _blocks_needed(self, prompt: Sequence[int], budget: int) -> int:
+        """Pool blocks a request needs for its WHOLE lifetime, allocated up
+        front so decode never grows mid-flight (no preemption needed). Only
+        positions ``[0, p0 + plen + budget + decode_chunk)`` are ever VISIBLE:
+        the prefill scatter also writes the prompt bucket's pad columns, but
+        those positions are hidden by the ``slot <= position`` mask until
+        decode overwrites them in order — so unallocated pad positions can land
+        in the scratch block and capacity scales with the request's ACTUAL
+        prompt length and budget, not its padded bucket."""
+        p0 = self.prefix.length if self.prefix is not None else 0
+        need = p0 + max(len(prompt), 1) + budget + self.decode_chunk
+        return -(-need // self.block_size)
 
     # ------------------------------------------------------------------ public API
 
@@ -368,6 +475,7 @@ class ContinuousBatcher:
             if self._sessions.get(session.slot) is session:
                 self._sessions.pop(session.slot)
                 self._free.append(session.slot)
+                self._release_blocks_locked(session.slot)
                 self._mask_slot_done(session.slot)
 
     def warmup(self) -> None:
@@ -430,6 +538,12 @@ class ContinuousBatcher:
                 ) if self.decode_dispatches else None,
                 "speculative": self._spec is not None,
             }
+            if self.block_size is not None:
+                snapshot["kv_blocks"] = {
+                    "total": self.pool_blocks,
+                    "used": self.pool_blocks - len(self._free_blocks),
+                    "block_size": self.block_size,
+                }
             if self._spec is not None and self._spec.rounds:
                 snapshot["acceptance_rate"] = round(
                     self._spec.accepted_tokens / (self._spec.rounds * self._spec.gamma), 3
@@ -495,18 +609,44 @@ class ContinuousBatcher:
             with self._lock:
                 if self._closed or not self._pending or not self._free:
                     return
+                blocks_row = None
+                if self.block_size is not None:
+                    # memory-pressure admission: the head-of-line request keeps
+                    # its FIFO position until residents free enough blocks (the
+                    # engine re-enters here at every chunk boundary)
+                    needed = self._blocks_needed(self._pending[0][0], self._pending[0][1].max_new)
+                    if needed > self.max_blocks:
+                        # an oversized prompt can never fit a table row: fail its
+                        # stream now instead of wedging the FIFO head forever
+                        prompt, session = self._pending.pop(0)
+                        if not session.finished:
+                            session.finished = True
+                            session.out.put(ValueError(
+                                f"prompt needs {needed} KV blocks but a slot's table holds {self.max_blocks}"
+                            ))
+                        continue
+                    if needed > len(self._free_blocks):
+                        return
                 prompt, session = self._pending.pop(0)
                 slot = self._free.pop(0)
                 session.slot = slot
+                if self.block_size is not None:
+                    alloc = [self._free_blocks.pop(0) for _ in range(needed)]
+                    self._slot_blocks[slot] = alloc
+                    blocks_row = np.full((self.max_blocks,), self._scratch_block, np.int32)
+                    blocks_row[: len(alloc)] = alloc
                 self._seed += 1
                 seed = self._seed
             try:
                 tok0, row_len, row_cache = self._prefill_row(prompt, seed)
                 if self._spec is not None:
                     # the draft's cache row: same prompt through the draft model
-                    # (its prompt-sampled token is discarded — emission #1 is the
-                    # target's, exactly as in SpeculativeGenerator._start_state)
-                    _, _, d_row = self._prefill_row(prompt, seed, gen=self._spec._draft)
+                    # with the DRAFT's prefix rows (its prompt-sampled token is
+                    # discarded — emission #1 is the target's, exactly as in
+                    # SpeculativeGenerator._start_state)
+                    _, _, d_row = self._prefill_row(
+                        prompt, seed, gen=self._spec._draft, prefix=self._draft_prefix
+                    )
             except ValueError as exc:
                 # a bad prompt (e.g. longer than the cache can hold) fails its
                 # own stream; the engine and other residents keep going. The
@@ -515,6 +655,7 @@ class ContinuousBatcher:
                 # could interleave its sentinel before (or instead of) the error
                 with self._lock:
                     self._free.append(slot)
+                    self._release_blocks_locked(slot)
                     if not session.finished:
                         session.finished = True
                         session.out.put(exc)
@@ -526,9 +667,15 @@ class ContinuousBatcher:
             start_done = hit_eos or 1 >= session.max_new
             if self._spec is None:
                 cache, tok, lengths, done, key = self._carry
-                cache, tok, lengths, done = self._admit_fn(
-                    cache, row_cache, tok, lengths, done, jnp.int32(slot), tok0, row_len
-                )
+                if blocks_row is not None:
+                    cache, tok, lengths, done = self._paged_admit_fn(
+                        cache, row_cache, tok, lengths, done, jnp.int32(slot), tok0, row_len,
+                        jnp.asarray(blocks_row),
+                    )
+                else:
+                    cache, tok, lengths, done = self._admit_fn(
+                        cache, row_cache, tok, lengths, done, jnp.int32(slot), tok0, row_len
+                    )
                 self._carry = (cache, tok, lengths, done, key)
             else:
                 t_cache, d_cache, tok, lengths, done, produced, out_buf, rounds, acc, key = self._carry
@@ -545,6 +692,7 @@ class ContinuousBatcher:
                     # just activated — mask it back out and return the slot
                     # instead of decoding a full budget to a dead queue
                     self._free.append(slot)
+                    self._release_blocks_locked(slot)
                     self._mask_slot_done(slot)
                     continue
                 session.out.put(first)
@@ -560,21 +708,37 @@ class ContinuousBatcher:
                     self._finish_locked(slot, device_done=self._spec is not None)
 
     def _mask_slot_done(self, slot: int) -> None:
-        """Set the device-side done flag of a slot (engine thread only)."""
+        """Set the device-side done flag of a slot (engine thread only). In
+        paged mode also repoint its table row at the scratch block: the freed
+        blocks may be reallocated immediately, and the done row keeps issuing a
+        ride-along K/V write per step — scratch is where it must land."""
         if self._carry is None:
             return
         state = list(self._carry)
         done_idx = 3 if self._spec is None else 4
         state[done_idx] = state[done_idx].at[slot].set(True)
+        if self.block_size is not None:
+            state[0] = tuple(
+                {**layer, "table": layer["table"].at[slot].set(self._scratch_block)}
+                for layer in state[0]
+            )
         self._carry = tuple(state)
+
+    def _release_blocks_locked(self, slot: int) -> None:
+        """Return a slot's pool blocks to the allocator (caller holds the lock)."""
+        if self.block_size is not None:
+            self._free_blocks.extend(self._slot_blocks.pop(slot, []))
 
     def _finish_locked(self, slot: int, *, device_done: bool) -> None:
         session = self._sessions.pop(slot)
         session.finished = True
         self._free.append(slot)
-        if not device_done:
+        self._release_blocks_locked(slot)
+        if not device_done or self.block_size is not None:
             # finished without the device knowing (budget exhausted, or the
-            # prompt-sampled token was eos): mask the row out of future chunks
+            # prompt-sampled token was eos): mask the row out of future chunks.
+            # Paged mode masks unconditionally — the table repoint to scratch
+            # must happen even when the device already flagged done
             self._mask_slot_done(slot)
         # sentinel last: once the consumer wakes, the engine state is consistent
         session.out.put(_SENTINEL)
