@@ -27,6 +27,27 @@ _SHIM = textwrap.dedent(
     if [ "$verb" = "push" ] && [ -n "$DOCKER_FAIL_PUSH" ]; then
       echo "ERROR: denied" >&2; exit 1
     fi
+    if [ "$verb" = "run" ]; then
+      # EXECUTE the container locally (the gcloud-shim ssh pattern): the image's
+      # entrypoint is `python -m unionml_tpu.job_runner`, its argument rides the
+      # docker argv, and -e vars become the process env — so a full remote_train
+      # really runs through ContainerLauncher's code path.
+      if [ -n "$DOCKER_FAIL_RUN_ONCE" ] && [ ! -f "$DOCKER_SHIM_STATE/run_failed" ]; then
+        mkdir -p "$DOCKER_SHIM_STATE"; touch "$DOCKER_SHIM_STATE/run_failed"
+        echo "docker: container exited unexpectedly" >&2; exit 125
+      fi
+      shift
+      envs=(); pos=()
+      while [ $# -gt 0 ]; do
+        case "$1" in
+          -e) envs+=("$2"); shift 2;;
+          -v|--name|--network) shift 2;;
+          --rm) shift;;
+          *) pos+=("$1"); shift;;
+        esac
+      done
+      exec env "${envs[@]}" "$PYTHON_FOR_SHIM" -m unionml_tpu.job_runner "${pos[1]}"
+    fi
     exit 0
     """
 )
@@ -43,7 +64,11 @@ def docker_env(tmp_path, monkeypatch):
     log.write_text("")
     monkeypatch.setenv("PATH", f"{bin_dir}{os.pathsep}{os.environ['PATH']}")
     monkeypatch.setenv("DOCKER_SHIM_LOG", str(log))
-    for var in ("DOCKER_FAIL_BUILD", "DOCKER_FAIL_PUSH"):
+    monkeypatch.setenv("DOCKER_SHIM_STATE", str(tmp_path / "shim_state"))
+    import sys as _sys
+
+    monkeypatch.setenv("PYTHON_FOR_SHIM", _sys.executable)
+    for var in ("DOCKER_FAIL_BUILD", "DOCKER_FAIL_PUSH", "DOCKER_FAIL_RUN_ONCE"):
         monkeypatch.delenv(var, raising=False)
 
     def calls(verb=None):
@@ -139,3 +164,60 @@ def test_app_dockerfile_is_respected(docker_env, docker_app, tmp_path, monkeypat
     version = model.remote_deploy(app_version="img-v5")
     bundle = tmp_path / "store" / "unionml-tpu" / "development" / "apps" / "remote_model" / version / "bundle"
     assert (bundle / "Dockerfile").read_text() == "FROM scratch\n# custom\n"
+
+
+def test_container_launcher_trains_end_to_end(docker_env, docker_app, tmp_path):
+    """The image IS the execution vehicle (reference remote.py:91-108 parity):
+    deploy builds+pushes the app image, remote_train launches it through
+    ContainerLauncher, and the shim executes the container's job_runner
+    entrypoint locally — the artifact comes back through the mounted store."""
+    from unionml_tpu.launcher import ContainerLauncher
+
+    model = docker_app.model
+    model.remote(
+        backend_store=str(tmp_path / "store"), registry="gcr.io/proj", launcher=ContainerLauncher()
+    )
+    model.remote_deploy(app_version="run-v1")
+    artifact = model.remote_train(hyperparameters={"max_iter": 200}, wait=True)
+    assert artifact.metrics["train"] > 0.8
+
+    runs = docker_env("run")
+    assert len(runs) == 1
+    line = runs[0]
+    assert "gcr.io/proj/unionml-tpu:remote-model-run-v1" in line  # manifest image
+    store = str((tmp_path / "store").resolve())
+    assert f"-v {store}" in line and "--network host" in line  # store mount + host net
+    assert "--rm" in line and "-e PYTHONPATH=" in line
+
+
+def test_container_launcher_without_image_is_a_clear_error(docker_app, tmp_path):
+    """No registry at deploy -> no image in the manifest -> ContainerLauncher
+    refuses with guidance instead of launching a broken docker command."""
+    from unionml_tpu.launcher import ContainerLauncher
+
+    model = docker_app.model
+    model.remote(backend_store=str(tmp_path / "store"), launcher=ContainerLauncher())
+    model.remote_deploy(app_version="run-v2")
+    with pytest.raises(Exception, match="registry|image"):
+        model.remote_train(hyperparameters={"max_iter": 200}, wait=True)
+
+
+def test_container_run_failure_consumes_retry(docker_env, docker_app, tmp_path, monkeypatch):
+    """A dead container (docker run exit 125) is a dead worker to the watchdog:
+    with retries=1 the execution resubmits and completes — two run invocations."""
+    from unionml_tpu.launcher import ContainerLauncher
+
+    monkeypatch.setenv("DOCKER_FAIL_RUN_ONCE", "1")
+    model = docker_app.model
+    model.remote(
+        backend_store=str(tmp_path / "store"), registry="gcr.io/proj", launcher=ContainerLauncher()
+    )
+    model.remote_deploy(app_version="run-v3")
+    artifact = model.remote_train(hyperparameters={"max_iter": 200}, wait=True, retries=1)
+    assert artifact.metrics["train"] > 0.8
+    runs = docker_env("run")
+    assert len(runs) == 2
+    # each attempt mints a fresh container name: a killed attempt's container
+    # lingers daemon-side, and reusing the name would fail the retry
+    names = [tok for line in runs for i, tok in enumerate(line.split()) if line.split()[i - 1] == "--name"]
+    assert len(set(names)) == 2 and names[0].endswith("-a0-w0") and names[1].endswith("-a1-w0")

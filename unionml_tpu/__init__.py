@@ -9,7 +9,7 @@ layer schedules app bundles onto TPU VM slices.
 """
 
 from unionml_tpu.dataset import Dataset  # noqa: F401
-from unionml_tpu.launcher import Launcher, LocalProcessLauncher, TPUVMLauncher  # noqa: F401
+from unionml_tpu.launcher import ContainerLauncher, Launcher, LocalProcessLauncher, TPUVMLauncher  # noqa: F401
 from unionml_tpu.model import BaseHyperparameters, Model, ModelArtifact  # noqa: F401
 from unionml_tpu.parallel.mesh import MeshSpec  # noqa: F401
 from unionml_tpu.parallel.sharding import PartitionRules  # noqa: F401
@@ -31,6 +31,7 @@ __all__ = [
     "PartitionRules",
     "Stage",
     "TPUVMLauncher",
+    "ContainerLauncher",
     "TrainerConfig",
     "make_train_step",
     "stage",

@@ -32,6 +32,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 from unionml_tpu._logging import logger
 
 __all__ = [
+    "ContainerLauncher",
     "LaunchSpec",
     "Launcher",
     "LocalProcessLauncher",
@@ -55,6 +56,16 @@ class LaunchSpec:
     log_mode: str  # "w" first attempt, "a" on resubmit
     execution_path: str
     accelerator: Optional[str] = None
+    #: 0-based relaunch counter (the watchdog's resubmit increments it) — lets
+    #: launchers mint per-attempt resource names (container names must be fresh:
+    #: a killed attempt's container lingers until the daemon reaps it)
+    attempt: int = 0
+    #: the app version's container image (deploy manifest's ``image``), when a
+    #: registry was configured at deploy — what :class:`ContainerLauncher` runs
+    image: Optional[str] = None
+    #: the backend store root — container/remote launchers mount or sync it so
+    #: the execution directory is visible inside the worker at the same path
+    store_root: Optional[str] = None
 
     @property
     def n_workers(self) -> int:
@@ -79,6 +90,100 @@ class LocalProcessLauncher(Launcher):
                     subprocess.Popen(spec.command, env=env, stdout=log_file, stderr=subprocess.STDOUT)
                 )
         return handles
+
+
+class ContainerLauncher(Launcher):
+    """Run each worker as a container from the app's deployed image.
+
+    This closes the reference's image-is-the-runtime contract
+    (/root/reference/unionml/remote.py:91-108 builds+pushes, model.py:696 pins
+    ``FLYTE_INTERNAL_IMAGE``, the cluster runs it): the image built at deploy
+    (:mod:`unionml_tpu.container`, entrypoint ``unionml_tpu.job_runner``) is the
+    execution vehicle, not just an artifact. Per worker::
+
+        docker run --rm --network host \\
+            -v <store_root>:<store_root> \\
+            -e UNIONML_TPU_... -e JAX_... -e PYTHONPATH=... \\
+            <manifest image> <execution_path>
+
+    The store root is bind-mounted at the SAME path so the execution directory
+    (spec/status/outputs) and the bundle are visible inside the container where
+    the host-side backend expects them; the jax.distributed coordinator env
+    rides ``--network host``, so multi-worker containers join one runtime
+    exactly like local processes. The handle is the local ``docker run``
+    process — the backend watchdog sees container death as docker exit, and the
+    same shim seam as the gcloud launcher drives the real code path in tests
+    (tests/integration/test_container.py).
+
+    :param image: override the manifest image (e.g. a locally built tag); by
+        default the :class:`LaunchSpec`'s ``image`` — the deploy manifest's —
+        is required.
+    :param docker_args: extra ``docker run`` arguments, e.g.
+        ``("--privileged", "--device=/dev/accel0")`` for TPU-VM device access.
+    """
+
+    def __init__(self, *, image: Optional[str] = None, docker_args: Sequence[str] = ()):
+        self.image = image
+        self.docker_args = list(docker_args)
+
+    def launch(self, spec: LaunchSpec) -> List[Any]:
+        image = self.image or spec.image
+        if not image:
+            raise ValueError(
+                "ContainerLauncher needs an image: deploy with a registry configured "
+                "(the manifest then records the built image) or pass ContainerLauncher(image=...)"
+            )
+        exec_name = Path(spec.execution_path).name
+        handles: List[Any] = []
+        for worker, (env, log_path) in enumerate(zip(spec.worker_envs, spec.log_paths)):
+            # per-ATTEMPT name: a watchdog-killed attempt's container lingers
+            # until the daemon reaps it, and a name reuse would fail every retry
+            # with a daemon name conflict
+            name = f"unionml-{exec_name}-a{spec.attempt}-w{worker}"
+            command = ["docker", "run", "--rm", "--network", "host", "--name", name]
+            if spec.store_root:
+                command += ["-v", f"{spec.store_root}:{spec.store_root}"]
+            for key, value in env.items():
+                if key.startswith(("UNIONML_TPU_", "PYTHONPATH", "JAX_")):
+                    command += ["-e", f"{key}={value}"]
+            command += self.docker_args
+            # the image's entrypoint is `python -m unionml_tpu.job_runner`; the
+            # execution path is its argument
+            command += [image, spec.execution_path]
+            with open(log_path, spec.log_mode) as log_file:
+                proc = subprocess.Popen(command, env=env, stdout=log_file, stderr=subprocess.STDOUT)
+            handles.append(_ContainerHandle(proc, name))
+        return handles
+
+
+class _ContainerHandle:
+    """Process-like handle for one containerized worker. ``poll``/``wait``/
+    ``returncode`` proxy the local ``docker run`` client (container death IS
+    client exit), but ``kill`` must target the CONTAINER — SIGKILL to the
+    client is never proxied to the daemon-side process, and a worker that
+    survived its own kill would keep mutating the bind-mounted execution dir
+    while the resubmitted attempt writes the same files."""
+
+    def __init__(self, proc: "subprocess.Popen", name: str):
+        self._proc = proc
+        self.container_name = name
+
+    def poll(self):
+        return self._proc.poll()
+
+    def wait(self, timeout: Optional[float] = None):
+        return self._proc.wait(timeout)
+
+    @property
+    def returncode(self):
+        return self._proc.returncode
+
+    def kill(self) -> None:
+        subprocess.run(
+            ["docker", "kill", self.container_name],
+            check=False, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        self._proc.kill()
 
 
 #: chips per host for each TPU generation prefix — the worker count for a slice is

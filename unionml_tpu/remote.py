@@ -310,6 +310,10 @@ class Backend:
             worker_envs.append(env)
             log_paths.append(Path(execution.path) / ("logs.txt" if worker == 0 else f"logs.{worker}.txt"))
 
+        manifest_path = self._app_dir(model_name, app_version) / "manifest.json"
+        image = None
+        if manifest_path.exists():
+            image = json.loads(manifest_path.read_text()).get("image")
         spec = LaunchSpec(
             command=[sys.executable, "-m", "unionml_tpu.job_runner", execution.path],
             worker_envs=worker_envs,
@@ -317,6 +321,9 @@ class Backend:
             log_mode="w" if attempt == 0 else "a",
             execution_path=execution.path,
             accelerator=self.config.accelerator,
+            image=image,
+            store_root=str(self.root.resolve()),
+            attempt=attempt,
         )
         execution.procs = list(self.launcher.launch(spec))
         execution.proc = execution.procs[0]
