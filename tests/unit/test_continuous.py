@@ -376,7 +376,7 @@ def test_paged_kv_matches_sequential_with_undersized_pool(tiny_gen):
         assert results == [e[:4] for e in expected]
         assert batcher.decoded_rows > batcher.decode_dispatches  # dispatches were shared
         stats = batcher.stats()["kv_blocks"]
-        assert stats == {"total": 10, "used": 0, "block_size": 8}  # all freed
+        assert stats == {"total": 10, "used": 0, "shared_prefix": 0, "block_size": 8}  # all freed
     finally:
         batcher.close()
 
@@ -447,6 +447,64 @@ def test_paged_kv_oversized_prompt_fails_cleanly(tiny_gen):
         with pytest.raises(ValueError, match="blocks"):
             _drain(doomed)
         assert _drain(ok) == expected[0]
+    finally:
+        batcher.close()
+
+
+def test_paged_shared_prefix_pages(tiny_gen):
+    """A long system prompt's FULL blocks are seeded once and SHARED: every
+    slot's table points at the same page ids (vLLM's prefix caching), so
+    per-request allocation shrinks by the shared pages — and tokens still equal
+    the sequential dense run."""
+    module, params = tiny_gen
+    cfg = GenerationConfig(max_new_tokens=6, temperature=0.0, prompt_buckets=(8, 32))
+    prefix = [7, 7, 3, 9, 1, 2, 5, 11, 4, 8, 2, 6, 9, 1, 3, 2, 8, 4, 1, 5]  # 20 tokens
+    suffixes = [[3, 1, 4], [9, 2, 6, 5], [8]]
+    expected = _sequential_expected(module, params, cfg, [prefix + s for s in suffixes])
+
+    gen = Generator(module, params, cfg)
+    batcher = ContinuousBatcher(
+        gen, slots=2, decode_chunk=3, prefix=gen.cache_prefix(prefix), block_size=8
+    )
+    try:
+        assert len(batcher._shared_prefix_blocks) == 2  # 20 // 8
+        # per-request need excludes the shared pages: ceil((20+4+6+3)/8)=5 - 2
+        assert batcher._blocks_needed(suffixes[1], 6) == 3
+        results = [_drain(batcher.submit(s)) for s in suffixes]
+        assert results == expected
+        stats = batcher.stats()["kv_blocks"]
+        assert stats["shared_prefix"] == 2
+        assert stats["used"] == 2  # only the permanently resident shared pages
+    finally:
+        batcher.close()
+
+
+def test_paged_speculative_with_prefix_all_compositions(tiny_gen):
+    """Everything at once: paged KV x speculative x shared prefix x per-request
+    budgets. One block allocation drives both models' pools; each greedy stream
+    equals the sequential plain run on (prefix + suffix)."""
+    import dataclasses
+
+    from unionml_tpu.models import DraftSpec
+
+    module, params = tiny_gen
+    base = GenerationConfig(max_new_tokens=8, temperature=0.0, prompt_buckets=(8, 16))
+    prefix = [7, 7, 3, 9]
+    suffixes = [[3, 1, 4], [9, 2, 6, 5], [8]]
+    expected = _sequential_expected(module, params, base, [prefix + s for s in suffixes])
+
+    draft, dp = _draft_for(97)
+    cfg = dataclasses.replace(base, draft=DraftSpec(module=draft, params=dp, gamma=3))
+    gen = Generator(module, params, cfg)
+    batcher = ContinuousBatcher(
+        gen, slots=2, decode_chunk=3, prefix=gen.cache_prefix(prefix), block_size=8
+    )
+    try:
+        results = [_drain(batcher.submit(s)) for s in suffixes]
+        assert results == expected
+        short = _drain(batcher.submit(suffixes[0], max_new_tokens=3))
+        assert short == expected[0][:3]
+        assert batcher.stats()["kv_blocks"]["used"] == 0  # allocator balanced
     finally:
         batcher.close()
 

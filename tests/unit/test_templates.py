@@ -107,8 +107,11 @@ def test_text_generation_template_trains_generates_and_serves(render, tmp_path):
 
     streamed = asyncio.run(concurrent())
     assert [p + s for p, s in zip(prompts, streamed)] == outputs
-    batcher = module._continuous.get(id(module.model.artifact.model_object))
-    assert batcher is not None and batcher.decode_dispatches > 0
+    # the cache stores (state, batcher): the strong state ref pins id reuse
+    entry = module._continuous.get(id(module.model.artifact.model_object))
+    assert entry is not None and entry[0] is module.model.artifact.model_object
+    batcher = entry[1]
+    assert batcher.decode_dispatches > 0
 
     # /metrics surfaces the shared batcher's utilization
     status, metrics_payload, _ = asyncio.run(app.dispatch("GET", "/metrics"))

@@ -179,10 +179,18 @@ class _ContainerHandle:
         return self._proc.returncode
 
     def kill(self) -> None:
-        subprocess.run(
+        result = subprocess.run(
             ["docker", "kill", self.container_name],
-            check=False, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            check=False, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
         )
+        if result.returncode != 0:
+            # the daemon-side worker may still be running and mutating the
+            # mounted execution dir — the one hazard this handle exists to
+            # prevent; it must not fail silently
+            logger.warning(
+                f"docker kill {self.container_name} failed (rc={result.returncode}): "
+                f"{result.stderr.decode(errors='replace').strip()}; the container may still be running"
+            )
         self._proc.kill()
 
 

@@ -187,6 +187,7 @@ class ContinuousBatcher:
         #: plus overshoot: one chunk of decode, or one round's gamma+1 verify
         #: writes in speculative mode (which never runs the plain decode)
         overshoot = (self._spec.gamma + 1) if self._spec is not None else decode_chunk
+        self._overshoot = overshoot  # also bounds per-request paged block needs
         p0 = prefix.length if prefix is not None else 0
         widest = max(cfg.prompt_buckets, default=64)
         self.cache_len = p0 + widest + cfg.max_new_tokens + overshoot
@@ -217,8 +218,6 @@ class ContinuousBatcher:
                     )
         self.block_size = block_size
         if block_size is not None:
-            if self._spec is not None:
-                raise ValueError("paged KV does not compose with speculative decoding yet")
             if generator.mesh is not None:
                 raise ValueError("paged KV does not compose with a sharded Generator yet")
             self.max_blocks = -(-self.cache_len // block_size)
@@ -231,6 +230,17 @@ class ContinuousBatcher:
             self._scratch_block = self.pool_blocks
             self._free_blocks: "List[int]" = list(range(self.pool_blocks))
             self._slot_blocks: Dict[int, "List[int]"] = {}
+            #: shared-prefix pages: the system prompt's FULL blocks are written
+            #: once and every slot's table points at the same ids — nothing ever
+            #: writes positions < p0, so sharing is safe read-only reuse and
+            #: each request allocates only blocks past the shared region (its
+            #: partial prefix tail, its prompt, its budget). The pool must hold
+            #: the shared blocks plus one worst-case request's PRIVATE blocks.
+            self._shared_prefix_blocks: "List[int]" = []
+            if prefix is not None:
+                # (pool >= max_blocks already covers shared + worst-case private)
+                n_shared = prefix.length // block_size
+                self._shared_prefix_blocks = [self._free_blocks.pop(0) for _ in range(n_shared)]
         elif pool_blocks is not None:
             raise ValueError("pool_blocks requires block_size (paged mode)")
         self._lock = threading.Condition()
@@ -246,7 +256,10 @@ class ContinuousBatcher:
         # any output shape, so donating them would just trigger warnings
         self._admit_fn = jax.jit(self._admit_impl, donate_argnums=(0,))
         self._spec_admit_fn = jax.jit(self._spec_admit_impl, donate_argnums=(0, 1, 2))
-        self._paged_admit_fn = jax.jit(self._paged_admit_impl, donate_argnums=(0,))
+        self._paged_admit_fn = jax.jit(self._paged_admit_impl, donate_argnums=(0,), static_argnums=(9,))
+        self._paged_spec_admit_fn = jax.jit(
+            self._paged_spec_admit_impl, donate_argnums=(0, 1, 2), static_argnums=(15,)
+        )
         #: dispatch/utilization counters for benchmarks and /metrics
         self.decode_dispatches = 0
         self.decoded_rows = 0
@@ -274,17 +287,25 @@ class ContinuousBatcher:
         return cache, tok, lengths, done
 
     @staticmethod
-    def _paged_admit_impl(cache, row_cache, tok, lengths, done, slot, row_tok, row_len, blocks_row):
+    def _paged_admit_impl(cache, row_cache, tok, lengths, done, slot, row_tok, row_len, blocks_row,
+                          skip=0):
         """Paged admission: point slot ``slot``'s table row at ``blocks_row`` in
         every layer and scatter the dense ``[1, cache_len]`` prefilled row into
         those blocks. ``blocks_row`` ([max_blocks] int32) is scratch-padded past
         the request's allocation, so the dense row's unused tail lands in the
-        scratch block, never in another request's pages."""
+        scratch block, never in another request's pages. ``skip`` (static)
+        diverts the first ``skip`` blocks' writes to scratch: those table
+        entries are SHARED prefix pages, already seeded once — the row's copy of
+        the prefix is identical, but re-writing shared pages per admission is
+        wasted bandwidth."""
         block_size = cache[0]["k"].shape[1]
+        scratch = cache[0]["k"].shape[0] - 1  # scratch is the last pool block
         new_layers = []
         for layer, row in zip(cache, row_cache):
             pos = jnp.arange(row["k"].shape[1])
             blk, off = blocks_row[pos // block_size], pos % block_size
+            if skip:
+                blk = jnp.where(pos < skip * block_size, scratch, blk)
             new_layer = {"table": jax.lax.dynamic_update_slice(layer["table"], blocks_row[None], (slot, 0))}
             for name in row:
                 new_layer[name] = layer[name].at[blk, off].set(row[name][0].astype(layer[name].dtype))
@@ -293,6 +314,33 @@ class ContinuousBatcher:
         lengths = jax.lax.dynamic_update_slice(lengths, row_len.astype(lengths.dtype), (slot,))
         done = jax.lax.dynamic_update_slice(done, jnp.zeros((1,), bool), (slot,))
         return tuple(new_layers), tok, lengths, done
+
+    @classmethod
+    def _paged_spec_admit_impl(cls, t_cache, d_cache, out_buf, t_row, d_row, tok, lengths, done,
+                               produced, slot, row_tok, row_len, row_done, pad, blocks_row, skip=0):
+        """Paged speculative admission: the SAME block ids serve both models —
+        their pools are sized in identical block counts (shapes differ), and a
+        slot's logical positions are identical in both caches, so one
+        allocation drives two scatters."""
+        t_cache, tok, lengths, done = cls._paged_admit_impl(
+            t_cache, t_row, tok, lengths, done, slot, row_tok, row_len, blocks_row, skip
+        )
+        d_cache, _, _, _ = cls._paged_admit_impl(
+            d_cache, d_row, tok, lengths, done, slot, row_tok, row_len, blocks_row, skip
+        )
+        out_buf, done, produced = cls._spec_activate(out_buf, done, produced, slot, row_tok, row_done, pad)
+        return t_cache, d_cache, out_buf, tok, lengths, done, produced
+
+    @staticmethod
+    def _spec_activate(out_buf, done, produced, slot, row_tok, row_done, pad):
+        """Speculative activation tail shared by the dense and paged admit
+        impls: reset the slot's out_buf row (pad everywhere, tok0 at 0), set
+        the start-done flag, and start the produced counter at 1."""
+        row = jnp.full((out_buf.shape[1],), pad, out_buf.dtype).at[0].set(row_tok[0])
+        out_buf = jax.lax.dynamic_update_slice(out_buf, row[None], (slot, 0))
+        done = jax.lax.dynamic_update_slice(done, row_done, (slot,))
+        produced = jax.lax.dynamic_update_slice(produced, jnp.ones((1,), produced.dtype), (slot,))
+        return out_buf, done, produced
 
     @classmethod
     def _spec_admit_impl(cls, t_cache, d_cache, out_buf, t_row, d_row, tok, lengths, done,
@@ -310,11 +358,30 @@ class ContinuousBatcher:
             return jax.lax.dynamic_update_slice(buf, row.astype(buf.dtype), start)
 
         d_cache = jax.tree_util.tree_map(paste, d_cache, d_row)
-        row = jnp.full((out_buf.shape[1],), pad, out_buf.dtype).at[0].set(row_tok[0])
-        out_buf = jax.lax.dynamic_update_slice(out_buf, row[None], (slot, 0))
-        done = jax.lax.dynamic_update_slice(done, row_done, (slot,))
-        produced = jax.lax.dynamic_update_slice(produced, jnp.ones((1,), produced.dtype), (slot,))
+        out_buf, done, produced = cls._spec_activate(out_buf, done, produced, slot, row_tok, row_done, pad)
         return t_cache, d_cache, out_buf, tok, lengths, done, produced
+
+    def _seed_shared_prefix(self, cache: Any, prefix_layers: Any) -> Any:
+        """Write the prefix's FULL blocks into a pool once; every admission's
+        table then points at these ids and nothing ever writes them again
+        (decode writes start at ``lengths >= p0``)."""
+        ids = jnp.asarray(self._shared_prefix_blocks, jnp.int32)
+        width = len(self._shared_prefix_blocks) * self.block_size
+
+        def seed(cache, prefix_layers, ids):
+            pos = jnp.arange(width)
+            blk, off = ids[pos // self.block_size], pos % self.block_size
+            new_layers = []
+            for layer, pre in zip(cache, prefix_layers):
+                new_layer = dict(layer)
+                for name in pre:
+                    new_layer[name] = layer[name].at[blk, off].set(
+                        pre[name][0, :width].astype(layer[name].dtype)
+                    )
+                new_layers.append(new_layer)
+            return tuple(new_layers)
+
+        return jax.jit(seed, donate_argnums=(0,))(cache, prefix_layers, ids)
 
     def _init_carry(self) -> tuple:
         cfg = self.gen.config
@@ -326,6 +393,8 @@ class ContinuousBatcher:
                 self.gen.module.config, self.slots, self.pool_blocks + 1, self.block_size,
                 self.max_blocks, kv_dtype=cfg.kv_cache_dtype, fill_block=self._scratch_block,
             )
+            if self._shared_prefix_blocks:
+                cache = self._seed_shared_prefix(cache, self.prefix.layers)
         else:
             cache = self.gen._place_cache(
                 init_cache(self.gen.module.config, self.slots, self.cache_len, kv_dtype=cfg.kv_cache_dtype)
@@ -340,9 +409,19 @@ class ContinuousBatcher:
         if self._spec is None:
             return (cache, tok, lengths, done, key)
         draft_gen = self._spec._draft
-        d_cache = draft_gen._place_cache(
-            init_cache(draft_gen.module.config, self.slots, self.cache_len, kv_dtype=cfg.kv_cache_dtype)
-        )
+        if self.block_size is not None:
+            # the draft's pool has the same BLOCK COUNT (different shapes), so
+            # one host allocation addresses both caches
+            d_cache = init_paged_cache(
+                draft_gen.module.config, self.slots, self.pool_blocks + 1, self.block_size,
+                self.max_blocks, kv_dtype=cfg.kv_cache_dtype, fill_block=self._scratch_block,
+            )
+            if self._shared_prefix_blocks:
+                d_cache = self._seed_shared_prefix(d_cache, self._draft_prefix.layers)
+        else:
+            d_cache = draft_gen._place_cache(
+                init_cache(draft_gen.module.config, self.slots, self.cache_len, kv_dtype=cfg.kv_cache_dtype)
+            )
         cap = cfg.max_new_tokens + self._spec.gamma + 1
         out_buf = jnp.full((self.slots, cap), cfg.pad_id, jnp.int32)
         produced = jnp.zeros((self.slots,), jnp.int32)
@@ -407,15 +486,17 @@ class ContinuousBatcher:
     def _blocks_needed(self, prompt: Sequence[int], budget: int) -> int:
         """Pool blocks a request needs for its WHOLE lifetime, allocated up
         front so decode never grows mid-flight (no preemption needed). Only
-        positions ``[0, p0 + plen + budget + decode_chunk)`` are ever VISIBLE:
+        positions ``[0, p0 + plen + budget + overshoot)`` are ever VISIBLE
+        (overshoot: one decode chunk, or one round's gamma+1 verify writes):
         the prefill scatter also writes the prompt bucket's pad columns, but
         those positions are hidden by the ``slot <= position`` mask until
         decode overwrites them in order — so unallocated pad positions can land
         in the scratch block and capacity scales with the request's ACTUAL
-        prompt length and budget, not its padded bucket."""
+        prompt length and budget, not its padded bucket. Blocks covering the
+        SHARED prefix pages are excluded — every slot reads the same ids."""
         p0 = self.prefix.length if self.prefix is not None else 0
-        need = p0 + max(len(prompt), 1) + budget + self.decode_chunk
-        return -(-need // self.block_size)
+        need = p0 + max(len(prompt), 1) + budget + self._overshoot
+        return -(-need // self.block_size) - len(self._shared_prefix_blocks)
 
     # ------------------------------------------------------------------ public API
 
@@ -539,9 +620,11 @@ class ContinuousBatcher:
                 "speculative": self._spec is not None,
             }
             if self.block_size is not None:
+                # "used" includes the permanently resident shared-prefix pages
                 snapshot["kv_blocks"] = {
                     "total": self.pool_blocks,
                     "used": self.pool_blocks - len(self._free_blocks),
+                    "shared_prefix": len(self._shared_prefix_blocks),
                     "block_size": self.block_size,
                 }
             if self._spec is not None and self._spec.rounds:
@@ -615,14 +698,16 @@ class ContinuousBatcher:
                     # its FIFO position until residents free enough blocks (the
                     # engine re-enters here at every chunk boundary)
                     needed = self._blocks_needed(self._pending[0][0], self._pending[0][1].max_new)
-                    if needed > self.max_blocks:
+                    shared = self._shared_prefix_blocks
+                    if len(shared) + needed > self.max_blocks:
                         # an oversized prompt can never fit a table row: fail its
                         # stream now instead of wedging the FIFO head forever
                         prompt, session = self._pending.pop(0)
                         if not session.finished:
                             session.finished = True
                             session.out.put(ValueError(
-                                f"prompt needs {needed} KV blocks but a slot's table holds {self.max_blocks}"
+                                f"prompt needs {len(shared) + needed} KV blocks but a slot's "
+                                f"table holds {self.max_blocks}"
                             ))
                         continue
                     if needed > len(self._free_blocks):
@@ -634,7 +719,8 @@ class ContinuousBatcher:
                     alloc = [self._free_blocks.pop(0) for _ in range(needed)]
                     self._slot_blocks[slot] = alloc
                     blocks_row = np.full((self.max_blocks,), self._scratch_block, np.int32)
-                    blocks_row[: len(alloc)] = alloc
+                    blocks_row[: len(shared)] = shared
+                    blocks_row[len(shared) : len(shared) + len(alloc)] = alloc
                 self._seed += 1
                 seed = self._seed
             try:
@@ -670,7 +756,7 @@ class ContinuousBatcher:
                 if blocks_row is not None:
                     cache, tok, lengths, done = self._paged_admit_fn(
                         cache, row_cache, tok, lengths, done, jnp.int32(slot), tok0, row_len,
-                        jnp.asarray(blocks_row),
+                        jnp.asarray(blocks_row), len(self._shared_prefix_blocks),
                     )
                 else:
                     cache, tok, lengths, done = self._admit_fn(
@@ -679,11 +765,19 @@ class ContinuousBatcher:
                 self._carry = (cache, tok, lengths, done, key)
             else:
                 t_cache, d_cache, tok, lengths, done, produced, out_buf, rounds, acc, key = self._carry
-                t_cache, d_cache, out_buf, tok, lengths, done, produced = self._spec_admit_fn(
-                    t_cache, d_cache, out_buf, row_cache, d_row, tok, lengths, done, produced,
-                    jnp.int32(slot), tok0, row_len, jnp.asarray([start_done]),
-                    jnp.int32(cfg.pad_id),
-                )
+                if blocks_row is not None:
+                    t_cache, d_cache, out_buf, tok, lengths, done, produced = self._paged_spec_admit_fn(
+                        t_cache, d_cache, out_buf, row_cache, d_row, tok, lengths, done, produced,
+                        jnp.int32(slot), tok0, row_len, jnp.asarray([start_done]),
+                        jnp.int32(cfg.pad_id), jnp.asarray(blocks_row),
+                        len(self._shared_prefix_blocks),
+                    )
+                else:
+                    t_cache, d_cache, out_buf, tok, lengths, done, produced = self._spec_admit_fn(
+                        t_cache, d_cache, out_buf, row_cache, d_row, tok, lengths, done, produced,
+                        jnp.int32(slot), tok0, row_len, jnp.asarray([start_done]),
+                        jnp.int32(cfg.pad_id),
+                    )
                 self._carry = (t_cache, d_cache, tok, lengths, done, produced, out_buf, rounds, acc, key)
             with self._lock:
                 if session.finished:
@@ -718,10 +812,12 @@ class ContinuousBatcher:
         done_idx = 3 if self._spec is None else 4
         state[done_idx] = state[done_idx].at[slot].set(True)
         if self.block_size is not None:
-            state[0] = tuple(
-                {**layer, "table": layer["table"].at[slot].set(self._scratch_block)}
-                for layer in state[0]
-            )
+            # speculative mode repoints BOTH caches (carry slots 0 and 1)
+            for cache_idx in (0,) if self._spec is None else (0, 1):
+                state[cache_idx] = tuple(
+                    {**layer, "table": layer["table"].at[slot].set(self._scratch_block)}
+                    for layer in state[cache_idx]
+                )
         self._carry = tuple(state)
 
     def _release_blocks_locked(self, slot: int) -> None:
