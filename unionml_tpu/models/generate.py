@@ -149,8 +149,11 @@ def init_paged_cache(
     fill_block: int,
 ) -> Tuple[Any, ...]:
     """Per-layer PAGED KV buffers: a shared pool of ``n_blocks`` blocks of
-    ``block_size`` positions (``[n_blocks, block_size, H_kv, D]``) plus a
-    ``[slots, max_blocks]`` block table initialized to ``fill_block``.
+    ``block_size`` positions plus a ``[slots, max_blocks]`` block table
+    initialized to ``fill_block``. Pools are HEADS-MAJOR
+    (``[H_kv, n_blocks, block_size, D]``) — the layout
+    ``jax.experimental.pallas.ops.tpu.paged_attention`` consumes directly, so
+    the kernel path needs no transpose.
     ``fill_block`` is REQUIRED and must be a reserved scratch block (allocate
     ``n_blocks = real + 1`` and pass ``fill_block = real``, as
     ``ContinuousBatcher._init_carry`` does): free and finished slots keep
@@ -161,13 +164,13 @@ def init_paged_cache(
     :meth:`unionml_tpu.models.layers.Attention._paged_cached_attention` for the
     read/write contract; HBM scales with the pool, not slots x worst-case."""
     head_dim = config.dim // config.n_heads
-    shape = (n_blocks, block_size, config.n_kv_heads, head_dim)
+    shape = (config.n_kv_heads, n_blocks, block_size, head_dim)
     # one table PER layer (same values): the cache is donated through admission
     # and decode, and donating an array aliased across layers is an XLA error
     # ("donate the same buffer twice"); the duplication is a few hundred bytes
     table = lambda: jnp.full((slots, max_blocks), fill_block, jnp.int32)  # noqa: E731
     if kv_dtype == "int8":
-        scale_shape = (n_blocks, block_size, config.n_kv_heads, 1)
+        scale_shape = (config.n_kv_heads, n_blocks, block_size, 1)
         return tuple(
             {
                 "k": jnp.zeros(shape, jnp.int8),

@@ -235,22 +235,28 @@ class Attention(nn.Module):
         return dense(features, "o_proj")(out)
 
     def _paged_cached_attention(self, q, k, v, positions, cache):
-        """The paged write+read: scatter new rows through the block table, gather
-        the pool back into the logical per-row layout, attend under the same
-        ``slot <= position`` visibility mask as the contiguous branch. Scatter
-        indices collide only on the scratch block (finished rows), where the
-        winning value is irrelevant — real slots own disjoint blocks."""
+        """The paged write+read: scatter new rows through the block table, then
+        attend — via the pallas paged-attention kernel (``impl="flash"`` on TPU,
+        single-token decode: pages stream block-by-block, no gathered copy) or
+        the portable gather path (``pool[:, table]`` back to the logical layout
+        under the same ``slot <= position`` visibility mask as the contiguous
+        branch — numerically identical to it). Pools are heads-major
+        ``[H_kv, n_pages, page_size, last]``. Scatter indices collide only on
+        the scratch block (finished rows), where the winning value is
+        irrelevant — real slots own disjoint blocks."""
         table = cache["table"]  # [B, max_blocks] int32
-        block_size = cache["k"].shape[1]
+        block_size = cache["k"].shape[2]
         blk = jnp.take_along_axis(table, positions // block_size, axis=1)  # [B, L]
         off = positions % block_size
 
         def scatter(pool: jax.Array, rows: jax.Array) -> jax.Array:
-            return pool.at[blk, off].set(rows.astype(pool.dtype))
+            # rows [B, L, H_kv, last] -> pool[:, blk, off] has shape [H_kv, B, L, last]
+            return pool.at[:, blk, off].set(jnp.moveaxis(rows, 2, 0).astype(pool.dtype))
 
         def logical(pool: jax.Array) -> jax.Array:
-            rows = pool[table]  # [B, MB, bs, H_kv, last]
-            return rows.reshape(rows.shape[0], -1, *rows.shape[3:])
+            rows = pool[:, table]  # [H_kv, B, MB, bs, last]
+            rows = rows.reshape(rows.shape[0], rows.shape[1], -1, rows.shape[-1])
+            return jnp.transpose(rows, (1, 2, 0, 3))  # [B, MB * bs, H_kv, last]
 
         if "k_scale" in cache:
             kq, k_scale = quantize_kv_rows(k)
@@ -266,6 +272,15 @@ class Attention(nn.Module):
             values = (logical(cache["v"]).astype(jnp.float32) * logical(cache["v_scale"])).astype(q.dtype)
         else:
             cache = {"k": scatter(cache["k"], k), "v": scatter(cache["v"], v), "table": table}
+            if self.impl == "flash" and q.shape[1] == 1:
+                # single-token decode through the pallas kernel (TPU only); the
+                # row's visible length includes the token just scattered
+                from unionml_tpu.ops.paged_attention import paged_decode_attention
+
+                out = paged_decode_attention(
+                    q[:, 0], cache["k"], cache["v"], positions[:, 0] + 1, table
+                )
+                return out[:, None], cache
             keys = logical(cache["k"]).astype(q.dtype)
             values = logical(cache["v"]).astype(q.dtype)
         visible = (

@@ -1,0 +1,71 @@
+"""Paged-attention decode over heads-major block pools.
+
+The gather path in :meth:`unionml_tpu.models.layers.Attention._paged_cached_attention`
+materializes ``pool[table]`` — a full logical-layout copy of every resident
+row's K/V per layer per step — before attending. This module routes the decode
+read through the pallas paged-attention kernel that ships with JAX
+(``jax.experimental.pallas.ops.tpu.paged_attention``, the production TPU
+serving kernel): it DMAs exactly the pages each row's table names, streams them
+block-by-block through flash-style online softmax, and never materializes the
+gathered copy — decode KV traffic drops to one pool read.
+
+The pool layout (``[H_kv, n_pages, page_size, D]``,
+:func:`unionml_tpu.models.generate.init_paged_cache`) matches the kernel's
+expectation, so dispatch is zero-copy. TPU-only (the kernel has no interpret
+mode); the portable gather path remains the default until the kernel wins its
+shootout (``benchmarks/bench_paged_attention.py``) — the same auto policy as
+:mod:`unionml_tpu.ops.flash_attention`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["paged_decode_attention"]
+
+
+def _pages_per_block(pages_per_sequence: int, target: int = 8) -> int:
+    """Largest divisor of ``pages_per_sequence`` that is <= ``target`` (the
+    kernel requires an exact tiling of the table width)."""
+    for candidate in range(min(target, pages_per_sequence), 0, -1):
+        if pages_per_sequence % candidate == 0:
+            return candidate
+    return 1
+
+
+def paged_decode_attention(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    lengths: jax.Array,
+    page_indices: jax.Array,
+    *,
+    pages_per_compute_block: Optional[int] = None,
+) -> jax.Array:
+    """One decode step of attention over paged K/V.
+
+    ``q: [B, H, D]``, ``k_pages/v_pages: [H_kv, n_pages, page_size, D]``,
+    ``lengths: [B] int32`` (visible positions per row, INCLUDING the token just
+    written), ``page_indices: [B, pages_per_sequence] int32``. Returns
+    ``[B, H, D]``. Grouped-query attention is native (``H % H_kv == 0``).
+
+    The library kernel computes RAW ``qk`` logits (no softmax scale anywhere in
+    ``paged_flash_attention_kernel``), so ``q`` is pre-scaled by
+    ``head_dim ** -0.5`` here — numerics then match
+    :func:`unionml_tpu.ops.attention.dot_product_attention` and the gather path.
+    """
+    from jax.experimental.pallas.ops.tpu.paged_attention import paged_attention
+
+    ppcb = pages_per_compute_block or _pages_per_block(page_indices.shape[1])
+    scale = q.shape[-1] ** -0.5
+    return paged_attention(
+        (q * scale).astype(q.dtype),
+        k_pages,
+        v_pages,
+        lengths.astype(jnp.int32),
+        page_indices,
+        pages_per_compute_block=ppcb,
+    )

@@ -298,17 +298,19 @@ class ContinuousBatcher:
         entries are SHARED prefix pages, already seeded once — the row's copy of
         the prefix is identical, but re-writing shared pages per admission is
         wasted bandwidth."""
-        block_size = cache[0]["k"].shape[1]
-        scratch = cache[0]["k"].shape[0] - 1  # scratch is the last pool block
+        block_size = cache[0]["k"].shape[2]  # pools are heads-major [H_kv, NB, bs, last]
+        scratch = cache[0]["k"].shape[1] - 1  # scratch is the last pool block
         new_layers = []
         for layer, row in zip(cache, row_cache):
-            pos = jnp.arange(row["k"].shape[1])
+            pos = jnp.arange(row["k"].shape[1])  # the dense row is [1, cache_len, H, last]
             blk, off = blocks_row[pos // block_size], pos % block_size
             if skip:
                 blk = jnp.where(pos < skip * block_size, scratch, blk)
             new_layer = {"table": jax.lax.dynamic_update_slice(layer["table"], blocks_row[None], (slot, 0))}
             for name in row:
-                new_layer[name] = layer[name].at[blk, off].set(row[name][0].astype(layer[name].dtype))
+                new_layer[name] = layer[name].at[:, blk, off].set(
+                    jnp.swapaxes(row[name][0], 0, 1).astype(layer[name].dtype)
+                )
             new_layers.append(new_layer)
         tok = jax.lax.dynamic_update_slice(tok, row_tok.astype(tok.dtype), (slot,))
         lengths = jax.lax.dynamic_update_slice(lengths, row_len.astype(lengths.dtype), (slot,))
@@ -374,9 +376,9 @@ class ContinuousBatcher:
             new_layers = []
             for layer, pre in zip(cache, prefix_layers):
                 new_layer = dict(layer)
-                for name in pre:
-                    new_layer[name] = layer[name].at[blk, off].set(
-                        pre[name][0, :width].astype(layer[name].dtype)
+                for name in pre:  # pools heads-major; prefix rows [1, p0, H, last]
+                    new_layer[name] = layer[name].at[:, blk, off].set(
+                        jnp.swapaxes(pre[name][0, :width], 0, 1).astype(layer[name].dtype)
                     )
                 new_layers.append(new_layer)
             return tuple(new_layers)
