@@ -107,12 +107,53 @@ def main() -> None:
         log("FATAL: kernel output diverges from the gather reference")
         sys.exit(1)
 
+    # int8 pages through the kernel, measured to SETTLE the analysis (the
+    # library broadcasts scales to full head width per page, predicting ~2.5x
+    # the bf16 traffic, which is why layers.py keeps int8 on the gather path);
+    # the timing only counts if the quantized output matches the dequantized
+    # gather reference
+    from unionml_tpu.models.layers import quantize_kv_rows
+
+    kq, k_sc = quantize_kv_rows(k_pages)
+    vq, v_sc = quantize_kv_rows(v_pages)
+    int8_ms = None
+    try:
+        int8_out = np.asarray(
+            paged_decode_attention(
+                q, kq, vq, lengths, table, k_scales=k_sc, v_scales=v_sc,
+                pages_per_compute_block=best_ppcb,
+            ),
+            np.float32,
+        )
+        int8_ref = np.asarray(
+            gather_path(
+                q,
+                (kq.astype(jnp.float32) * k_sc).astype(jnp.bfloat16),
+                (vq.astype(jnp.float32) * v_sc).astype(jnp.bfloat16),
+                table, lengths,
+            ),
+            np.float32,
+        )
+        int8_err = float(np.max(np.abs(int8_ref - int8_out)))
+        if int8_err > 0.1:
+            raise RuntimeError(f"int8 kernel diverges from dequantized reference (max |diff| {int8_err:.4f})")
+        int8_ms = _time(
+            lambda q, kq, vq, ks, vs, ln, tb: paged_decode_attention(
+                q, kq, vq, ln, tb, k_scales=ks, v_scales=vs, pages_per_compute_block=best_ppcb
+            ),
+            q, kq, vq, k_sc, v_sc, lengths, table,
+        ) * 1e3
+        log(f"int8 pages: {int8_ms:.3f} ms ({kernel_ms / int8_ms:.2f}x vs bf16 kernel), max |diff| {int8_err:.4f}")
+    except Exception as exc:
+        log(f"int8 kernel path failed ({type(exc).__name__}: {exc}); reporting bf16 only")
+
     emit(
         "paged_attention_decode_step",
         kernel_ms,
         "ms",
         gather_ms / kernel_ms,
         gather_ms=round(gather_ms, 3),
+        int8_ms=round(int8_ms, 3) if int8_ms is not None else None,
         pages_per_compute_block=best_ppcb,
         context=CONTEXT,
         slots=S,

@@ -258,6 +258,7 @@ class Attention(nn.Module):
             rows = rows.reshape(rows.shape[0], rows.shape[1], -1, rows.shape[-1])
             return jnp.transpose(rows, (1, 2, 0, 3))  # [B, MB * bs, H_kv, last]
 
+        use_kernel = self.impl == "flash" and q.shape[1] == 1
         if "k_scale" in cache:
             kq, k_scale = quantize_kv_rows(k)
             vq, v_scale = quantize_kv_rows(v)
@@ -268,11 +269,17 @@ class Attention(nn.Module):
                 "v_scale": scatter(cache["v_scale"], v_scale),
                 "table": table,
             }
+            # int8 pages stay on the gather path even under impl="flash": the
+            # library kernel broadcasts the per-position scales to FULL head
+            # width and DMAs them alongside the int8 pages (5 B/elem vs bf16's
+            # 2), so routing int8 through it would RAISE page traffic — the
+            # shootout (bench_paged_attention.py) measures the kernel's int8
+            # mode anyway, and this gate flips only if hardware disagrees
             keys = (logical(cache["k"]).astype(jnp.float32) * logical(cache["k_scale"])).astype(q.dtype)
             values = (logical(cache["v"]).astype(jnp.float32) * logical(cache["v_scale"])).astype(q.dtype)
         else:
             cache = {"k": scatter(cache["k"], k), "v": scatter(cache["v"], v), "table": table}
-            if self.impl == "flash" and q.shape[1] == 1:
+            if use_kernel:
                 # single-token decode through the pallas kernel (TPU only); the
                 # row's visible length includes the token just scattered
                 from unionml_tpu.ops.paged_attention import paged_decode_attention

@@ -43,6 +43,8 @@ def paged_decode_attention(
     lengths: jax.Array,
     page_indices: jax.Array,
     *,
+    k_scales: Optional[jax.Array] = None,
+    v_scales: Optional[jax.Array] = None,
     pages_per_compute_block: Optional[int] = None,
 ) -> jax.Array:
     """One decode step of attention over paged K/V.
@@ -52,13 +54,31 @@ def paged_decode_attention(
     written), ``page_indices: [B, pages_per_sequence] int32``. Returns
     ``[B, H, D]``. Grouped-query attention is native (``H % H_kv == 0``).
 
+    ``k_scales``/``v_scales`` (``[H_kv, n_pages, page_size, 1]`` f32, OUR int8
+    convention: ``dequant = int8 * scale``) switch to the kernel's quantized
+    page path; our scales map exactly via ``h = scale * 127.5`` (the kernel
+    dequantizes ``int8 * h / 127.5``). CAVEAT: the library broadcasts the
+    scales to FULL head width before launch and DMAs them per page, so int8
+    pages cost ~5 B/elem of traffic vs bf16's 2 — the mode exists for the
+    shootout's measurement, not as a recommended production path.
+
     The library kernel computes RAW ``qk`` logits (no softmax scale anywhere in
     ``paged_flash_attention_kernel``), so ``q`` is pre-scaled by
     ``head_dim ** -0.5`` here — numerics then match
     :func:`unionml_tpu.ops.attention.dot_product_attention` and the gather path.
     """
     from jax.experimental.pallas.ops.tpu.paged_attention import paged_attention
+    from jax.experimental.pallas.ops.tpu.paged_attention import quantization_utils
 
+    if (k_scales is None) != (v_scales is None):
+        raise ValueError("k_scales and v_scales must be passed together")
+    if k_scales is not None:
+        k_pages = quantization_utils.QuantizedTensor(
+            weight=k_pages, scales=(k_scales * quantization_utils.MAX_INT8).astype(jnp.float32)
+        )
+        v_pages = quantization_utils.QuantizedTensor(
+            weight=v_pages, scales=(v_scales * quantization_utils.MAX_INT8).astype(jnp.float32)
+        )
     ppcb = pages_per_compute_block or _pages_per_block(page_indices.shape[1])
     scale = q.shape[-1] ** -0.5
     return paged_attention(
