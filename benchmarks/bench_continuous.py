@@ -117,7 +117,7 @@ def main() -> None:
         Generator(module, params, cfg), slots=top, decode_chunk=8, block_size=block
     )
     pool = max(
-        sum(sizer._blocks_needed(mixed_prompts[i], budgets[i]) for i in range(top)),
+        sum(sizer._blocks_lifetime(mixed_prompts[i], budgets[i]) for i in range(top)),
         sizer.max_blocks,
     )
     dense_kv_positions = top * sizer.cache_len

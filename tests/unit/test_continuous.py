@@ -362,7 +362,7 @@ def test_paged_kv_matches_sequential_with_undersized_pool(tiny_gen):
         # worst-case sizing would need slots * max_blocks; the pool is smaller
         assert batcher.pool_blocks < batcher.slots * batcher.max_blocks
         # every request (budget 4) needs few enough blocks that all 4 fit at once
-        assert 4 * batcher._blocks_needed(PROMPTS[0], 4) <= batcher.pool_blocks
+        assert 4 * batcher._blocks_lifetime(PROMPTS[0], 4) <= batcher.pool_blocks
         results = [None] * 4
 
         def worker(i):
@@ -376,7 +376,9 @@ def test_paged_kv_matches_sequential_with_undersized_pool(tiny_gen):
         assert results == [e[:4] for e in expected]
         assert batcher.decoded_rows > batcher.decode_dispatches  # dispatches were shared
         stats = batcher.stats()["kv_blocks"]
-        assert stats == {"total": 10, "used": 0, "shared_prefix": 0, "block_size": 8}  # all freed
+        assert stats == {
+            "total": 10, "used": 0, "shared_prefix": 0, "block_size": 8, "preemptions": 0,
+        }  # all freed, nobody evicted
     finally:
         batcher.close()
 
@@ -451,6 +453,106 @@ def test_paged_kv_oversized_prompt_fails_cleanly(tiny_gen):
         batcher.close()
 
 
+def test_paged_preemption_recovers_token_exact(tiny_gen):
+    """Pool exhaustion mid-decode preempts the YOUNGEST resident (freed,
+    requeued as prompt + emitted tokens, re-prefilled) — and the evicted
+    stream's total output is still exactly its sequential run: recompute
+    preemption is invisible in tokens. Pool = one worst-case request, so two
+    long-budget residents cannot coexist to completion."""
+    module, params = tiny_gen
+    cfg = GenerationConfig(max_new_tokens=16, temperature=0.0, prompt_buckets=(16,))
+    expected = _sequential_expected(module, params, cfg, PROMPTS[:3])
+
+    gen = Generator(module, params, cfg)
+    probe = ContinuousBatcher(gen, slots=3, decode_chunk=2, block_size=8)
+    min_pool = probe.max_blocks
+    probe.close()
+    batcher = ContinuousBatcher(gen, slots=3, decode_chunk=2, block_size=8, pool_blocks=min_pool)
+    try:
+        results = [None] * 3
+
+        def worker(i):
+            results[i] = _drain(batcher.submit(PROMPTS[i]))
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert results == expected
+        stats = batcher.stats()["kv_blocks"]
+        assert stats["preemptions"] > 0  # the tight pool actually evicted someone
+        assert stats["used"] == 0
+    finally:
+        batcher.close()
+
+
+def test_paged_preempted_resume_outgrows_buckets(tiny_gen):
+    """A preempted stream's resume prompt (original + emitted) can exceed every
+    configured prompt bucket; the resume must prefill at exact width and stay
+    token-exact instead of failing the stream mid-generation (round-4 review
+    repro: bucket 16, resume length 19 -> oversized-bucket ValueError)."""
+    module, params = tiny_gen
+    cfg = GenerationConfig(max_new_tokens=16, temperature=0.0, prompt_buckets=(16,))
+    long_prompts = [[3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8, 9, 7], [2, 7, 1, 8, 2, 8, 1, 8, 2, 8, 4, 5, 9, 0o4]]
+    expected = _sequential_expected(module, params, cfg, long_prompts)
+
+    gen = Generator(module, params, cfg)
+    probe = ContinuousBatcher(gen, slots=2, decode_chunk=8, block_size=8)
+    # big enough to ADMIT both (initial needs), too small for both to finish —
+    # and chunk 8 means the victim has a full chunk in its echo at eviction,
+    # so its resume prompt (14 + 9 = 23) overflows the single 16-wide bucket
+    pool = 2 * probe._blocks_initial(long_prompts[0], cfg.max_new_tokens)
+    assert pool < 2 * probe._blocks_lifetime(long_prompts[0], cfg.max_new_tokens)
+    probe.close()
+    batcher = ContinuousBatcher(gen, slots=2, decode_chunk=8, block_size=8, pool_blocks=pool)
+    try:
+        results = [None] * 2
+
+        def worker(i):
+            results[i] = _drain(batcher.submit(long_prompts[i]))
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert results == expected
+        assert batcher.stats()["kv_blocks"]["preemptions"] > 0  # the repro actually fired
+    finally:
+        batcher.close()
+
+
+def test_paged_lazy_growth_admits_beyond_reserved_budgets(tiny_gen):
+    """Lazy allocation: admission reserves only prompt + one dispatch, so a
+    pool far below the residents' SUMMED lifetime needs still admits them all
+    concurrently — blocks arrive as decoding actually proceeds."""
+    module, params = tiny_gen
+    cfg = GenerationConfig(max_new_tokens=12, temperature=0.0, prompt_buckets=(16,))
+    expected = _sequential_expected(module, params, cfg, PROMPTS[:4])
+
+    gen = Generator(module, params, cfg)
+    batcher = ContinuousBatcher(gen, slots=4, decode_chunk=3, block_size=8, pool_blocks=8)
+    try:
+        # the pool cannot hold 4 lifetime reservations...
+        assert 4 * batcher._blocks_lifetime(PROMPTS[0], cfg.max_new_tokens) > batcher.pool_blocks
+        # ...but it CAN admit all 4 (initial needs only)
+        assert 4 * batcher._blocks_initial(PROMPTS[0], cfg.max_new_tokens) <= batcher.pool_blocks
+        results = [None] * 4
+
+        def worker(i):
+            results[i] = _drain(batcher.submit(PROMPTS[i]))
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert results == expected
+    finally:
+        batcher.close()
+
+
 def test_paged_shared_prefix_pages(tiny_gen):
     """A long system prompt's FULL blocks are seeded once and SHARED: every
     slot's table points at the same page ids (vLLM's prefix caching), so
@@ -468,8 +570,8 @@ def test_paged_shared_prefix_pages(tiny_gen):
     )
     try:
         assert len(batcher._shared_prefix_blocks) == 2  # 20 // 8
-        # per-request need excludes the shared pages: ceil((20+4+6+3)/8)=5 - 2
-        assert batcher._blocks_needed(suffixes[1], 6) == 3
+        # admission need excludes the shared pages: ceil((20+4+3+3)/8)=4 - 2
+        assert batcher._blocks_initial(suffixes[1], 6) == 2
         results = [_drain(batcher.submit(s)) for s in suffixes]
         assert results == expected
         stats = batcher.stats()["kv_blocks"]
@@ -614,10 +716,10 @@ def test_cancel_during_prefill_window_returns_slot(tiny_gen):
         entered, gate = threading.Event(), threading.Event()
         orig = batcher._prefill_row
 
-        def slow_prefill(prompt, seed, gen=None):
+        def slow_prefill(prompt, seed, *args, **kwargs):
             entered.set()
             gate.wait(timeout=30)
-            return orig(prompt, seed, gen=gen)
+            return orig(prompt, seed, *args, **kwargs)
 
         batcher._prefill_row = slow_prefill
         stream = batcher.submit(PROMPTS[0])

@@ -78,6 +78,21 @@ class _Session:
     max_new: int  # this request's token budget (<= config.max_new_tokens)
     produced: int = 0  # tokens emitted so far (includes the prefill token)
     finished: bool = False
+    #: every token emitted so far — a PREEMPTED request resumes by prefilling
+    #: (original prompt + echo), which reproduces its greedy continuation
+    #: exactly; bounded by max_new ints of host memory
+    echo: "List[int]" = dataclasses.field(default_factory=list)
+    #: ``produced`` at the start of the current residency: the device-side
+    #: out_buf/produced counters restart at each (re)admission, so host slices
+    #: of device output are offset by this base (speculative mode)
+    resident_base: int = 0
+    #: admission sequence number — preemption evicts the YOUNGEST resident
+    admit_seq: int = 0
+    #: absolute position of this residency's first decode write
+    #: (prefix + resumed-prompt length); drives lazy block growth
+    row_start: int = 0
+    #: the ORIGINAL prompt from submit(); a resume prefills prompt + echo
+    prompt: "List[int]" = dataclasses.field(default_factory=list)
 
 
 class _TokenStream:
@@ -263,6 +278,8 @@ class ContinuousBatcher:
         #: dispatch/utilization counters for benchmarks and /metrics
         self.decode_dispatches = 0
         self.decoded_rows = 0
+        self.preemptions = 0
+        self._admit_counter = 0
         # high-water marks of the carry's ride-along counters, so the spec
         # engine's rounds/accepted_tokens telemetry gets per-dispatch deltas
         self._spec_rounds_seen = 0
@@ -437,6 +454,7 @@ class ContinuousBatcher:
         seed: int,
         gen: Optional[Generator] = None,
         prefix: Optional[PrefixCache] = None,
+        budget: Optional[int] = None,
     ):
         """Prefill one prompt at batch 1 into a fresh [1, cache_len] cache using
         the Generator's own jitted machinery — identical numerics and the same
@@ -445,18 +463,31 @@ class ContinuousBatcher:
         (a suffix) flows through the offset chunked path, exactly like
         ``Generator.__call__(..., prefix=...)``. ``gen``/``prefix`` override the
         model and its prefix rows (speculative mode prefills the draft's row
-        with the DRAFT's prefix)."""
+        with the DRAFT's prefix). ``budget`` is THIS request's remaining token
+        budget (default: the config's) — feasibility and the resume-width
+        fallback below depend on it, not on the config worst case."""
         cfg = self.gen.config
         if gen is None:
             gen, prefix = self.gen, self.prefix
+        if budget is None:
+            budget = cfg.max_new_tokens
         # draft and target prefixes have the same length (same token ids)
         p0 = self.prefix.length if self.prefix is not None else 0
         bucket = gen._bucket(max(len(prompt), 1))
-        if p0 + bucket + cfg.max_new_tokens > self.cache_len:
-            raise ValueError(
-                f"prompt of length {len(prompt)} needs prefix {p0} + bucket {bucket} + "
-                f"{cfg.max_new_tokens} new tokens > cache_len {self.cache_len}"
-            )
+        if p0 + bucket + budget > self.cache_len:
+            # a PREEMPTED request resumes as prompt + emitted tokens, which can
+            # outgrow every configured bucket while still fitting the cache
+            # contiguously (prompt + remaining budget <= cache_len by
+            # construction) — prefill at the exact width instead of failing the
+            # stream; the extra compile is bounded by preemptions being rare
+            exact = max(len(prompt), 1)
+            if p0 + exact + budget <= self.cache_len:
+                bucket = exact
+            else:
+                raise ValueError(
+                    f"prompt of length {len(prompt)} needs prefix {p0} + bucket {bucket} + "
+                    f"{budget} new tokens > cache_len {self.cache_len}"
+                )
         tokens = np.full((1, bucket), cfg.pad_id, np.int32)
         tokens[0, : len(prompt)] = np.asarray(prompt, np.int32)
         lengths = jnp.asarray([p0 + max(len(prompt), 1)], jnp.int32)
@@ -485,20 +516,37 @@ class ContinuousBatcher:
             )
         return tok0, lengths, row_cache
 
-    def _blocks_needed(self, prompt: Sequence[int], budget: int) -> int:
-        """Pool blocks a request needs for its WHOLE lifetime, allocated up
-        front so decode never grows mid-flight (no preemption needed). Only
-        positions ``[0, p0 + plen + budget + overshoot)`` are ever VISIBLE
-        (overshoot: one decode chunk, or one round's gamma+1 verify writes):
-        the prefill scatter also writes the prompt bucket's pad columns, but
-        those positions are hidden by the ``slot <= position`` mask until
-        decode overwrites them in order — so unallocated pad positions can land
-        in the scratch block and capacity scales with the request's ACTUAL
-        prompt length and budget, not its padded bucket. Blocks covering the
+    def _blocks_for_tokens(self, tokens: int) -> int:
+        """Private (non-shared) blocks covering positions ``[0, tokens)``.
+        Only real, still-visible positions need real blocks: the prefill
+        scatter also writes the prompt bucket's pad columns, but those are
+        hidden by the ``slot <= position`` mask until decode overwrites them in
+        order, so they can land in the scratch block. Blocks covering the
         SHARED prefix pages are excluded — every slot reads the same ids."""
+        return max(0, -(-tokens // self.block_size) - len(self._shared_prefix_blocks))
+
+    def _blocks_initial(self, prompt: Sequence[int], budget: int) -> int:
+        """Blocks an ADMISSION needs — the same target the first
+        :meth:`_ensure_capacity_locked` pass will demand (prompt + one chunk of
+        lookahead, capped at the request's remaining budget), so a fresh
+        admission is never admit-then-instantly-preempted. Allocation is lazy
+        from there: residents grow at chunk boundaries and are preempted LIFO
+        when the pool runs dry, so resident HBM tracks tokens actually decoded,
+        not reserved budgets (the vLLM scheduling model)."""
         p0 = self.prefix.length if self.prefix is not None else 0
-        need = p0 + max(len(prompt), 1) + budget + self._overshoot
-        return -(-need // self.block_size) - len(self._shared_prefix_blocks)
+        plen = max(len(prompt), 1)
+        tokens = min(
+            p0 + plen + self.decode_chunk + self._overshoot,
+            p0 + plen + budget - 1 + self._overshoot,
+        )
+        return self._blocks_for_tokens(tokens)
+
+    def _blocks_lifetime(self, prompt: Sequence[int], budget: int) -> int:
+        """Worst-case blocks over a request's whole life (prompt + its budget +
+        dispatch overshoot) — the feasibility bound for the oversized check and
+        the guarantee that a lone worst-case request always fits."""
+        p0 = self.prefix.length if self.prefix is not None else 0
+        return self._blocks_for_tokens(p0 + max(len(prompt), 1) + budget + self._overshoot)
 
     # ------------------------------------------------------------------ public API
 
@@ -519,7 +567,11 @@ class ContinuousBatcher:
                     f"max_new_tokens must be in [1, {budget}] (the config budget the cache is sized for)"
                 )
             budget = max_new_tokens
-        session = _Session(slot=-1, out=queue.Queue(), max_new=budget)
+        session = _Session(
+            slot=-1, out=queue.Queue(), max_new=budget,
+            # the original prompt is retained only where preemption can resume it
+            prompt=list(prompt) if self.block_size is not None else [],
+        )
         with self._lock:
             if self._closed:
                 raise RuntimeError("ContinuousBatcher is closed")
@@ -628,6 +680,7 @@ class ContinuousBatcher:
                     "used": self.pool_blocks - len(self._free_blocks),
                     "shared_prefix": len(self._shared_prefix_blocks),
                     "block_size": self.block_size,
+                    "preemptions": self.preemptions,
                 }
             if self._spec is not None and self._spec.rounds:
                 snapshot["acceptance_rate"] = round(
@@ -692,23 +745,35 @@ class ContinuousBatcher:
         cfg = self.gen.config
         while True:
             with self._lock:
+                # drop dead heads before paying allocation/prefill for them
+                # (cancelled while pending; their consumers hold the sentinel)
+                while self._pending and self._pending[0][1].finished:
+                    self._pending.pop(0)
                 if self._closed or not self._pending or not self._free:
                     return
                 blocks_row = None
                 if self.block_size is not None:
-                    # memory-pressure admission: the head-of-line request keeps
-                    # its FIFO position until residents free enough blocks (the
-                    # engine re-enters here at every chunk boundary)
-                    needed = self._blocks_needed(self._pending[0][0], self._pending[0][1].max_new)
+                    # memory-pressure admission: allocation covers only the
+                    # prompt + first dispatch (residents grow lazily); the
+                    # head-of-line request keeps its FIFO position until blocks
+                    # free up (the engine re-enters here at every chunk
+                    # boundary, and preemption favors residents over waiters)
+                    head_prompt, head_session = self._pending[0]
+                    needed = self._blocks_initial(
+                        head_prompt, head_session.max_new - head_session.produced
+                    )
                     shared = self._shared_prefix_blocks
-                    if len(shared) + needed > self.max_blocks:
+                    lifetime = self._blocks_lifetime(
+                        head_prompt, head_session.max_new - head_session.produced
+                    )
+                    if len(shared) + lifetime > self.max_blocks:
                         # an oversized prompt can never fit a table row: fail its
                         # stream now instead of wedging the FIFO head forever
                         prompt, session = self._pending.pop(0)
                         if not session.finished:
                             session.finished = True
                             session.out.put(ValueError(
-                                f"prompt needs {len(shared) + needed} KV blocks but a slot's "
+                                f"prompt needs {len(shared) + lifetime} KV blocks but a slot's "
                                 f"table holds {self.max_blocks}"
                             ))
                         continue
@@ -717,6 +782,10 @@ class ContinuousBatcher:
                 prompt, session = self._pending.pop(0)
                 slot = self._free.pop(0)
                 session.slot = slot
+                session.admit_seq = self._admit_counter
+                self._admit_counter += 1
+                p0 = self.prefix.length if self.prefix is not None else 0
+                session.row_start = p0 + max(len(prompt), 1)
                 if self.block_size is not None:
                     alloc = [self._free_blocks.pop(0) for _ in range(needed)]
                     self._slot_blocks[slot] = alloc
@@ -725,15 +794,17 @@ class ContinuousBatcher:
                     blocks_row[len(shared) : len(shared) + len(alloc)] = alloc
                 self._seed += 1
                 seed = self._seed
+            remaining = session.max_new - session.produced
             try:
-                tok0, row_len, row_cache = self._prefill_row(prompt, seed)
+                tok0, row_len, row_cache = self._prefill_row(prompt, seed, budget=remaining)
                 if self._spec is not None:
                     # the draft's cache row: same prompt through the draft model
                     # with the DRAFT's prefix rows (its prompt-sampled token is
                     # discarded — emission #1 is the target's, exactly as in
                     # SpeculativeGenerator._start_state)
                     _, _, d_row = self._prefill_row(
-                        prompt, seed, gen=self._spec._draft, prefix=self._draft_prefix
+                        prompt, seed, gen=self._spec._draft, prefix=self._draft_prefix,
+                        budget=remaining,
                     )
             except ValueError as exc:
                 # a bad prompt (e.g. longer than the cache can hold) fails its
@@ -752,7 +823,8 @@ class ContinuousBatcher:
                 self._carry = self._init_carry()
             first = np.asarray(tok0)
             hit_eos = cfg.eos_id is not None and int(first[0]) == cfg.eos_id
-            start_done = hit_eos or 1 >= session.max_new
+            # produced carries across preemptions; this residency adds one token
+            start_done = hit_eos or session.produced + 1 >= session.max_new
             if self._spec is None:
                 cache, tok, lengths, done, key = self._carry
                 if blocks_row is not None:
@@ -792,7 +864,10 @@ class ContinuousBatcher:
                     self._mask_slot_done(slot)
                     continue
                 session.out.put(first)
-                session.produced = 1
+                if self.block_size is not None:  # echo exists only for preemption resume
+                    session.echo.append(int(first[0]))
+                session.resident_base = session.produced
+                session.produced += 1
                 self._sessions[slot] = session
                 if start_done:
                     # speculative mode already marked the row done on device
@@ -827,6 +902,72 @@ class ContinuousBatcher:
         if self.block_size is not None:
             self._free_blocks.extend(self._slot_blocks.pop(slot, []))
 
+    def _extend_tables(self, slot: int, start_idx: int, ids: "List[int]") -> None:
+        """Append freshly allocated block ids to a resident slot's table row in
+        every cache (engine thread only)."""
+        if not ids or self._carry is None:
+            return
+        ids_arr = jnp.asarray(ids, jnp.int32)
+        state = list(self._carry)
+        for cache_idx in (0,) if self._spec is None else (0, 1):
+            state[cache_idx] = tuple(
+                {**layer, "table": layer["table"].at[slot, start_idx : start_idx + len(ids)].set(ids_arr)}
+                for layer in state[cache_idx]
+            )
+        self._carry = tuple(state)
+
+    def _preempt_locked(self, slot: int) -> None:
+        """Evict a resident under pool exhaustion: free its slot/blocks, mask
+        its row, and requeue it at the FIFO head as (original prompt + every
+        token already emitted) — the resumed prefill's greedy continuation is
+        token-identical, so the consumer never notices beyond latency. The
+        cost is recomputing the evicted context once (vLLM's recompute
+        preemption)."""
+        session = self._sessions.pop(slot)
+        self.preemptions += 1
+        self._free.append(slot)
+        self._release_blocks_locked(slot)
+        self._mask_slot_done(slot)
+        session.slot = -1
+        if not session.finished:
+            # a cancelled-but-not-yet-reaped victim is simply dropped — its
+            # consumer already has the sentinel, and requeuing it would waste a
+            # full prefill before admission notices it is dead
+            self._pending.insert(0, (list(session.prompt) + list(session.echo), session))
+
+    def _ensure_capacity_locked(self) -> None:
+        """Lazy growth at every chunk boundary (engine thread, lock held):
+        each resident's table must cover the NEXT dispatch's worst-case writes;
+        when the pool cannot supply the growth, the YOUNGEST resident is
+        preempted and retried — older residents keep their pages (LIFO, so
+        long-running streams converge instead of thrashing). A lone resident
+        can always grow to its lifetime need (pool >= max_blocks)."""
+        if self.block_size is None:
+            return
+        while True:
+            deficits = {}
+            for slot, session in self._sessions.items():
+                produced_res = session.produced - session.resident_base
+                # one chunk of lookahead, capped at the session's lifetime
+                # ceiling (a small remaining budget never over-grows)
+                tokens = min(
+                    session.row_start + max(produced_res - 1, 0) + self.decode_chunk + self._overshoot,
+                    session.row_start + (session.max_new - session.resident_base) - 1 + self._overshoot,
+                )
+                target = self._blocks_for_tokens(tokens)
+                have = len(self._slot_blocks.get(slot, ()))
+                if target > have:
+                    deficits[slot] = target - have
+            if sum(deficits.values()) <= len(self._free_blocks):
+                for slot, extra in deficits.items():
+                    alloc = [self._free_blocks.pop(0) for _ in range(extra)]
+                    start_idx = len(self._shared_prefix_blocks) + len(self._slot_blocks[slot])
+                    self._slot_blocks[slot].extend(alloc)
+                    self._extend_tables(slot, start_idx, alloc)
+                return
+            victim = max(self._sessions, key=lambda s: self._sessions[s].admit_seq)
+            self._preempt_locked(victim)
+
     def _finish_locked(self, slot: int, *, device_done: bool) -> None:
         session = self._sessions.pop(slot)
         session.finished = True
@@ -842,6 +983,10 @@ class ContinuousBatcher:
         session.out.put(_SENTINEL)
 
     def _decode_chunk(self) -> None:
+        with self._lock:
+            self._ensure_capacity_locked()
+            if not self._sessions:
+                return  # growth preempted the last resident; re-admission next loop
         if self._spec is not None:
             return self._spec_chunk()
         cfg = self.gen.config
@@ -862,6 +1007,8 @@ class ContinuousBatcher:
                         take = min(take, int(hits[0]) + 1)  # emit the eos, stop after
                 if take > 0:
                     session.out.put(row[:take].copy())
+                    if self.block_size is not None:
+                        session.echo.extend(int(t) for t in row[:take])
                     session.produced += take
                 device_done = bool(done_np[slot])
                 if session.produced >= session.max_new or device_done:
@@ -878,7 +1025,10 @@ class ContinuousBatcher:
         with self._lock:
             budget_np = np.zeros((self.slots,), np.int32)
             for slot, session in self._sessions.items():
-                budget_np[slot] = session.max_new
+                # device counters are per-RESIDENCY: a resumed (preempted)
+                # session's out_buf restarted at its re-admission, so its
+                # device budget is the tokens remaining at that point
+                budget_np[slot] = session.max_new - session.resident_base
         budget = jnp.asarray(budget_np)
         # per-row floor: every unfinished row gains >= decode_chunk tokens this
         # dispatch (capped by its budget); free slots are done and ignored
@@ -902,9 +1052,11 @@ class ContinuousBatcher:
             self.decoded_rows += len(self._sessions)
             for slot in list(self._sessions):
                 session = self._sessions[slot]
-                new = out_np[slot, session.produced : prod_np[slot]]
+                new = out_np[slot, session.produced - session.resident_base : prod_np[slot]]
                 if new.size:
                     session.out.put(new.copy())
-                    session.produced = int(prod_np[slot])
+                    if self.block_size is not None:
+                        session.echo.extend(int(t) for t in new)
+                    session.produced = session.resident_base + int(prod_np[slot])
                 if bool(done_np[slot]):
                     self._finish_locked(slot, device_done=True)
