@@ -119,6 +119,11 @@ def test_text_generation_template_trains_generates_and_serves(render, tmp_path):
     generation = metrics_payload["generation"]
     assert generation["slots"] == 4 and generation["decode_dispatches"] > 0
     assert generation["speculative"] is False
+    # the template serves through the paged pool; occupancy is surfaced, and
+    # with every stream drained the allocator must be balanced (a leak would
+    # show as used > 0 — blocks release before each stream's end sentinel)
+    kv = generation["kv_blocks"]
+    assert kv["block_size"] == 16 and kv["used"] == 0
 
     # speculative decoding through the Generator façade: greedy-exact vs the
     # plain predictor (the half-depth draft changes speed, never tokens)

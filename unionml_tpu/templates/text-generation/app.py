@@ -174,7 +174,11 @@ def _continuous_for(state: train_state.TrainState):
             for _, stale in _continuous.values():
                 stale.close(wait=False)  # graceful: residents finish, no new joins
             _continuous.clear()
-            batcher = ContinuousBatcher(_generator_for(state), slots=4, decode_chunk=8)
+            # paged KV (block_size): a shared block pool with lazy allocation —
+            # HBM tracks tokens actually decoded, /metrics reports occupancy
+            batcher = ContinuousBatcher(
+                _generator_for(state), slots=4, decode_chunk=8, block_size=16
+            )
             _continuous[id(state)] = (state, batcher)
             model.generation_batcher = batcher  # surfaces utilization on /metrics
         return batcher
