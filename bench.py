@@ -174,10 +174,25 @@ def _watcher_capture(max_age_s: float = 14 * 3600) -> "dict | None":
     or None. Only trusted if it carries the exact headline metric name AND is
     fresh (file mtime within one round's span) — a stale file from an earlier
     round must never launder into the current report."""
+    import glob
     import os
+    import re
     from pathlib import Path
 
-    path = Path(os.environ.get("BENCH_CAPTURE_DIR", "bench_r4")) / "bench_mlp_train.json"
+    if os.environ.get("BENCH_CAPTURE_DIR"):
+        path = Path(os.environ["BENCH_CAPTURE_DIR"]) / "bench_mlp_train.json"
+    else:
+        # ONLY the current (highest-numbered) round's watcher dir: an earlier
+        # round's capture inside the freshness window must not launder into
+        # this round's report
+        rounds = sorted(
+            (int(m.group(1)), d)
+            for d in glob.glob("bench_r*")
+            if (m := re.fullmatch(r"bench_r(\d+)", d))
+        )
+        if not rounds:
+            return None
+        path = Path(rounds[-1][1]) / "bench_mlp_train.json"
     try:
         age_s = time.time() - path.stat().st_mtime
         payload = json.loads(path.read_text())

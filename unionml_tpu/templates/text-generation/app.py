@@ -174,10 +174,12 @@ def _continuous_for(state: train_state.TrainState):
             for _, stale in _continuous.values():
                 stale.close(wait=False)  # graceful: residents finish, no new joins
             _continuous.clear()
-            # paged KV (block_size): a shared block pool with lazy allocation —
-            # HBM tracks tokens actually decoded, /metrics reports occupancy
+            # paged KV: a shared block pool with lazy allocation, sized BELOW
+            # slots x worst-case (the default) so HBM actually tracks tokens
+            # decoded — typical short prompts fit concurrently, a worst-case
+            # mix rides lazy growth + preemption; /metrics reports occupancy
             batcher = ContinuousBatcher(
-                _generator_for(state), slots=4, decode_chunk=8, block_size=16
+                _generator_for(state), slots=4, decode_chunk=8, block_size=16, pool_blocks=16
             )
             _continuous[id(state)] = (state, batcher)
             model.generation_batcher = batcher  # surfaces utilization on /metrics
