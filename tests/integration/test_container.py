@@ -27,6 +27,9 @@ _SHIM = textwrap.dedent(
     if [ "$verb" = "push" ] && [ -n "$DOCKER_FAIL_PUSH" ]; then
       echo "ERROR: denied" >&2; exit 1
     fi
+    if [ "$verb" = "kill" ] && [ -n "$DOCKER_FAIL_KILL" ]; then
+      echo "ERROR: no such container" >&2; exit 1
+    fi
     if [ "$verb" = "run" ]; then
       # EXECUTE the container locally (the gcloud-shim ssh pattern): the image's
       # entrypoint is `python -m unionml_tpu.job_runner`, its argument rides the
@@ -68,7 +71,7 @@ def docker_env(tmp_path, monkeypatch):
     import sys as _sys
 
     monkeypatch.setenv("PYTHON_FOR_SHIM", _sys.executable)
-    for var in ("DOCKER_FAIL_BUILD", "DOCKER_FAIL_PUSH", "DOCKER_FAIL_RUN_ONCE"):
+    for var in ("DOCKER_FAIL_BUILD", "DOCKER_FAIL_PUSH", "DOCKER_FAIL_RUN_ONCE", "DOCKER_FAIL_KILL"):
         monkeypatch.delenv(var, raising=False)
 
     def calls(verb=None):
@@ -221,3 +224,47 @@ def test_container_run_failure_consumes_retry(docker_env, docker_app, tmp_path, 
     # lingers daemon-side, and reusing the name would fail the retry
     names = [tok for line in runs for i, tok in enumerate(line.split()) if line.split()[i - 1] == "--name"]
     assert len(set(names)) == 2 and names[0].endswith("-a0-w0") and names[1].endswith("-a1-w0")
+
+
+def test_container_handle_kill_targets_container_and_logs_failure(docker_env, tmp_path, monkeypatch):
+    """The watchdog's kill() must reach the CONTAINER (docker kill <name>), not
+    just the local client — and a failed docker kill must be loud, because the
+    daemon-side worker may still be mutating the mounted store."""
+    import logging
+    import subprocess as sp
+
+    from unionml_tpu.launcher import _ContainerHandle
+
+    proc = sp.Popen(["sleep", "30"])
+    proc2 = None
+    handle = _ContainerHandle(proc, "unionml-test-a0-w0")
+    try:
+        handle.kill()
+        proc.wait(timeout=10)
+        assert [ln.split()[1] for ln in docker_env("kill")] == ["unionml-test-a0-w0"]
+
+        # a failing docker kill logs the hazard instead of passing silently
+        proc2 = sp.Popen(["sleep", "30"])
+        handle2 = _ContainerHandle(proc2, "unionml-test-a0-w1")
+        monkeypatch.setenv("DOCKER_FAIL_KILL", "1")
+        # the package logger does not propagate; capture via a direct handler
+        records = []
+
+        class _Catch(logging.Handler):
+            def emit(self, record):
+                records.append(record)
+
+        from unionml_tpu._logging import logger as pkg_logger
+
+        catcher = _Catch(level=logging.WARNING)
+        pkg_logger.addHandler(catcher)
+        try:
+            handle2.kill()
+        finally:
+            pkg_logger.removeHandler(catcher)
+        proc2.wait(timeout=10)
+        assert any("docker kill unionml-test-a0-w1 failed" in r.getMessage() for r in records)
+    finally:
+        for p in (proc, proc2):
+            if p is not None and p.poll() is None:
+                p.kill()
