@@ -24,9 +24,13 @@ import numpy as np
 
 from benchmarks.common import V5E_PEAK_BF16_FLOPS, emit, log
 
+import os
+
 SEQ_LEN = 128
-BATCH_PER_CHIP = 64
-STEPS = 30
+# sweepable via env for MFU tuning runs; the canonical config is the default
+BATCH_PER_CHIP = int(os.environ.get("BENCH_BERT_BATCH", "64"))
+STEPS = int(os.environ.get("BENCH_BERT_STEPS", "30"))
+STEPS_PER_CALL = int(os.environ.get("BENCH_BERT_STEPS_PER_CALL", "10"))
 A100_REFERENCE_SPS = 400.0
 
 
@@ -73,7 +77,7 @@ def main() -> None:
             partition_rules=bert_partition_rules(),
             shuffle=False,
             device_data=True,
-            steps_per_call=10,
+            steps_per_call=STEPS_PER_CALL,
         ),
     )
     sps_chip = result.samples_per_sec_per_chip

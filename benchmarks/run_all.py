@@ -27,6 +27,8 @@ SCRIPTS = {
     "continuous": "bench_continuous.py",
     "int8_matmul": "bench_int8_matmul.py",
     "kv_cache": "bench_kv_cache.py",
+    "flash_attention": "bench_flash_attention.py",
+    "paged_attention": "bench_paged_attention.py",
 }
 
 

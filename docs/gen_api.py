@@ -56,6 +56,7 @@ MODULES = [
     "unionml_tpu.artifact",
     "unionml_tpu.remote",
     "unionml_tpu.launcher",
+    "unionml_tpu.gke",
     "unionml_tpu.job_runner",
     "unionml_tpu.resolver",
     "unionml_tpu.templating",

@@ -1,0 +1,290 @@
+"""GKELauncher's REAL kubectl code path, driven through a shim ``kubectl`` on PATH.
+
+The manifest emitter is unit-tested in tests/unit/test_gke.py; this ring is the
+cluster analog of the gcloud/docker shim e2es (test_launcher_gcloud.py,
+test_container.py): a shim kubectl records every invocation and — for ``apply`` —
+actually EXECUTES the Job's workers locally (one ``unionml_tpu.job_runner``
+process per completion index, env from the manifest), so a full remote_train runs
+end-to-end through apply -> pod-status polling -> log streaming -> delete.
+Failure injection covers worker failure (watchdog resubmit under a fresh
+per-attempt job name) and apply failure.
+"""
+
+import json
+import os
+import subprocess
+import textwrap
+import time
+
+import pytest
+
+from tests.unit.test_remote import APP_SOURCE
+
+_SHIM = textwrap.dedent(
+    '''\
+    #!/usr/bin/env python3
+    # kubectl shim: logs every call; `apply` runs the Job's workers as local
+    # processes (the pod analog), `get` reports their status as pod/job JSON,
+    # `delete` kills them. Failure injection via KUBECTL_* env vars.
+    import glob, json, os, signal, subprocess, sys
+
+    STATE = os.environ["KUBECTL_SHIM_STATE"]
+    args = sys.argv[1:]
+    with open(os.environ["KUBECTL_SHIM_LOG"], "a") as fh:
+        fh.write(" ".join(args) + "\\n")
+
+    def jdir(name):
+        return os.path.join(STATE, name)
+
+    def completions(name):
+        with open(os.path.join(jdir(name), "manifest.json")) as fh:
+            manifest = json.load(fh)
+        job = next(i for i in manifest["items"] if i["kind"] == "Job")
+        return job["spec"]["completions"]
+
+    verb = args[0]
+    if verb == "apply":
+        if os.environ.get("KUBECTL_FAIL_APPLY"):
+            print("error: connection refused", file=sys.stderr)
+            sys.exit(1)
+        manifest = json.loads(sys.stdin.read())
+        job = next(i for i in manifest["items"] if i["kind"] == "Job")
+        name = job["metadata"]["name"]
+        os.makedirs(jdir(name), exist_ok=True)
+        with open(os.path.join(jdir(name), "manifest.json"), "w") as fh:
+            json.dump(manifest, fh)
+        container = job["spec"]["template"]["spec"]["containers"][0]
+        fail_first = os.environ.get("KUBECTL_FAIL_WORKER_ONCE") and name.endswith("-a0")
+        for i in range(job["spec"]["completions"]):
+            env = dict(os.environ)
+            for entry in container["env"]:
+                if "value" in entry:
+                    env[entry["name"]] = entry["value"]
+            # the cluster provides these: completion index -> process id, and
+            # the coordinator's pod DNS name -> loopback (same port)
+            env["UNIONML_TPU_PROCESS_ID"] = str(i)
+            coord = env.get("UNIONML_TPU_COORDINATOR")
+            if coord:
+                env["UNIONML_TPU_COORDINATOR"] = "127.0.0.1:" + coord.rpartition(":")[2]
+            log = os.path.join(jdir(name), "w%d.log" % i)
+            rc = os.path.join(jdir(name), "w%d.rc" % i)
+            body = "exit 7" if fail_first else "%s -m unionml_tpu.job_runner %s" % (
+                json.dumps(sys.executable), json.dumps(container["args"][0])
+            )
+            cmd = "(%s) > %s 2>&1; echo $? > %s" % (body, json.dumps(log), json.dumps(rc))
+            # fully detach stdio: the worker would otherwise inherit apply's
+            # stdout pipe and the launcher's capture_output read would block
+            # until the WORKER exits, serializing the whole "cluster"
+            proc = subprocess.Popen(
+                ["bash", "-c", cmd], env=env, start_new_session=True,
+                stdin=subprocess.DEVNULL, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            )
+            with open(os.path.join(jdir(name), "w%d.pid" % i), "w") as fh:
+                fh.write(str(proc.pid))
+        print("service/%s created\\njob.batch/%s created" % (name, name))
+    elif verb == "get":
+        kind = args[1]
+        if kind == "pods":
+            name = args[args.index("-l") + 1].split("=", 1)[1]
+            items = []
+            if os.path.isdir(jdir(name)):
+                for i in range(completions(name)):
+                    rcf = os.path.join(jdir(name), "w%d.rc" % i)
+                    if os.path.exists(rcf):
+                        with open(rcf) as fh:
+                            phase = "Succeeded" if fh.read().strip() == "0" else "Failed"
+                    else:
+                        phase = "Running"
+                    items.append({
+                        "metadata": {
+                            "name": "%s-%d" % (name, i),
+                            "annotations": {"batch.kubernetes.io/job-completion-index": str(i)},
+                        },
+                        "status": {"phase": phase},
+                    })
+            print(json.dumps({"items": items}))
+        else:
+            name = args[2]
+            if not os.path.isdir(jdir(name)):
+                print("jobs.batch %s not found" % name, file=sys.stderr)
+                sys.exit(1)
+            rcs = []
+            for i in range(completions(name)):
+                rcf = os.path.join(jdir(name), "w%d.rc" % i)
+                if os.path.exists(rcf):
+                    with open(rcf) as fh:
+                        rcs.append(fh.read().strip())
+            conditions = []
+            if any(rc != "0" for rc in rcs):
+                conditions = [{"type": "Failed", "status": "True"}]
+            elif len(rcs) == completions(name):
+                conditions = [{"type": "Complete", "status": "True"}]
+            print(json.dumps({"status": {"conditions": conditions}}))
+    elif verb == "logs":
+        follow = args[1] == "-f"
+        pod = args[2] if follow else args[1]
+        name, index = pod.rsplit("-", 1)
+        path = os.path.join(jdir(name), "w%s.log" % index)
+        open(path, "a").close()
+        if follow:
+            os.execvp("tail", ["tail", "-F", "-n", "+1", path])
+        with open(path) as fh:  # terminated-pod snapshot: full output
+            sys.stdout.write(fh.read())
+    elif verb == "delete":
+        if os.environ.get("KUBECTL_FAIL_DELETE"):
+            print("error: forbidden", file=sys.stderr)
+            sys.exit(1)
+        kind, name = args[1], args[2]
+        if kind == "job":  # a service delete must NOT kill the job's workers
+            for pidf in glob.glob(os.path.join(jdir(name), "w*.pid")):
+                with open(pidf) as fh:
+                    try:
+                        os.killpg(int(fh.read().strip()), signal.SIGKILL)
+                    except (ProcessLookupError, PermissionError, ValueError):
+                        pass
+        print('%s "%s" deleted' % (kind, name))
+    '''
+)
+
+
+@pytest.fixture
+def kubectl_env(tmp_path, monkeypatch):
+    """A shim kubectl on PATH + call log + state dir; returns the call-log reader."""
+    bin_dir = tmp_path / "shimbin"
+    bin_dir.mkdir()
+    shim = bin_dir / "kubectl"
+    shim.write_text(_SHIM)
+    shim.chmod(0o755)
+    log = tmp_path / "kubectl_calls.log"
+    log.write_text("")
+    state = tmp_path / "shim_state"
+    state.mkdir()
+    monkeypatch.setenv("PATH", f"{bin_dir}{os.pathsep}{os.environ['PATH']}")
+    monkeypatch.setenv("KUBECTL_SHIM_LOG", str(log))
+    monkeypatch.setenv("KUBECTL_SHIM_STATE", str(state))
+    for var in ("KUBECTL_FAIL_APPLY", "KUBECTL_FAIL_WORKER_ONCE", "KUBECTL_FAIL_DELETE"):
+        monkeypatch.delenv(var, raising=False)
+
+    def calls(verb=None):
+        lines = [ln for ln in log.read_text().splitlines() if ln]
+        if verb is None:
+            return lines
+        return [ln for ln in lines if ln.split()[0] == verb]
+
+    calls.state = state
+    return calls
+
+
+@pytest.fixture
+def gke_app(tmp_path, monkeypatch):
+    app_dir = tmp_path / "appsrc"
+    app_dir.mkdir()
+    (app_dir / "remote_app.py").write_text(APP_SOURCE)
+    monkeypatch.syspath_prepend(str(app_dir))
+    monkeypatch.chdir(app_dir)
+    import importlib
+
+    import remote_app
+
+    importlib.reload(remote_app)
+    return remote_app
+
+
+def make_launcher():
+    from unionml_tpu.gke import GKELauncher
+
+    # fast polling (the shim is local); image override = the ContainerLauncher
+    # pattern for clusters whose image is prebuilt rather than deploy-pushed
+    return GKELauncher(poll_throttle_s=0.05, image="local/gke-app:test")
+
+
+def applied_manifest(calls, index=0):
+    state = calls.state
+    jobs = sorted(p for p in state.iterdir() if p.is_dir())
+    return json.loads((jobs[index] / "manifest.json").read_text())
+
+
+def test_gke_job_trains_end_to_end(kubectl_env, gke_app, tmp_path):
+    """remote_train through apply -> indexed pod polling -> completion: the shim
+    executes the Job's worker locally, so the applied manifest IS the execution
+    vehicle; the manifest carries the TPU selectors and the job_runner args."""
+    model = gke_app.model
+    model.remote(backend_store=str(tmp_path / "store"), accelerator="v5e-8", launcher=make_launcher())
+    model.remote_deploy(app_version="gke-v1")
+    artifact = model.remote_train(hyperparameters={"max_iter": 200}, wait=True)
+    assert artifact.metrics["train"] > 0.8
+
+    assert len(kubectl_env("apply")) == 1
+    manifest = applied_manifest(kubectl_env)
+    job = next(i for i in manifest["items"] if i["kind"] == "Job")
+    pod = job["spec"]["template"]["spec"]
+    assert pod["nodeSelector"]["cloud.google.com/gke-tpu-accelerator"] == "tpu-v5-lite-podslice"
+    assert pod["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "2x4"
+    assert pod["containers"][0]["image"] == "local/gke-app:test"
+    # the worker really ran job_runner against the shared store: its execution
+    # path is the args, and the pod status was polled to completion
+    assert pod["containers"][0]["args"][0].startswith(str(tmp_path / "store"))
+    assert kubectl_env("get")
+
+
+def test_worker_logs_stream_into_execution_dir(kubectl_env, gke_app, tmp_path):
+    """The handle's `kubectl logs -f` pipes the worker pod's output into the
+    execution's logs.txt — the file `unionml logs` and the failure tail read."""
+    model = gke_app.model
+    model.remote(backend_store=str(tmp_path / "store"), accelerator="v5e-8", launcher=make_launcher())
+    model.remote_deploy(app_version="gke-v2")
+    model.remote_train(hyperparameters={"max_iter": 200}, wait=True)
+    store = tmp_path / "store"
+    logs = [p for p in store.rglob("logs.txt") if "executions" in p.parts]
+    assert logs
+    # the terminal snapshot (_finalize_logs) lands synchronously at the poll
+    # that saw completion, so the worker's start line is here by the time wait
+    # returns even if the -f streamer lost the race
+    assert "job_runner: train" in logs[0].read_text()
+
+
+def test_worker_failure_resubmits_under_fresh_job_name(kubectl_env, gke_app, tmp_path, monkeypatch):
+    """A failed worker pod is a dead worker to the watchdog: with retries=1 the
+    execution resubmits as a NEW job (per-attempt name — k8s would reject a
+    create under the still-terminating old name) after deleting the failed one."""
+    monkeypatch.setenv("KUBECTL_FAIL_WORKER_ONCE", "1")
+    model = gke_app.model
+    model.remote(backend_store=str(tmp_path / "store"), accelerator="v5e-8", launcher=make_launcher())
+    model.remote_deploy(app_version="gke-v3")
+    artifact = model.remote_train(hyperparameters={"max_iter": 200}, wait=True, retries=1)
+    assert artifact.metrics["train"] > 0.8
+
+    applies = kubectl_env("apply")
+    assert len(applies) == 2
+    names = sorted(p.name for p in kubectl_env.state.iterdir())
+    assert names[0].endswith("-a0") and names[1].endswith("-a1")
+    # an already-dead worker needs no kill, so the failed JOB is not deleted —
+    # it stays for inspection and the manifest's ttlSecondsAfterFinished GCs it
+    # (terminal polls do reap the coordinator Service, which has no TTL)
+    assert not [d for d in kubectl_env("delete") if d.split()[1] == "job"]
+
+
+def test_kill_deletes_the_job(kubectl_env, tmp_path):
+    """The handle's kill() must target the JOB (the ContainerHandle.kill
+    principle, launcher.py:159-165): pods the watchdog abandons would otherwise
+    keep mutating the shared store."""
+    from unionml_tpu.gke import GKELauncher, _GKEWorkerHandle
+
+    launcher = GKELauncher(poll_throttle_s=0.05, image="x:y")
+    handle = _GKEWorkerHandle(launcher, "unionml-kill-test-a0", 0, tmp_path / "logs.txt", "w")
+    handle.kill()
+    assert handle.returncode == -9
+    job_deletes = [d for d in kubectl_env("delete") if d.split()[1] == "job"]
+    assert len(job_deletes) == 1 and "unionml-kill-test-a0" in job_deletes[0]
+    assert "--wait=false" in job_deletes[0]
+    # the job's coordinator Service is reaped alongside it
+    assert any(d.split()[1] == "service" for d in kubectl_env("delete"))
+
+
+def test_apply_failure_raises(kubectl_env, gke_app, tmp_path, monkeypatch):
+    monkeypatch.setenv("KUBECTL_FAIL_APPLY", "1")
+    model = gke_app.model
+    model.remote(backend_store=str(tmp_path / "store"), accelerator="v5e-8", launcher=make_launcher())
+    model.remote_deploy(app_version="gke-v4")
+    with pytest.raises(RuntimeError, match="kubectl apply"):
+        model.remote_train(hyperparameters={"max_iter": 200}, wait=True)

@@ -1,0 +1,188 @@
+"""GKE manifest emitter: LaunchSpec -> kubectl-applyable Indexed Job + Service.
+
+Pure-function ring for :mod:`unionml_tpu.gke` (no cluster, no shim): topology
+mapping, the Indexed-Job/coordinator-DNS/completion-index contract multi-host
+jax.distributed needs, TPU chip limits, and the store-volume shapes. The
+kubectl-shim e2e lives in tests/integration/test_gke.py.
+"""
+
+from pathlib import Path
+
+import pytest
+
+from unionml_tpu.gke import gke_accelerator_type, gke_job_manifest, gke_topology
+from unionml_tpu.launcher import LaunchSpec
+
+
+def make_spec(n_workers=2, accelerator="v5e-16", image="gcr.io/p/app:v1", **overrides):
+    envs = []
+    for worker in range(n_workers):
+        env = {
+            "PYTHONPATH": "/store/bundle:/repo",
+            "UNIONML_TPU_NUM_PROCESSES": str(n_workers),
+            "UNIONML_TPU_COORDINATOR": "127.0.0.1:43210",
+            "UNIONML_TPU_PROCESS_ID": str(worker),
+            "JAX_PLATFORMS": "tpu",
+            "HOME": "/root",  # must NOT leak into the pod env
+        }
+        envs.append(env)
+    kwargs = dict(
+        command=["python", "-m", "unionml_tpu.job_runner", "/store/executions/m/e1"],
+        worker_envs=envs,
+        log_paths=[Path(f"/tmp/logs.{i}.txt") for i in range(n_workers)],
+        log_mode="w",
+        execution_path="/store/executions/m/e1",
+        accelerator=accelerator,
+        image=image,
+        store_root="/store",
+    )
+    kwargs.update(overrides)
+    return LaunchSpec(**kwargs)
+
+
+def job_of(manifest):
+    return next(i for i in manifest["items"] if i["kind"] == "Job")
+
+
+def pod_of(manifest):
+    return job_of(manifest)["spec"]["template"]["spec"]
+
+
+class TestTopologyMapping:
+    def test_accelerator_types(self):
+        assert gke_accelerator_type("v5e-8") == "tpu-v5-lite-podslice"
+        assert gke_accelerator_type("v6e-4") == "tpu-v6e-slice"
+        assert gke_accelerator_type("v4-32") == "tpu-v4-podslice"
+        assert gke_accelerator_type("v5p-16") == "tpu-v5p-slice"
+
+    def test_2d_topologies(self):
+        assert gke_topology("v5e-1") == "1x1"
+        assert gke_topology("v5e-8") == "2x4"
+        assert gke_topology("v5e-16") == "4x4"
+        assert gke_topology("v6e-256") == "16x16"
+
+    def test_3d_generations_require_explicit_topology(self):
+        with pytest.raises(ValueError, match="topology="):
+            gke_topology("v4-32")
+        # ...but the manifest accepts one
+        manifest = gke_job_manifest(make_spec(n_workers=4, accelerator="v4-32"), topology="2x2x4")
+        assert pod_of(manifest)["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "2x2x4"
+
+    def test_unknown_shapes_rejected(self):
+        with pytest.raises(ValueError, match="cannot parse"):
+            gke_topology("v5e")
+        with pytest.raises(ValueError, match="unknown TPU generation"):
+            gke_topology("h100-8")
+        with pytest.raises(ValueError, match="no standard 2D topology"):
+            gke_topology("v5e-12")
+
+
+class TestManifestShape:
+    def test_indexed_job_with_headless_service(self):
+        manifest = gke_job_manifest(make_spec())
+        kinds = [i["kind"] for i in manifest["items"]]
+        assert kinds == ["Service", "Job"]
+        svc, job = manifest["items"]
+        assert svc["spec"]["clusterIP"] == "None"
+        name = job["metadata"]["name"]
+        assert svc["spec"]["selector"] == {"job-name": name}
+        assert job["spec"]["completionMode"] == "Indexed"
+        assert job["spec"]["completions"] == 2 and job["spec"]["parallelism"] == 2
+        # retries belong to the backend watchdog, not kubelet/the job controller
+        assert job["spec"]["backoffLimit"] == 0
+        # terminal jobs linger for inspection; the cluster GCs them after a day
+        assert job["spec"]["ttlSecondsAfterFinished"] == 86400
+        assert pod_of(manifest)["restartPolicy"] == "Never"
+        assert pod_of(manifest)["subdomain"] == name
+
+    def test_job_name_is_per_attempt(self):
+        first = job_of(gke_job_manifest(make_spec()))["metadata"]["name"]
+        retry = job_of(gke_job_manifest(make_spec(attempt=1)))["metadata"]["name"]
+        assert first != retry and first.endswith("-a0") and retry.endswith("-a1")
+
+    def test_tpu_node_selectors_and_chip_limits(self):
+        manifest = gke_job_manifest(make_spec())  # v5e-16: 2 hosts x 8 chips
+        pod = pod_of(manifest)
+        assert pod["nodeSelector"]["cloud.google.com/gke-tpu-accelerator"] == "tpu-v5-lite-podslice"
+        assert pod["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "4x4"
+        assert pod["containers"][0]["resources"]["limits"]["google.com/tpu"] == 8
+
+    def test_extra_node_selectors_merge(self):
+        manifest = gke_job_manifest(make_spec(), node_selector={"cloud.google.com/gke-spot": "true"})
+        assert pod_of(manifest)["nodeSelector"]["cloud.google.com/gke-spot"] == "true"
+
+    def test_entrypoint_args_are_the_execution_path(self):
+        container = pod_of(gke_job_manifest(make_spec()))["containers"][0]
+        assert container["image"] == "gcr.io/p/app:v1"
+        # image entrypoint is `python -m unionml_tpu.job_runner` (container.py)
+        assert container["args"] == ["/store/executions/m/e1"]
+
+
+class TestWorkerEnv:
+    def env_by_name(self, manifest):
+        return {e["name"]: e for e in pod_of(manifest)["containers"][0]["env"]}
+
+    def test_coordinator_rewritten_to_pod0_dns(self):
+        manifest = gke_job_manifest(make_spec())
+        env = self.env_by_name(manifest)
+        job = job_of(manifest)["metadata"]["name"]
+        # loopback coordinator is meaningless across pods; port is preserved
+        assert env["UNIONML_TPU_COORDINATOR"]["value"] == f"{job}-0.{job}:43210"
+
+    def test_process_id_from_completion_index(self):
+        env = self.env_by_name(gke_job_manifest(make_spec()))
+        field = env["UNIONML_TPU_PROCESS_ID"]["valueFrom"]["fieldRef"]["fieldPath"]
+        assert field == "metadata.annotations['batch.kubernetes.io/job-completion-index']"
+
+    def test_only_framework_env_forwarded(self):
+        env = self.env_by_name(gke_job_manifest(make_spec()))
+        assert "HOME" not in env
+        assert env["JAX_PLATFORMS"]["value"] == "tpu"
+        assert env["UNIONML_TPU_NUM_PROCESSES"]["value"] == "2"
+
+    def test_single_worker_has_no_service(self):
+        # the Service exists solely for the multi-host coordinator DNS name;
+        # single-host slices must not leak one per execution
+        spec = make_spec(n_workers=1, accelerator="v5e-8")
+        manifest = gke_job_manifest(spec)
+        assert [i["kind"] for i in manifest["items"]] == ["Job"]
+
+    def test_single_worker_has_no_distributed_env(self):
+        spec = make_spec(n_workers=1, accelerator="v5e-8")
+        for env in spec.worker_envs:
+            env.pop("UNIONML_TPU_COORDINATOR")
+            env.pop("UNIONML_TPU_PROCESS_ID")
+            env.pop("UNIONML_TPU_NUM_PROCESSES")
+        env = self.env_by_name(gke_job_manifest(spec))
+        assert "UNIONML_TPU_COORDINATOR" not in env
+        assert "UNIONML_TPU_PROCESS_ID" not in env
+
+
+class TestVolumesAndErrors:
+    def test_store_mounted_hostpath_by_default(self):
+        pod = pod_of(gke_job_manifest(make_spec()))
+        assert pod["volumes"] == [
+            {"name": "store", "hostPath": {"path": "/store", "type": "DirectoryOrCreate"}}
+        ]
+        # same path inside the pod: execution dirs resolve without translation
+        assert pod["containers"][0]["volumeMounts"] == [{"name": "store", "mountPath": "/store"}]
+
+    def test_store_claim_mounts_pvc(self):
+        pod = pod_of(gke_job_manifest(make_spec(), store_claim="unionml-store"))
+        assert pod["volumes"] == [
+            {"name": "store", "persistentVolumeClaim": {"claimName": "unionml-store"}}
+        ]
+
+    def test_service_account(self):
+        pod = pod_of(gke_job_manifest(make_spec(), service_account="tpu-sa"))
+        assert pod["serviceAccountName"] == "tpu-sa"
+
+    def test_image_required_with_override(self):
+        with pytest.raises(ValueError, match="image"):
+            gke_job_manifest(make_spec(image=None))
+        manifest = gke_job_manifest(make_spec(image=None), image="local/app:dev")
+        assert pod_of(manifest)["containers"][0]["image"] == "local/app:dev"
+
+    def test_accelerator_required(self):
+        with pytest.raises(ValueError, match="accelerator"):
+            gke_job_manifest(make_spec(accelerator=None))

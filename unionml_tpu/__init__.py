@@ -9,6 +9,7 @@ layer schedules app bundles onto TPU VM slices.
 """
 
 from unionml_tpu.dataset import Dataset  # noqa: F401
+from unionml_tpu.gke import GKELauncher  # noqa: F401
 from unionml_tpu.launcher import ContainerLauncher, Launcher, LocalProcessLauncher, TPUVMLauncher  # noqa: F401
 from unionml_tpu.model import BaseHyperparameters, Model, ModelArtifact  # noqa: F401
 from unionml_tpu.parallel.mesh import MeshSpec  # noqa: F401
@@ -32,6 +33,7 @@ __all__ = [
     "Stage",
     "TPUVMLauncher",
     "ContainerLauncher",
+    "GKELauncher",
     "TrainerConfig",
     "make_train_step",
     "stage",

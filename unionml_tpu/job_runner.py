@@ -119,6 +119,13 @@ def run_job(execution_dir: str) -> None:
         with open(exec_path / "spec.pkl", "rb") as f:
             spec = pickle.load(f)
 
+        # the one guaranteed log line per worker: what runs, where, which attempt —
+        # launcher log streams (files, `docker logs`, `kubectl logs`) key on it
+        logger.info(
+            f"job_runner: {spec['kind']} {spec['app_module']} "
+            f"(attempt {my_attempt}, process {os.environ.get('UNIONML_TPU_PROCESS_ID', '0')})"
+        )
+
         _maybe_init_distributed()
         _maybe_inject_fault(exec_path)
 

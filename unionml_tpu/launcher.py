@@ -37,6 +37,7 @@ __all__ = [
     "Launcher",
     "LocalProcessLauncher",
     "TPUVMLauncher",
+    "parse_accelerator",
     "slice_hosts",
 ]
 
@@ -208,20 +209,28 @@ _CHIPS_PER_HOST = {
 }
 
 
-def slice_hosts(accelerator: str) -> int:
-    """Number of hosts (worker processes) in an accelerator slice, e.g. ``v5e-8`` -> 1,
-    ``v5e-16`` -> 2, ``v4-32`` -> 4 (v4 counts TensorCores: 32 cores = 16 chips)."""
+def parse_accelerator(accelerator: str) -> "tuple[str, int]":
+    """``"v5e-16"`` -> ``("v5e", 16)`` (generation, CHIP count). The core-counted
+    generations (v2-v4, v5p) are halved: ``v4-32`` is 32 TensorCores = 16 chips.
+    The single accelerator-string parser — :func:`slice_hosts` and the GKE
+    manifest emitter (:mod:`unionml_tpu.gke`) both resolve through it."""
     name, _, count_str = accelerator.rpartition("-")
     name = name.lower()
     try:
         count = int(count_str)
     except ValueError:
         raise ValueError(f"cannot parse accelerator {accelerator!r}; expected e.g. 'v5e-8'")
-    per_host = _CHIPS_PER_HOST.get(name)
-    if per_host is None:
+    if name not in _CHIPS_PER_HOST:
         raise ValueError(f"unknown TPU generation in accelerator {accelerator!r}")
     chips = count // 2 if name in ("v2", "v3", "v4", "v5p") else count  # core-counted gens
-    return max(1, -(-chips // per_host))
+    return name, max(1, chips)
+
+
+def slice_hosts(accelerator: str) -> int:
+    """Number of hosts (worker processes) in an accelerator slice, e.g. ``v5e-8`` -> 1,
+    ``v5e-16`` -> 2, ``v4-32`` -> 4 (v4 counts TensorCores: 32 cores = 16 chips)."""
+    name, chips = parse_accelerator(accelerator)
+    return max(1, -(-chips // _CHIPS_PER_HOST[name]))
 
 
 class TPUVMLauncher(Launcher):
