@@ -3,11 +3,21 @@
 Usage: ``python benchmarks/run_all.py [--only digits,bert,...]``. Each script runs in
 its own interpreter (fresh XLA client; one failure doesn't kill the suite). The
 headline metric (``bench.py`` at the repo root) is separate and unchanged.
+
+TPU-dependent scripts are probe-gated (the ``bench.py`` policy): the tunneled
+axon plugin wedges for stretches of minutes-to-hours, and an unprobed launch
+into a wedge costs a full per-script timeout — observed live in round 4 when the
+tunnel died mid-suite and ``bench_llama_lora`` burned its whole hour hanging on
+``remote_compile``. A ~90 s probe decides whether the backend is worth a launch;
+unhealthy probes sleep and retry until ``BENCH_SUITE_DEADLINE_S`` (default 8 h)
+so the suite rides out wedge windows instead of cascading failures. Results are
+flushed to BENCH_ALL.json after every script — a later crash loses nothing.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import subprocess
 import sys
 import time
@@ -30,33 +40,108 @@ SCRIPTS = {
     "flash_attention": "bench_flash_attention.py",
     "paged_attention": "bench_paged_attention.py",
 }
+#: scripts that initialize the (tunneled) accelerator backend; everything else is
+#: CPU-substrate by design (sklearn/serving) and launches unprobed
+CPU_ONLY = {"digits", "serving"}
+
+PROBE_RETRY_S = 600.0
+SCRIPT_TIMEOUT_S = float(os.environ.get("RUNALL_SCRIPT_TIMEOUT_S", "1800"))
+DEADLINE_S = float(os.environ.get("BENCH_SUITE_DEADLINE_S", str(8 * 3600)))
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def wait_for_backend(deadline: float) -> bool:
+    """Probe-with-backoff until the REAL accelerator is healthy or the suite
+    deadline passes. Reuses ``bench.py``'s probe (one probe to maintain): its
+    subprocess fetches a matmul scalar — the only reliable fence on the tunneled
+    plugin — and reports the platform, so a silent CPU fallback counts as
+    unhealthy rather than letting CPU timings masquerade as TPU results."""
+    sys.path.insert(0, str(ROOT))
+    from bench import _probe_backend
+
+    while True:
+        platform = _probe_backend()
+        if platform not in ("cpu", "timeout", "failed"):
+            return True
+        remaining = deadline - time.monotonic()
+        if remaining <= PROBE_RETRY_S:
+            return False
+        _log(
+            f"backend unhealthy ({platform}); retrying in {PROBE_RETRY_S:.0f}s "
+            f"({remaining / 60:.0f} min left)"
+        )
+        time.sleep(PROBE_RETRY_S)
+
+
+def _is_success(entry) -> bool:
+    return isinstance(entry, dict) and "error" not in entry and "skipped" not in entry
+
+
+def _record_failure(results: dict, out: Path, name: str, entry: dict) -> None:
+    """Flush a failure/skip marker WITHOUT clobbering an earlier run's success —
+    the accretion contract is that re-invocations only improve BENCH_ALL.json."""
+    if _is_success(results.get(name)):
+        _log(f"{name}: keeping previous successful result over {entry}")
+        return
+    results[name] = entry
+    out.write_text(json.dumps(results, indent=2))
 
 
 def main() -> None:
     only = None
     if len(sys.argv) > 2 and sys.argv[1] == "--only":
         only = set(sys.argv[2].split(","))
+    out = ROOT / "BENCH_ALL.json"
     results = {}
+    if out.exists():
+        try:
+            results = json.loads(out.read_text())  # accrete across invocations
+        except ValueError:
+            results = {}
+    deadline = time.monotonic() + DEADLINE_S
     for name, script in SCRIPTS.items():
         if only and name not in only:
             continue
+        if name not in CPU_ONLY and not wait_for_backend(deadline):
+            _log(f"=== {name}: skipped, backend never became healthy before the deadline")
+            _record_failure(results, out, name, {"skipped": "tpu_unavailable_all_windows"})
+            continue
         path = (Path(__file__).parent / script).resolve()
-        print(f"=== {name} ({path.name}) ===", file=sys.stderr, flush=True)
+        _log(f"=== {name} ({path.name}) ===")
         start = time.perf_counter()
-        proc = subprocess.run(
-            [sys.executable, str(path)], capture_output=True, text=True, cwd=ROOT, timeout=3600
-        )
+        try:
+            proc = subprocess.run(
+                [sys.executable, str(path)],
+                capture_output=True,
+                text=True,
+                cwd=ROOT,
+                timeout=SCRIPT_TIMEOUT_S,
+            )
+        except subprocess.TimeoutExpired as exc:
+            _log(f"{name} timed out after {SCRIPT_TIMEOUT_S:.0f}s (backend wedged mid-run?)")
+            tail = (exc.stderr or b"")
+            if isinstance(tail, bytes):
+                tail = tail.decode(errors="replace")
+            _record_failure(results, out, name, {"error": "timeout", "stderr_tail": tail[-500:]})
+            continue
         wall = time.perf_counter() - start
         if proc.returncode != 0:
-            print(proc.stderr[-2000:], file=sys.stderr)
-            results[name] = {"error": proc.returncode, "stderr_tail": proc.stderr[-500:]}
+            _log(proc.stderr[-2000:])
+            _record_failure(results, out, name, {"error": proc.returncode, "stderr_tail": proc.stderr[-500:]})
             continue
-        line = [ln for ln in proc.stdout.strip().splitlines() if ln.startswith("{")][-1]
-        results[name] = json.loads(line)
+        lines = [ln for ln in proc.stdout.strip().splitlines() if ln.startswith("{")]
+        if not lines:
+            # rc=0 with no JSON line must not abort the remaining scripts
+            _log(f"{name}: exited 0 but printed no JSON result line")
+            _record_failure(results, out, name, {"error": "no_json_output", "stdout_tail": proc.stdout[-500:]})
+            continue
+        results[name] = json.loads(lines[-1])
         results[name]["bench_wall_s"] = round(wall, 1)
-        print(line, file=sys.stderr, flush=True)
-    out = ROOT / "BENCH_ALL.json"
-    out.write_text(json.dumps(results, indent=2))
+        _log(lines[-1])
+        out.write_text(json.dumps(results, indent=2))
     print(json.dumps(results, indent=2))
 
 
