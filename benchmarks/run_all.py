@@ -45,8 +45,13 @@ SCRIPTS = {
 CPU_ONLY = {"digits", "serving"}
 
 PROBE_RETRY_S = 600.0
+#: per-script cap: a healthy run of the longest script (generate, ~15 min with
+#: tunnel compiles) fits comfortably; a wedged run must not cost the old 60 min —
+#: the probe gate makes mid-run wedges the only way to hit this
 SCRIPT_TIMEOUT_S = float(os.environ.get("RUNALL_SCRIPT_TIMEOUT_S", "1800"))
 DEADLINE_S = float(os.environ.get("BENCH_SUITE_DEADLINE_S", str(8 * 3600)))
+
+sys.path.insert(0, str(ROOT))
 
 
 def _log(msg: str) -> None:
@@ -59,7 +64,6 @@ def wait_for_backend(deadline: float) -> bool:
     subprocess fetches a matmul scalar — the only reliable fence on the tunneled
     plugin — and reports the platform, so a silent CPU fallback counts as
     unhealthy rather than letting CPU timings masquerade as TPU results."""
-    sys.path.insert(0, str(ROOT))
     from bench import _probe_backend
 
     while True:
@@ -80,6 +84,14 @@ def _is_success(entry) -> bool:
     return isinstance(entry, dict) and "error" not in entry and "skipped" not in entry
 
 
+def _flush(results: dict, out: Path) -> None:
+    """Atomic write: a SIGKILL/full disk mid-write must not truncate the file —
+    the accretion contract depends on the previous flush surviving."""
+    tmp = out.with_suffix(".json.tmp")
+    tmp.write_text(json.dumps(results, indent=2))
+    os.replace(tmp, out)
+
+
 def _record_failure(results: dict, out: Path, name: str, entry: dict) -> None:
     """Flush a failure/skip marker WITHOUT clobbering an earlier run's success —
     the accretion contract is that re-invocations only improve BENCH_ALL.json."""
@@ -87,7 +99,7 @@ def _record_failure(results: dict, out: Path, name: str, entry: dict) -> None:
         _log(f"{name}: keeping previous successful result over {entry}")
         return
     results[name] = entry
-    out.write_text(json.dumps(results, indent=2))
+    _flush(results, out)
 
 
 def main() -> None:
@@ -98,14 +110,18 @@ def main() -> None:
     results = {}
     if out.exists():
         try:
-            results = json.loads(out.read_text())  # accrete across invocations
+            loaded = json.loads(out.read_text())  # accrete across invocations
+            results = loaded if isinstance(loaded, dict) else {}
         except ValueError:
             results = {}
     deadline = time.monotonic() + DEADLINE_S
+    backend_recently_healthy = False
     for name, script in SCRIPTS.items():
         if only and name not in only:
             continue
-        if name not in CPU_ONLY and not wait_for_backend(deadline):
+        # a TPU script that just exited 0 IS a health probe; skip the redundant
+        # ~30-90s probe until something fails again
+        if name not in CPU_ONLY and not backend_recently_healthy and not wait_for_backend(deadline):
             _log(f"=== {name}: skipped, backend never became healthy before the deadline")
             _record_failure(results, out, name, {"skipped": "tpu_unavailable_all_windows"})
             continue
@@ -122,6 +138,7 @@ def main() -> None:
             )
         except subprocess.TimeoutExpired as exc:
             _log(f"{name} timed out after {SCRIPT_TIMEOUT_S:.0f}s (backend wedged mid-run?)")
+            backend_recently_healthy = False
             tail = (exc.stderr or b"")
             if isinstance(tail, bytes):
                 tail = tail.decode(errors="replace")
@@ -130,18 +147,25 @@ def main() -> None:
         wall = time.perf_counter() - start
         if proc.returncode != 0:
             _log(proc.stderr[-2000:])
+            backend_recently_healthy = False
             _record_failure(results, out, name, {"error": proc.returncode, "stderr_tail": proc.stderr[-500:]})
             continue
         lines = [ln for ln in proc.stdout.strip().splitlines() if ln.startswith("{")]
-        if not lines:
-            # rc=0 with no JSON line must not abort the remaining scripts
+        try:
+            payload = json.loads(lines[-1]) if lines else None
+        except ValueError:
+            payload = None
+        if payload is None:
+            # rc=0 without a parseable JSON line must not abort the remaining scripts
             _log(f"{name}: exited 0 but printed no JSON result line")
             _record_failure(results, out, name, {"error": "no_json_output", "stdout_tail": proc.stdout[-500:]})
             continue
-        results[name] = json.loads(lines[-1])
+        if name not in CPU_ONLY:
+            backend_recently_healthy = True
+        results[name] = payload
         results[name]["bench_wall_s"] = round(wall, 1)
         _log(lines[-1])
-        out.write_text(json.dumps(results, indent=2))
+        _flush(results, out)
     print(json.dumps(results, indent=2))
 
 

@@ -281,6 +281,26 @@ def test_kill_deletes_the_job(kubectl_env, tmp_path):
     assert any(d.split()[1] == "service" for d in kubectl_env("delete"))
 
 
+def test_multihost_slice_forms_one_distributed_runtime(kubectl_env, gke_app, tmp_path):
+    """v5e-16 = 2 hosts -> a 2-completion Indexed Job. The shim plays the
+    cluster: completion index -> process id, coordinator DNS -> loopback (same
+    port). Both 'pods' must join ONE jax.distributed runtime (job_runner logs
+    the join with its process rank) and the execution completes through the
+    shared store — the emulated-cluster analog of tests/emulated/test_multihost."""
+    model = gke_app.model
+    model.remote(backend_store=str(tmp_path / "store"), accelerator="v5e-16", launcher=make_launcher())
+    model.remote_deploy(app_version="gke-v5")
+    artifact = model.remote_train(hyperparameters={"max_iter": 200}, wait=True)
+    assert artifact.metrics["train"] > 0.8
+
+    job = next(i for i in applied_manifest(kubectl_env)["items"] if i["kind"] == "Job")
+    assert job["spec"]["completions"] == 2
+    store = tmp_path / "store"
+    logs = sorted(p for p in store.rglob("logs*.txt") if "executions" in p.parts)
+    texts = " ".join(p.read_text() for p in logs)
+    assert "process 0/2" in texts and "process 1/2" in texts
+
+
 def test_apply_failure_raises(kubectl_env, gke_app, tmp_path, monkeypatch):
     monkeypatch.setenv("KUBECTL_FAIL_APPLY", "1")
     model = gke_app.model
