@@ -60,6 +60,7 @@ MODULES = [
     "unionml_tpu.job_runner",
     "unionml_tpu.resolver",
     "unionml_tpu.templating",
+    "unionml_tpu.compile_cache",
     "unionml_tpu.defaults",
 ]
 

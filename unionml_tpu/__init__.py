@@ -8,6 +8,7 @@ a dynamic micro-batching queue in front of an AOT-compiled predictor, and the re
 layer schedules app bundles onto TPU VM slices.
 """
 
+from unionml_tpu.compile_cache import enable_compile_cache  # noqa: F401
 from unionml_tpu.dataset import Dataset  # noqa: F401
 from unionml_tpu.gke import GKELauncher  # noqa: F401
 from unionml_tpu.launcher import ContainerLauncher, Launcher, LocalProcessLauncher, TPUVMLauncher  # noqa: F401
@@ -35,6 +36,14 @@ __all__ = [
     "ContainerLauncher",
     "GKELauncher",
     "TrainerConfig",
+    "enable_compile_cache",
     "make_train_step",
     "stage",
 ]
+
+# env-gated: UNIONML_TPU_COMPILE_CACHE turns the persistent XLA compilation
+# cache on for every process that imports the package (CLI, workers, serving)
+from unionml_tpu.compile_cache import _maybe_enable_from_env as _cc_hook  # noqa: E402
+
+_cc_hook()
+del _cc_hook
