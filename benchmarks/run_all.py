@@ -120,7 +120,10 @@ def main() -> None:
     os.environ.setdefault("UNIONML_TPU_COMPILE_CACHE", str(ROOT / ".xla_cache"))
     deadline = time.monotonic() + DEADLINE_S
     backend_recently_healthy = False
-    for name, script in SCRIPTS.items():
+    # CPU-substrate scripts first: they must not queue behind a wedged-tunnel
+    # probe loop that can legitimately sleep for hours
+    ordered = sorted(SCRIPTS.items(), key=lambda kv: kv[0] not in CPU_ONLY)
+    for name, script in ordered:
         if only and name not in only:
             continue
         # a TPU script that just exited 0 IS a health probe; skip the redundant
