@@ -31,6 +31,7 @@ SEQ_LEN = 128
 BATCH_PER_CHIP = int(os.environ.get("BENCH_BERT_BATCH", "64"))
 STEPS = int(os.environ.get("BENCH_BERT_STEPS", "30"))
 STEPS_PER_CALL = int(os.environ.get("BENCH_BERT_STEPS_PER_CALL", "10"))
+METRIC = os.environ.get("BENCH_BERT_METRIC", "bert_base_sst2_train_throughput")
 A100_REFERENCE_SPS = 400.0
 
 
@@ -101,7 +102,7 @@ def main() -> None:
     mfu = sps_chip * flops_per_sample / V5E_PEAK_BF16_FLOPS
 
     emit(
-        "bert_base_sst2_train_throughput",
+        METRIC,
         sps_chip,
         "samples/sec/chip",
         sps_chip / A100_REFERENCE_SPS,

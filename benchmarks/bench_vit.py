@@ -22,8 +22,11 @@ import numpy as np
 from benchmarks.common import V5E_PEAK_BF16_FLOPS, emit, log
 
 IMAGE = 224
-BATCH_PER_CHIP = 64
-STEPS = 20
+# sweepable via env for MFU tuning runs; the canonical config is the default
+BATCH_PER_CHIP = int(os.environ.get("BENCH_VIT_BATCH", "64"))
+STEPS = int(os.environ.get("BENCH_VIT_STEPS", "20"))
+CEILING_STEPS_PER_CALL = int(os.environ.get("BENCH_VIT_STEPS_PER_CALL", "5"))
+METRIC = os.environ.get("BENCH_VIT_METRIC", "vit_prefetch_train_throughput")
 MODEL = os.environ.get("BENCH_VIT_MODEL", "B")
 
 
@@ -91,8 +94,11 @@ def main() -> None:
 
     # compute ceiling: same model with the split resident in HBM — the gap between
     # this and the prefetch number is pure input-pipeline/H2D cost (on the axon
-    # tunnel the host->device link is the bottleneck; on a TPU VM it is PCIe-class)
-    n_ceiling = BATCH_PER_CHIP * n_chips * 25
+    # tunnel the host->device link is the bottleneck; on a TPU VM it is PCIe-class).
+    # Step count is a whole number of steps_per_call groups: a ragged tail scan
+    # would recompile inside the timed window and deflate the ceiling
+    ceiling_groups = max(2, -(-25 // CEILING_STEPS_PER_CALL))
+    n_ceiling = BATCH_PER_CHIP * n_chips * ceiling_groups * CEILING_STEPS_PER_CALL
     state2 = train_state.TrainState.create(apply_fn=module.apply, params=params, tx=optax.adamw(1e-3))
     ceiling = fit(
         state2,
@@ -105,7 +111,7 @@ def main() -> None:
             partition_rules=vit_partition_rules(),
             shuffle=False,
             device_data=True,
-            steps_per_call=5,
+            steps_per_call=CEILING_STEPS_PER_CALL,
         ),
     )
     log(f"device-resident ceiling: {ceiling.samples_per_sec_per_chip:.1f} samples/s/chip")
@@ -116,7 +122,7 @@ def main() -> None:
     ceiling_mfu = ceiling.samples_per_sec_per_chip * flops_per_sample / V5E_PEAK_BF16_FLOPS
 
     emit(
-        "vit_prefetch_train_throughput",
+        METRIC,
         sps_chip,
         "samples/sec/chip",
         mfu,

@@ -28,8 +28,10 @@ SCRIPTS = {
     "digits": "bench_digits.py",
     "mlp": "../bench.py",  # headline config 2
     "bert": "bench_bert.py",
+    "bert_mfu": "bench_bert_mfu.py",
     "llama_lora": "bench_llama_lora.py",
     "vit": "bench_vit.py",
+    "vit_mfu": "bench_vit_mfu.py",
     "serving": "bench_serving.py",
     "serving_jit": "bench_serving_jit.py",
     "generate": "bench_generate.py",
