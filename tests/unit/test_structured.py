@@ -1,0 +1,453 @@
+"""Grammar-constrained (structured) decoding.
+
+Oracles, mirroring the generation ring's style (tests/unit/test_generate.py):
+
+- compiler level: the token DFA's ``allowed``/``trans``/EOS columns are checked
+  against Python ``re.fullmatch`` over enumerated token sequences — acceptance
+  (EOS allowed) must equal full-match of the concatenated text, and every
+  allowed token must keep the text extendable to a sentence of the language
+  (token-level liveness);
+- engine level: greedy/sampled decoding under a constraint must emit text the
+  grammar full-matches (or a legal prefix when the budget truncates), the FREE
+  grammar must be byte-identical to an unconstrained generator, and the
+  continuous batcher's concurrent constrained streams must equal their solo
+  ``Generator.__call__(constraint=...)`` runs token-exactly.
+
+The reference has no generation surface at all (SURVEY.md §2.3); structured
+output is new TPU-native capability: the grammar is data (device tables), not
+control flow, so one compiled decode program serves every grammar.
+"""
+
+import re
+from typing import List
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from unionml_tpu.models import (
+    ConstraintSet,
+    DraftSpec,
+    GenerationConfig,
+    Generator,
+    Llama,
+    LlamaConfig,
+    TokenConstraint,
+    compile_regex,
+    literal_choice,
+)
+
+EOS = 96
+
+
+def _texts() -> List[str]:
+    """Token id -> decoded text for the tiny vocab: ids 1-26 = a-z, 27-36 =
+    digits, a few multi-char BPE-style pieces, everything else (incl. pad 0 and
+    eos 96) decodes empty."""
+    texts = [""] * 97
+    for i in range(26):
+        texts[1 + i] = chr(ord("a") + i)
+    for i in range(10):
+        texts[27 + i] = str(i)
+    texts[40], texts[41], texts[42] = "ab", "12", "3.5"
+    return texts
+
+
+TEXTS = _texts()
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    config = LlamaConfig.tiny(
+        vocab_size=97, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, hidden_dim=128,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    module = Llama(config)
+    params = module.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    return module, params, config
+
+
+def decode_text(row, texts=TEXTS) -> str:
+    out = ""
+    for t in np.asarray(row).tolist():
+        if t == EOS:
+            break
+        out += texts[t]
+    return out
+
+
+# ---------------------------------------------------------------------- compiler
+
+
+def test_token_dfa_acceptance_equals_re_fullmatch():
+    """Walk every token sequence up to depth 3 over a small vocab: the DFA must
+    allow exactly the extendable ones, and allow EOS exactly at full matches."""
+    vocab = ["", "a", "b", "ab", "c", "cc"]
+    pattern = r"(ab|b)*c{1,2}"
+    c = compile_regex(pattern, vocab, eos_id=0)
+    alphabet = "abc"
+
+    # brute-force the language up to 8 chars (regular + short)
+    def strings(prefix, depth):
+        yield prefix
+        if depth == 0:
+            return
+        for ch in alphabet:
+            yield from strings(prefix + ch, depth - 1)
+    lang = {s for s in strings("", 8) if re.fullmatch(pattern, s)}
+
+    def extendable(text: str) -> bool:
+        return any(s.startswith(text) for s in lang)
+
+    seqs = [((), 0, "")]
+    for _ in range(3):
+        nxt = []
+        for toks, state, text in seqs:
+            # EOS column == exact acceptance
+            assert bool(c.allowed[state, 0]) == bool(re.fullmatch(pattern, text)), (toks, text)
+            for t, tx in enumerate(vocab):
+                if t == 0:
+                    continue
+                ok = bool(c.allowed[state, t])
+                assert ok == extendable(text + tx), (text, tx)
+                if ok:
+                    nxt.append((toks + (t,), int(c.trans[state, t]), text + tx))
+        seqs = nxt
+
+
+def test_empty_match_allows_immediate_eos():
+    c = compile_regex(r"(ab)*", ["", "ab"], eos_id=0)
+    assert bool(c.allowed[0, 0])
+
+
+def test_bounded_quantifier():
+    c = compile_regex("a{2,3}", ["", "a", "aa"], eos_id=0)
+    s1 = int(c.trans[0, 1])
+    assert not c.allowed[s1, 0]  # "a": not yet a sentence
+    s2 = int(c.trans[s1, 1])
+    assert c.allowed[s2, 0]  # "aa"
+    s3 = int(c.trans[s2, 1])
+    assert c.allowed[s3, 0] and not c.allowed[s3, 1]  # "aaa" is maximal
+    # the two-char token takes the same states
+    assert int(c.trans[0, 2]) == s2
+
+
+def test_char_classes_and_escapes():
+    vocab = ["", "a", "Z", "_", "7", " ", "-"]
+    c = compile_regex(r"\w+", vocab, eos_id=0)
+    for t in (1, 2, 3, 4):
+        assert c.allowed[0, t]
+    for t in (5, 6):
+        assert not c.allowed[0, t]
+    neg = compile_regex(r"[^0-9]+", vocab, eos_id=0)
+    assert neg.allowed[0, 1] and not neg.allowed[0, 4]
+
+
+def test_literal_choice_tokenization_paths():
+    vocab = ["", "y", "es", "yes", "n", "o", "no", "s"]
+    c = literal_choice(["yes", "no"], vocab, eos_id=0)
+    start_ok = {vocab[t] for t in range(len(vocab)) if c.allowed[0, t]}
+    assert start_ok == {"y", "yes", "n", "no"}
+    s_yes = int(c.trans[0, 3])
+    assert c.allowed[s_yes, 0]  # complete
+    assert not c.allowed[s_yes, 7]  # "yess" escapes the language
+
+
+def test_malformed_brace_is_literal_like_re():
+    """``re`` treats non-quantifier braces as literals; the compiler must not
+    silently parse them as quantifiers (a{-2} once compiled to the
+    empty-string language)."""
+    vocab = ["", "a", "{", "-", "2", "}", " ", ",", "3", "4"]
+    for pat in ("a{-2}", "a{ 2}", "a{}", "a{2,3,4}"):
+        c = compile_regex(pat, vocab, eos_id=0)
+        state = 0
+        for ch in pat:
+            t = vocab.index(ch)
+            assert c.allowed[state, t], (pat, ch)
+            state = int(c.trans[state, t])
+        assert c.allowed[state, 0], pat  # the literal text is a full match
+        assert re.fullmatch(re.escape(pat) if False else pat, pat), pat
+
+
+def test_open_ended_brace_quantifiers():
+    vocab = ["", "a"]
+    c = compile_regex("a{,2}", vocab, eos_id=0)  # 0-2 a's
+    assert c.allowed[0, 0]
+    s1 = int(c.trans[0, 1])
+    s2 = int(c.trans[s1, 1])
+    assert c.allowed[s2, 0] and not c.allowed[s2, 1]
+    # Python 3.12 treats bare {,} as {0,}
+    c = compile_regex("a{,}", vocab, eos_id=0)
+    assert c.allowed[0, 0]
+    s = int(c.trans[0, 1])
+    assert c.allowed[s, 0] and c.allowed[s, 1]
+
+
+def test_dangling_escape_in_class_raises_valueerror():
+    with pytest.raises(ValueError, match="dangling backslash"):
+        compile_regex("[\\", ["", "a"], eos_id=0)
+    with pytest.raises(ValueError, match="quantifier bounds"):
+        compile_regex("a{3,2}", ["", "a"], eos_id=0)
+
+
+def test_unrealizable_grammar_raises():
+    with pytest.raises(ValueError, match="unreachable with this vocabulary"):
+        compile_regex("[0-9]+", ["", "a", "b"], eos_id=0)
+
+
+def test_empty_string_tokens_never_allowed():
+    c = compile_regex("a*", ["", "a", ""], eos_id=0)
+    assert not c.allowed[:, 2].any()
+
+
+def test_constraint_set_layout():
+    vocab = ["", "a", "b"]
+    g1 = compile_regex("a+", vocab, eos_id=0)
+    g2 = compile_regex("b+", vocab, eos_id=0)
+    cs = ConstraintSet([g1, g2])
+    assert cs.n_grammars == 3  # FREE + 2
+    assert bool(cs.allowed[0].all())  # FREE allows everything
+    s = int(cs.starts[1])
+    assert cs.allowed[s, 1] and not cs.allowed[s, 2]
+    s = int(cs.starts[2])
+    assert cs.allowed[s, 2] and not cs.allowed[s, 1]
+    with pytest.raises(ValueError, match="grammar id"):
+        cs.start_states([3])
+    with pytest.raises(ValueError, match="share one vocab"):
+        ConstraintSet([g1, compile_regex("a", ["", "a"], eos_id=0)])
+
+
+# ------------------------------------------------------------------- generator
+
+
+@pytest.fixture(scope="module")
+def cs():
+    return ConstraintSet(
+        [
+            compile_regex(r"[a-c]{3,5}", TEXTS, eos_id=EOS),
+            compile_regex(r"-?[0-9]+(\.[0-9]+)?", TEXTS, eos_id=EOS),
+        ]
+    )
+
+
+def test_greedy_generation_satisfies_grammar(tiny, cs):
+    module, params, _ = tiny
+    gen = Generator(
+        module, params,
+        GenerationConfig(max_new_tokens=10, temperature=0.0, eos_id=EOS,
+                         prompt_buckets=(8,), constraints=cs),
+    )
+    out = gen([[3, 14, 15], [7, 7, 9]], constraint=[1, 2])
+    text0, text1 = decode_text(out[0]), decode_text(out[1])
+    assert re.fullmatch(r"[a-c]{3,5}", text0), text0
+    # the digit grammar may be budget-truncated: full match or legal prefix
+    assert re.fullmatch(r"-?[0-9]+(\.[0-9]+)?", text1) or re.fullmatch(
+        r"-?[0-9]*(\.[0-9]*)?", text1
+    ), text1
+
+
+def test_free_grammar_matches_unconstrained(tiny, cs):
+    module, params, _ = tiny
+    kw = dict(max_new_tokens=8, temperature=0.0, eos_id=EOS, prompt_buckets=(8,))
+    gen_cs = Generator(module, params, GenerationConfig(constraints=cs, **kw))
+    gen_plain = Generator(module, params, GenerationConfig(**kw))
+    prompts = [[5, 6, 7], [1, 2]]
+    assert np.array_equal(gen_cs(prompts), gen_plain(prompts))
+    assert np.array_equal(gen_cs(prompts, constraint=0), gen_plain(prompts))
+
+
+def test_sampled_generation_satisfies_grammar(tiny, cs):
+    module, params, _ = tiny
+    gen = Generator(
+        module, params,
+        GenerationConfig(max_new_tokens=12, temperature=1.0, eos_id=EOS,
+                         prompt_buckets=(8,), constraints=cs),
+    )
+    for seed in range(4):
+        text = decode_text(gen([[2, 3]], seed=seed, constraint=1)[0])
+        assert re.fullmatch(r"[a-c]{3,5}", text) or (
+            len(text) < 3 and all(ch in "abc" for ch in text)
+        ), (seed, text)
+
+
+def test_stream_matches_call_constrained(tiny, cs):
+    module, params, _ = tiny
+    gen = Generator(
+        module, params,
+        GenerationConfig(max_new_tokens=9, temperature=0.0, eos_id=EOS,
+                         prompt_buckets=(8,), constraints=cs),
+    )
+    prompts = [[3, 14, 15], [7, 9]]
+    ref = gen(prompts, constraint=[1, 2])
+    chunks = list(gen.stream(prompts, chunk_size=3, constraint=[1, 2]))
+    got = np.concatenate(chunks, axis=1)
+    assert np.array_equal(got, ref[:, : got.shape[1]])
+
+
+def test_prefix_cache_composes_with_constraint(tiny, cs):
+    module, params, _ = tiny
+    gen = Generator(
+        module, params,
+        GenerationConfig(max_new_tokens=6, temperature=0.0, eos_id=EOS,
+                         prompt_buckets=(8,), constraints=cs),
+    )
+    prefix = gen.cache_prefix([11, 12, 13])
+    out = gen([[3, 14]], prefix=prefix, constraint=1)
+    full = gen([[11, 12, 13, 3, 14]], constraint=1)
+    assert np.array_equal(out, full)
+
+
+def test_constraint_without_set_raises(tiny):
+    module, params, _ = tiny
+    gen = Generator(module, params, GenerationConfig(max_new_tokens=4, prompt_buckets=(8,)))
+    with pytest.raises(ValueError, match="requires GenerationConfig.constraints"):
+        gen([[1, 2]], constraint=1)
+
+
+def test_wrong_constraint_arity_raises(tiny, cs):
+    module, params, _ = tiny
+    gen = Generator(
+        module, params,
+        GenerationConfig(max_new_tokens=4, prompt_buckets=(8,), constraints=cs),
+    )
+    with pytest.raises(ValueError, match="entries for"):
+        gen([[1, 2]], constraint=[1, 2])
+
+
+def test_beam_search_rejects_constraints(tiny, cs):
+    module, params, _ = tiny
+    gen = Generator(
+        module, params,
+        GenerationConfig(max_new_tokens=4, temperature=0.0, prompt_buckets=(8,), constraints=cs),
+    )
+    with pytest.raises(NotImplementedError, match="beam"):
+        gen.beam_search([[1, 2]])
+
+
+def test_draft_with_constraints_rejected(tiny, cs):
+    module, params, _ = tiny
+    with pytest.raises(ValueError, match="speculative"):
+        Generator(
+            module, params,
+            GenerationConfig(
+                max_new_tokens=4, prompt_buckets=(8,), constraints=cs,
+                draft=DraftSpec(module=module, params=params),
+            ),
+        )
+
+
+def test_speculative_generator_rejects_constraints_directly(tiny, cs):
+    """Both SpeculativeGenerator constructors strip draft from the config,
+    which would bypass Generator.__init__'s guard — the shared init body must
+    reject a constraints-bearing config itself."""
+    from unionml_tpu.models import SpeculativeGenerator
+
+    module, params, _ = tiny
+    with pytest.raises(ValueError, match="speculative"):
+        SpeculativeGenerator(
+            module, params, module, params,
+            GenerationConfig(max_new_tokens=4, temperature=0.0, prompt_buckets=(8,), constraints=cs),
+        )
+
+
+def test_draft_path_rejects_constraint_argument(tiny):
+    """A structured-output request must never be silently dropped on the
+    speculative early-return in __call__/stream."""
+    module, params, _ = tiny
+    gen = Generator(
+        module, params,
+        GenerationConfig(
+            max_new_tokens=4, temperature=0.0, prompt_buckets=(8,),
+            draft=DraftSpec(module=module, params=params),
+        ),
+    )
+    with pytest.raises(ValueError, match="constraint= does not compose"):
+        gen([[1, 2]], constraint=1)
+    with pytest.raises(ValueError, match="constraint= does not compose"):
+        next(iter(gen.stream([[1, 2]], constraint=1)))
+
+
+# ------------------------------------------------------------------ continuous
+
+
+def _collect(stream) -> List[int]:
+    return [int(t) for chunk in stream for t in np.atleast_1d(chunk)]
+
+
+def _solo_until_eos(gen, prompt, gid) -> List[int]:
+    row = gen([prompt], constraint=gid)[0].tolist()
+    out = []
+    for t in row:
+        out.append(t)
+        if t == EOS:
+            break
+    return out
+
+
+@pytest.mark.parametrize("paged", [False, True], ids=["dense", "paged"])
+def test_continuous_constrained_streams_match_solo(tiny, cs, paged):
+    from unionml_tpu.serving import ContinuousBatcher
+
+    module, params, _ = tiny
+    gen = Generator(
+        module, params,
+        GenerationConfig(max_new_tokens=8, temperature=0.0, eos_id=EOS,
+                         prompt_buckets=(8,), constraints=cs),
+    )
+    prompts = [[3, 14, 15], [7, 7, 9], [1, 2]]
+    gids = [1, 2, 0]
+    solo = [_solo_until_eos(gen, p, g) for p, g in zip(prompts, gids)]
+    batcher = ContinuousBatcher(
+        gen, slots=2, decode_chunk=2, **(dict(block_size=4) if paged else {})
+    )
+    try:
+        # more streams than slots: admission contention + slot reuse under
+        # per-request grammars
+        streams = [batcher.submit(p, constraint=g) for p, g in zip(prompts, gids)]
+        for got_stream, ref in zip(streams, solo):
+            assert _collect(got_stream) == ref
+    finally:
+        batcher.close()
+
+
+def test_continuous_constraint_survives_preemption(tiny, cs):
+    """A preempted constrained request must resume masking at the DFA state its
+    echo reached (the host walk in _admit_pending), not restart the grammar."""
+    from unionml_tpu.serving import ContinuousBatcher
+
+    module, params, _ = tiny
+    gen = Generator(
+        module, params,
+        GenerationConfig(max_new_tokens=8, temperature=0.0, eos_id=EOS,
+                         prompt_buckets=(8,), constraints=cs),
+    )
+    prompts = [[3, 14, 15], [7, 7, 9]]
+    gids = [1, 2]
+    solo = [_solo_until_eos(gen, p, g) for p, g in zip(prompts, gids)]
+    # a pool sized for ONE worst-case request forces the second admission to
+    # wait and residents to preempt under growth pressure
+    batcher = ContinuousBatcher(gen, slots=2, decode_chunk=2, block_size=2, pool_blocks=9)
+    try:
+        streams = [batcher.submit(p, constraint=g) for p, g in zip(prompts, gids)]
+        for got_stream, ref in zip(streams, solo):
+            assert _collect(got_stream) == ref
+    finally:
+        batcher.close()
+
+
+def test_continuous_rejects_constraint_without_set(tiny):
+    from unionml_tpu.serving import ContinuousBatcher
+
+    module, params, _ = tiny
+    gen = Generator(
+        module, params,
+        GenerationConfig(max_new_tokens=4, temperature=0.0, eos_id=EOS, prompt_buckets=(8,)),
+    )
+    batcher = ContinuousBatcher(gen, slots=1)
+    try:
+        with pytest.raises(ValueError, match="requires GenerationConfig.constraints"):
+            batcher.submit([1, 2], constraint=1)
+    finally:
+        batcher.close()

@@ -10,6 +10,12 @@ from unionml_tpu.models.generate import (  # noqa: F401
     sample_tokens,
 )
 from unionml_tpu.models.speculative import SpeculativeGenerator  # noqa: F401
+from unionml_tpu.models.structured import (  # noqa: F401
+    ConstraintSet,
+    TokenConstraint,
+    compile_regex,
+    literal_choice,
+)
 from unionml_tpu.models.llama import (  # noqa: F401
     Llama,
     LlamaConfig,

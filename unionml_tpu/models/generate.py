@@ -100,6 +100,12 @@ class GenerationConfig:
     #: attach a :class:`DraftSpec` to decode speculatively through the same
     #: Generator façade (excluded from equality/repr — it carries param trees)
     draft: Optional["DraftSpec"] = dataclasses.field(default=None, compare=False, repr=False)
+    #: a :class:`~unionml_tpu.models.structured.ConstraintSet` enabling
+    #: grammar-constrained decoding: pass ``constraint=`` (grammar ids) to
+    #: :meth:`Generator.__call__` / :meth:`Generator.stream` and each row's
+    #: logits are masked by its grammar's token-DFA inside the decode scan.
+    #: Excluded from equality/repr — it carries the DFA tables.
+    constraints: Optional[Any] = dataclasses.field(default=None, compare=False, repr=False)
 
 
 def chunk_aligned(length: int, chunk: int) -> int:
@@ -332,6 +338,28 @@ class Generator:
             dequant = lambda p: p  # noqa: E731
         self._dequant_params = dequant  # for engines composing on top (speculative)
 
+        cs = config.constraints
+        if cs is not None:
+            if config.draft is not None:
+                raise ValueError(
+                    "constraints do not compose with speculative decoding yet: the "
+                    "draft's proposals would need the same per-row DFA masking to "
+                    "keep the verify law exact"
+                )
+            # the tables ride to the device once; inside the jitted step the
+            # constraint is two gathers and a where (see models/structured.py)
+            self._cs_trans = jnp.asarray(cs.trans)
+            self._cs_allowed = jnp.asarray(cs.allowed)
+        self._cs = cs
+
+        def constrain(logits: jax.Array, cstate: tuple) -> jax.Array:
+            """Mask ``[B, V]`` logits by each row's DFA state (``cstate`` is the
+            variadic tail — empty when the generator is unconstrained, so every
+            unconstrained signature and carry layout stays exactly as before)."""
+            if cs is None:
+                return logits
+            return jnp.where(self._cs_allowed[cstate[0]], logits, -jnp.inf)
+
         def apply(p: Any, tokens: jax.Array, positions: jax.Array, cache: Any, token_mask: Any):
             hidden, cache = module.apply(
                 {"params": p},
@@ -347,7 +375,7 @@ class Generator:
             kernel = p["lm_head"]["kernel"]
             return (hidden @ kernel.astype(hidden.dtype)).astype(jnp.float32)
 
-        def prefill(p, tokens, lengths, cache, key, row_valid):
+        def prefill(p, tokens, lengths, cache, key, row_valid, *cstate):
             self.prefill_traces += 1
             p = dequant(p)
             batch, prompt_len = tokens.shape
@@ -357,7 +385,7 @@ class Generator:
             token_mask = (jnp.arange(prompt_len)[None] < lengths[:, None]) & row_valid[:, None]
             hidden, cache = apply(p, tokens, positions, cache, token_mask)
             last = jnp.take_along_axis(hidden, (lengths - 1)[:, None, None], axis=1)[:, 0]
-            tok0 = sample_tokens(head(p, last), key, config)
+            tok0 = sample_tokens(constrain(head(p, last), cstate), key, config)
             return tok0, cache, last.astype(jnp.float32)
 
         def prefill_chunk(p, tokens, start, lengths, cache, row_valid):
@@ -375,34 +403,41 @@ class Generator:
             chunk_last = jnp.einsum("blc,bl->bc", hidden.astype(jnp.float32), sel.astype(jnp.float32))
             return chunk_last, sel.any(axis=1), cache
 
-        def first_token(p, last, key):
+        def first_token(p, last, key, *cstate):
             """Sample the first generated token from accumulated last-row hiddens
             (chunked-prefill epilogue; everything but lm_head is DCE'd)."""
             p = dequant(p)
-            return sample_tokens(head(p, last.astype(compute_dtype)), key, config)
+            return sample_tokens(constrain(head(p, last.astype(compute_dtype)), cstate), key, config)
 
-        def decode_steps(p, cache, tok, lengths, done, key, steps: int):
+        def decode_steps(p, cache, tok, lengths, done, key, *cstate, steps: int):
             """Roll ``steps`` decode steps from the carry; returns the new tokens
             ``[B, steps]`` and the advanced carry. One ``lax.scan`` compile per
             distinct ``steps`` value — __call__ always uses max_new_tokens - 1 and
-            stream() a fixed chunk size, so the trace set stays tiny."""
+            stream() a fixed chunk size, so the trace set stays tiny. With
+            constraints the carry gains each row's DFA state as its tail element;
+            ``steps`` is keyword-only so both carry layouts share this signature."""
             self.decode_traces += 1
             eos = config.eos_id
 
             def body(carry, _):
-                cache, tok, lengths, done, key = carry
+                cache, tok, lengths, done, key, *cst = carry
                 key, sub = jax.random.split(key)
                 ps = dequant(p)  # per-step so int8, not bf16, is the steady-state HBM read
                 positions = lengths[:, None]  # each example's next free cache slot
                 hidden, cache = apply(ps, tok[:, None], positions, cache, (~done)[:, None])
-                nxt = sample_tokens(head(ps, hidden[:, 0]), sub, config)
+                nxt = sample_tokens(constrain(head(ps, hidden[:, 0]), cst), sub, config)
+                if cs is not None:
+                    # done rows hold their state (their sampled token is a pad)
+                    cst = (jnp.where(done, cst[0], self._cs_trans[cst[0], nxt]),)
                 nxt = jnp.where(done, jnp.int32(config.pad_id), nxt)
                 lengths = lengths + jnp.where(done, 0, 1)
                 if eos is not None:
                     done = done | (nxt == eos)
-                return (cache, nxt, lengths, done, key), nxt
+                return (cache, nxt, lengths, done, key, *cst), nxt
 
-            carry, toks = jax.lax.scan(body, (cache, tok, lengths, done, key), None, length=steps)
+            carry, toks = jax.lax.scan(
+                body, (cache, tok, lengths, done, key, *cstate), None, length=steps
+            )
             # the advanced carry (incl. cache) is returned so the donated input
             # buffers have outputs to alias with — one cache in HBM throughout
             return toks.T, carry
@@ -411,7 +446,7 @@ class Generator:
         self._prefill = jax.jit(prefill, donate_argnums=(3,))
         self._prefill_chunk = jax.jit(prefill_chunk, donate_argnums=(4,))
         self._first_token = jax.jit(first_token)
-        self._decode = jax.jit(decode_steps, static_argnums=(6,), donate_argnums=(1,))
+        self._decode = jax.jit(decode_steps, static_argnames=("steps",), donate_argnums=(1,))
         self._apply_fn = apply  # for engines composing on top (beam search)
         self._head_fn = head
         self._beam_fns: dict = {}
@@ -483,7 +518,7 @@ class Generator:
                 local_fwd, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
             )
 
-        def sp_prefill(p, tokens, lengths, cache, key, row_valid):
+        def sp_prefill(p, tokens, lengths, cache, key, row_valid, *cstate):
             self.prefill_traces += 1
             p = self._dequant_params(p)
             # pad columns and synthetic batch rows must not claim routed-expert
@@ -513,7 +548,10 @@ class Generator:
                     }
                 new_cache.append(layer)
             last = jnp.take_along_axis(hidden, (lengths - 1)[:, None, None], axis=1)[:, 0]
-            tok0 = sample_tokens(self._head_fn(p, last.astype(compute_dtype)), key, cfg)
+            logits = self._head_fn(p, last.astype(compute_dtype))
+            if cstate:
+                logits = jnp.where(self._cs_allowed[cstate[0]], logits, -jnp.inf)
+            tok0 = sample_tokens(logits, key, cfg)
             return tok0, tuple(new_cache), last.astype(jnp.float32)
 
         return jax.jit(sp_prefill, donate_argnums=(3,))
@@ -551,7 +589,8 @@ class Generator:
         p0 = len(prefix_tokens)
         if p0 == 0:
             raise ValueError("prefix_tokens must be non-empty")
-        _, _, _, (cache, _, _, _, _) = self._start([list(prefix_tokens)], 0)
+        _, _, _, carry = self._start([list(prefix_tokens)], 0)
+        cache = carry[0]
         return PrefixCache(
             layers=jax.tree_util.tree_map(lambda c: c[:1, :p0], cache),
             length=p0,
@@ -565,6 +604,7 @@ class Generator:
         extra_cache: int = 0,
         batch_override: Optional[int] = None,
         prefix: Optional[PrefixCache] = None,
+        constraint: Optional[Any] = None,
     ):
         """Shared prefill setup: pad/bucket the prompts, allocate + place the cache,
         run prefill, and return the first sampled token, the last-token hidden
@@ -572,8 +612,12 @@ class Generator:
         exactly (beam search needs batch == groups * num_beams). With ``prefix``,
         the cached prefix rows are pasted into every row's cache and only the
         suffix is prefilled (through the chunked path, which takes a start
-        offset)."""
+        offset). ``constraint`` (an int or one int per prompt) selects each row's
+        grammar from ``config.constraints``; rows then start at that grammar's
+        DFA start state and the carry gains the per-row state as its tail."""
         cfg = self.config
+        if constraint is not None and self._cs is None:
+            raise ValueError("constraint= requires GenerationConfig.constraints to be set")
         n = len(prompts)
         if prefix is not None and any(len(p) == 0 for p in prompts):
             # an empty suffix would silently condition on prefix + [pad_id]
@@ -600,6 +644,22 @@ class Generator:
         all_lengths = np.ones((batch,), np.int32)
         all_lengths[:n] = lengths
 
+        cstate: tuple = ()
+        if self._cs is not None:
+            # grammar id -> start state; synthetic padding rows ride FREE (id 0)
+            gids = np.zeros((batch,), np.int64)
+            if constraint is not None:
+                con = np.asarray(constraint)
+                if con.ndim == 0:
+                    gids[:n] = int(con)
+                elif con.shape[0] == n:
+                    gids[:n] = con
+                else:
+                    raise ValueError(
+                        f"constraint has {con.shape[0]} entries for {n} prompts"
+                    )
+            cstate = (jnp.asarray(self._cs.start_states(gids)),)
+
         sp = (
             cfg.sp_prefill
             and self.mesh is not None
@@ -612,7 +672,9 @@ class Generator:
             # to the sp path); the short per-request suffix goes through the
             # offset chunked path here — SP where length lives, cache reuse
             # where repetition lives
-            return self._start_with_prefix(prefix, tokens, lengths, batch, n, bucket, extra_cache, seed)
+            return self._start_with_prefix(
+                prefix, tokens, lengths, batch, n, bucket, extra_cache, seed, cstate
+            )
         if sp:
             seq = int(self.mesh.shape["sequence"])
             aligned = chunk_aligned(bucket, seq)  # each sequence shard gets equal columns
@@ -632,18 +694,18 @@ class Generator:
             if self._sp_prefill_fn is None:
                 self._sp_prefill_fn = self._build_sp_prefill()
             tok0, cache, last = self._sp_prefill_fn(
-                self.params, jnp.asarray(tokens), jnp.asarray(all_lengths), cache, prefill_key, row_valid
+                self.params, jnp.asarray(tokens), jnp.asarray(all_lengths), cache, prefill_key, row_valid, *cstate
             )
         elif chunk and bucket > chunk:
             last, cache = self._chunked_prefill_loop(
                 tokens, jnp.asarray(all_lengths), cache, row_valid, chunk
             )
-            tok0 = self._first_token(self.params, last, prefill_key)
+            tok0 = self._first_token(self.params, last, prefill_key, *cstate)
         else:
             tok0, cache, last = self._prefill(
-                self.params, jnp.asarray(tokens), jnp.asarray(all_lengths), cache, prefill_key, row_valid
+                self.params, jnp.asarray(tokens), jnp.asarray(all_lengths), cache, prefill_key, row_valid, *cstate
             )
-        return self._finish_prefill(n, tok0, last, cache, jnp.asarray(all_lengths), row_valid, key)
+        return self._finish_prefill(n, tok0, last, cache, jnp.asarray(all_lengths), row_valid, key, cstate)
 
     def _chunked_prefill_loop(self, tokens, lengths_dev, cache, row_valid, chunk: int, start: int = 0):
         """Run right-padded ``tokens`` through the chunked prefill fn in
@@ -662,13 +724,18 @@ class Generator:
             last = jnp.where(has[:, None], chunk_last, last)
         return last, cache
 
-    def _finish_prefill(self, n, tok0, last, cache, lengths_dev, row_valid, key):
+    def _finish_prefill(self, n, tok0, last, cache, lengths_dev, row_valid, key, cstate=()):
         eos = self.config.eos_id
         done = (tok0 == eos) if eos is not None else jnp.zeros(tok0.shape, bool)
         # synthetic batch-padding rows start done: they emit pads, never advance
         # their cache, and stay out of routed-expert capacity
         done = done | ~row_valid
-        return n, tok0, last, (cache, tok0, lengths_dev, done, key)
+        carry = (cache, tok0, lengths_dev, done, key)
+        if cstate:
+            # advance each row's DFA past its (constrained) first token; the
+            # state rides as the carry's tail through the decode scan
+            carry = carry + (self._cs_trans[cstate[0], tok0],)
+        return n, tok0, last, carry
 
     def _start_with_prefix(
         self,
@@ -680,6 +747,7 @@ class Generator:
         bucket: int,
         extra_cache: int,
         seed: int,
+        cstate: tuple = (),
     ):
         """Prefill only the per-request suffix: the prefix's K/V rows are pasted
         into slots ``[0, p0)`` of every cache row and the suffix flows through the
@@ -712,8 +780,8 @@ class Generator:
         last, cache = self._chunked_prefill_loop(
             tokens, lengths_dev, cache, row_valid, chunk, start=p0
         )
-        tok0 = self._first_token(self.params, last, prefill_key)
-        return self._finish_prefill(n, tok0, last, cache, lengths_dev, row_valid, key)
+        tok0 = self._first_token(self.params, last, prefill_key, *cstate)
+        return self._finish_prefill(n, tok0, last, cache, lengths_dev, row_valid, key, cstate)
 
     def __call__(
         self,
@@ -721,20 +789,27 @@ class Generator:
         *,
         seed: int = 0,
         prefix: Optional[PrefixCache] = None,
+        constraint: Optional[Any] = None,
     ) -> np.ndarray:
         """Generate ``max_new_tokens`` per prompt; returns ``[len(prompts), max_new]``
         int32 (``pad_id`` after each example's ``eos_id``). With ``prefix`` (from
         :meth:`cache_prefix`), prompts are suffixes after the shared prefix and
         only they are prefilled. With ``config.draft`` set, decoding runs
-        speculatively (same output law, fewer target dispatches)."""
+        speculatively (same output law, fewer target dispatches). ``constraint``
+        (an int, or one int per prompt, indexing ``config.constraints``; 0 = the
+        FREE grammar) masks each row's decoding by its grammar's token DFA."""
         if self.config.draft is not None:
+            if constraint is not None:
+                # must not silently drop a structured-output request: the
+                # speculative path has no DFA masking (see __init__'s guard)
+                raise ValueError("constraint= does not compose with speculative decoding yet")
             return self._speculative()(prompts, seed=seed, prefix=prefix)
-        n, tok0, _, carry = self._start(prompts, seed, prefix=prefix)
+        n, tok0, _, carry = self._start(prompts, seed, prefix=prefix, constraint=constraint)
         steps = self.config.max_new_tokens - 1
         first = np.asarray(tok0)[:, None]
         if steps <= 0:
             return first[:n]
-        rest, _ = self._decode(self.params, *carry, steps)
+        rest, _ = self._decode(self.params, *carry, steps=steps)
         return np.concatenate([first, np.asarray(rest)], axis=1)[:n]
 
     def beam_search(
@@ -760,6 +835,11 @@ class Generator:
         cfg = self.config
         if num_beams < 1:
             raise ValueError("num_beams must be >= 1")
+        if self._cs is not None:
+            raise NotImplementedError(
+                "beam_search does not compose with constrained decoding yet: beam "
+                "reordering would need to gather DFA states alongside cache rows"
+            )
         n = len(prompts)
         # pad whole GROUPS (not rows) so the batch is exactly groups * num_beams;
         # a multiple of the data axis keeps both the prefill batch (groups) and
@@ -866,6 +946,7 @@ class Generator:
         seed: int = 0,
         chunk_size: int = 16,
         prefix: Optional[PrefixCache] = None,
+        constraint: Optional[Any] = None,
     ):
         """Incremental generation: yields ``[len(prompts), <=chunk_size]`` arrays of
         newly decoded tokens as they materialize (the first yield is the single
@@ -879,6 +960,8 @@ class Generator:
         if chunk_size < 1:
             raise ValueError("chunk_size must be >= 1")
         if cfg.draft is not None:
+            if constraint is not None:
+                raise ValueError("constraint= does not compose with speculative decoding yet")
             yield from self._speculative().stream(
                 prompts, seed=seed, chunk_size=chunk_size, prefix=prefix
             )
@@ -886,13 +969,15 @@ class Generator:
         # the last chunk may overshoot max_new_tokens; give its cache writes room
         n_chunks = max(0, -(-(cfg.max_new_tokens - 1) // chunk_size))
         extra = n_chunks * chunk_size - (cfg.max_new_tokens - 1)
-        n, tok0, _, carry = self._start(prompts, seed, extra_cache=extra, prefix=prefix)
+        n, tok0, _, carry = self._start(
+            prompts, seed, extra_cache=extra, prefix=prefix, constraint=constraint
+        )
         yield np.asarray(tok0)[:n, None]
         produced = 1
         while produced < cfg.max_new_tokens:
             if bool(np.asarray(carry[3]).all()):
                 return  # every row finished with eos
-            toks, carry = self._decode(self.params, *carry, chunk_size)
+            toks, carry = self._decode(self.params, *carry, steps=chunk_size)
             take = min(chunk_size, cfg.max_new_tokens - produced)
             yield np.asarray(toks)[:n, :take]
             produced += take

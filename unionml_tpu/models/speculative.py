@@ -107,6 +107,16 @@ class SpeculativeGenerator:
         cannot drift."""
         if gamma < 1:
             raise ValueError("gamma must be >= 1")
+        if config.constraints is not None:
+            # the same contract Generator.__init__ enforces for draft=; checked
+            # here too because both constructors strip draft from the config,
+            # which would otherwise bypass that guard and crash later on the
+            # constrained carry layout
+            raise ValueError(
+                "constraints do not compose with speculative decoding yet: the "
+                "draft's proposals would need the same per-row DFA masking to "
+                "keep the verify law exact"
+            )
         self.config = config
         self.gamma = int(gamma)
         self.rounds = 0

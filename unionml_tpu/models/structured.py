@@ -1,0 +1,519 @@
+"""Grammar-constrained (structured) decoding: regex -> token-level DFA tables.
+
+The reference has no generation stack at all (SURVEY.md §2.3 — no attention or
+inference code anywhere in unionml/), so structured output is pure new surface;
+it is table stakes for a production serving engine (JSON mode, enum outputs,
+tool-call argument shapes). The TPU-native design constraint is that the decode
+loop is ONE compiled ``lax.scan`` — so the grammar must be data, not control
+flow:
+
+- a regex is compiled on the host to a char-level DFA (Thompson NFA + subset
+  construction), then projected onto the token vocabulary: ``trans[s, t]`` is
+  the DFA state after emitting token ``t`` from state ``s`` and
+  ``allowed[s, t]`` whether that emission keeps the output inside the language;
+- the tables ride to the device once; inside the jitted decode step the
+  constraint is two gathers and a ``where`` — ``logits`` masked by
+  ``allowed[state]``, ``state`` advanced by ``trans[state, token]``. No
+  data-dependent Python control flow, no recompilation per grammar.
+
+:class:`ConstraintSet` unions several grammars into ONE table pair by
+renumbering states; a row's grammar is then nothing but its start state, so a
+single compiled decode program serves every grammar — per-request constraints
+in a continuously-batched server cost zero extra compiles.
+
+Token-level liveness: a char-level-live DFA state can still be a dead end for a
+given vocabulary (no token realizes any escaping path). Tables are pruned to
+token-level-live states by a backwards fixed point, so every reachable state
+always has at least one allowed token (EOS counts at accepting states) — the
+masked logits row can never be all ``-inf``.
+
+Budget truncation caveat (shared by every structured-output engine): if
+``max_new_tokens`` runs out before the DFA reaches an accepting state, the
+emitted prefix matches a prefix of the language, not necessarily a full
+sentence of it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+__all__ = ["TokenConstraint", "ConstraintSet", "compile_regex", "literal_choice"]
+
+
+# ---------------------------------------------------------------------------
+# Regex AST. The supported subset: literals, escapes (\d \w \s and inverses,
+# \n \t \r, escaped metachars), classes [a-z0-9_] with ranges and negation,
+# '.', quantifiers * + ? {m} {m,} {m,n}, alternation |, grouping (). This is
+# the regular (finite-automaton) core — no backrefs/lookarounds, which have no
+# DFA and therefore no place in a fixed-shape decode step.
+
+
+@dataclasses.dataclass(frozen=True)
+class _CharSet:
+    chars: FrozenSet[str]
+    negated: bool = False
+
+    def resolve(self, alphabet: FrozenSet[str]) -> FrozenSet[str]:
+        return frozenset(alphabet - self.chars) if self.negated else self.chars
+
+
+@dataclasses.dataclass(frozen=True)
+class _Node:
+    kind: str  # "chars" | "concat" | "alt" | "repeat"
+    chars: Optional[_CharSet] = None
+    children: Tuple["_Node", ...] = ()
+    lo: int = 0
+    hi: Optional[int] = None  # None = unbounded
+
+
+_DIGITS = frozenset("0123456789")
+_WORD = frozenset("abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_")
+_SPACE = frozenset(" \t\n\r\f\v")
+_ESCAPES = {
+    "d": _CharSet(_DIGITS),
+    "D": _CharSet(_DIGITS, negated=True),
+    "w": _CharSet(_WORD),
+    "W": _CharSet(_WORD, negated=True),
+    "s": _CharSet(_SPACE),
+    "S": _CharSet(_SPACE, negated=True),
+    "n": _CharSet(frozenset("\n")),
+    "t": _CharSet(frozenset("\t")),
+    "r": _CharSet(frozenset("\r")),
+}
+
+
+class _Parser:
+    def __init__(self, pattern: str):
+        self.p = pattern
+        self.i = 0
+
+    def error(self, msg: str) -> ValueError:
+        return ValueError(f"regex error at position {self.i} in {self.p!r}: {msg}")
+
+    def peek(self) -> Optional[str]:
+        return self.p[self.i] if self.i < len(self.p) else None
+
+    def next(self) -> str:
+        ch = self.p[self.i]
+        self.i += 1
+        return ch
+
+    def parse(self) -> _Node:
+        node = self.alt()
+        if self.i != len(self.p):
+            raise self.error(f"unexpected {self.p[self.i]!r}")
+        return node
+
+    def alt(self) -> _Node:
+        branches = [self.concat()]
+        while self.peek() == "|":
+            self.next()
+            branches.append(self.concat())
+        if len(branches) == 1:
+            return branches[0]
+        return _Node("alt", children=tuple(branches))
+
+    def concat(self) -> _Node:
+        parts: List[_Node] = []
+        while self.peek() not in (None, "|", ")"):
+            parts.append(self.repeat())
+        return _Node("concat", children=tuple(parts))
+
+    def repeat(self) -> _Node:
+        node = self.atom()
+        while self.peek() in ("*", "+", "?", "{"):
+            ch = self.peek()
+            if ch == "{":
+                save = self.i
+                bounds = self._brace_bounds()
+                if bounds is None:
+                    self.i = save
+                    break  # a literal '{' with no valid quantifier body
+                lo, hi = bounds
+            else:
+                self.next()
+                lo, hi = {"*": (0, None), "+": (1, None), "?": (0, 1)}[ch]
+            node = _Node("repeat", children=(node,), lo=lo, hi=hi)
+        return node
+
+    def _brace_bounds(self) -> Optional[Tuple[int, Optional[int]]]:
+        """Parse ``{m}``/``{m,}``/``{m,n}``/``{,n}`` after a consumed ``{``;
+        ``None`` = not a quantifier (the brace is a literal, matching how
+        ``re`` treats e.g. ``a{-2}`` or ``a{ 2}``)."""
+        self.next()  # consume '{'
+        body = ""
+        while self.peek() not in (None, "}"):
+            body += self.next()
+        if self.peek() != "}":
+            return None
+        self.next()
+        # strictly (possibly empty) digits around at most one comma — int()
+        # would also accept "-2" / " 2", silently compiling a different
+        # language than re does. Python 3.12 semantics: {m}, {m,}, {,n}, and
+        # bare {,} (= {0,}) are quantifiers; anything else is a literal brace.
+        head, sep, tail = body.partition(",")
+        if (head and not head.isdigit()) or (tail and not tail.isdigit()):
+            return None
+        if not sep:
+            if not head:
+                return None  # "{}" is a literal
+            lo = int(head)
+            return lo, lo
+        lo = int(head) if head else 0
+        hi = int(tail) if tail else None
+        if hi is not None and hi < lo:
+            raise self.error(f"bad quantifier bounds {{{body}}}")
+        return lo, hi
+
+    def atom(self) -> _Node:
+        ch = self.peek()
+        if ch is None:
+            raise self.error("unexpected end of pattern")
+        if ch == "(":
+            self.next()
+            node = self.alt()
+            if self.peek() != ")":
+                raise self.error("unbalanced parenthesis")
+            self.next()
+            return node
+        if ch == "[":
+            return _Node("chars", chars=self._char_class())
+        if ch == ".":
+            self.next()
+            return _Node("chars", chars=_CharSet(frozenset("\n"), negated=True))
+        if ch == "\\":
+            self.next()
+            esc = self.next() if self.peek() is not None else None
+            if esc is None:
+                raise self.error("dangling backslash")
+            return _Node("chars", chars=_ESCAPES.get(esc, _CharSet(frozenset(esc))))
+        if ch in ")|*+?":
+            raise self.error(f"unexpected {ch!r}")
+        self.next()
+        return _Node("chars", chars=_CharSet(frozenset(ch)))
+
+    def _char_class(self) -> _CharSet:
+        self.next()  # consume '['
+        negated = self.peek() == "^"
+        if negated:
+            self.next()
+        chars: Set[str] = set()
+        negated_parts: List[_CharSet] = []
+        first = True
+        while self.peek() != "]" or first:
+            first = False
+            ch = self.peek()
+            if ch is None:
+                raise self.error("unterminated character class")
+            if ch == "\\":
+                self.next()
+                if self.peek() is None:
+                    raise self.error("dangling backslash in character class")
+                esc = self.next()
+                part = _ESCAPES.get(esc, _CharSet(frozenset(esc)))
+                if part.negated:
+                    negated_parts.append(part)
+                else:
+                    chars |= part.chars
+                continue
+            self.next()
+            if self.peek() == "-" and self.i + 1 < len(self.p) and self.p[self.i + 1] != "]":
+                self.next()  # consume '-'
+                end = self.next()
+                if ord(end) < ord(ch):
+                    raise self.error(f"bad range {ch}-{end}")
+                chars |= {chr(c) for c in range(ord(ch), ord(end) + 1)}
+            else:
+                chars.add(ch)
+        self.next()  # consume ']'
+        if negated_parts:
+            # [\D...] style classes inside a positive class need the alphabet to
+            # resolve; rare enough to refuse rather than approximate
+            raise self.error("negated escape inside a character class is unsupported")
+        return _CharSet(frozenset(chars), negated=negated)
+
+
+def _ast_chars(node: _Node) -> Set[str]:
+    if node.kind == "chars":
+        return set(node.chars.chars)
+    out: Set[str] = set()
+    for child in node.children:
+        out |= _ast_chars(child)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Thompson NFA -> subset-construction DFA over an explicit (projected) alphabet.
+
+
+class _NFA:
+    def __init__(self) -> None:
+        self.eps: List[Set[int]] = []
+        self.edges: List[List[Tuple[FrozenSet[str], int]]] = []
+
+    def state(self) -> int:
+        self.eps.append(set())
+        self.edges.append([])
+        return len(self.eps) - 1
+
+
+def _build_nfa(node: _Node, nfa: _NFA, alphabet: FrozenSet[str]) -> Tuple[int, int]:
+    """Returns (entry, exit) state ids for ``node``'s fragment."""
+    if node.kind == "chars":
+        s, e = nfa.state(), nfa.state()
+        nfa.edges[s].append((node.chars.resolve(alphabet), e))
+        return s, e
+    if node.kind == "concat":
+        s = e = nfa.state()
+        for child in node.children:
+            cs, ce = _build_nfa(child, nfa, alphabet)
+            nfa.eps[e].add(cs)
+            e = ce
+        return s, e
+    if node.kind == "alt":
+        s, e = nfa.state(), nfa.state()
+        for child in node.children:
+            cs, ce = _build_nfa(child, nfa, alphabet)
+            nfa.eps[s].add(cs)
+            nfa.eps[ce].add(e)
+        return s, e
+    if node.kind == "repeat":
+        (child,) = node.children
+        s = e = nfa.state()
+        for _ in range(node.lo):  # mandatory copies
+            cs, ce = _build_nfa(child, nfa, alphabet)
+            nfa.eps[e].add(cs)
+            e = ce
+        if node.hi is None:  # Kleene tail
+            cs, ce = _build_nfa(child, nfa, alphabet)
+            nfa.eps[e].add(cs)
+            nfa.eps[ce].add(cs)
+            out = nfa.state()
+            nfa.eps[e].add(out)
+            nfa.eps[ce].add(out)
+            return s, out
+        tail_exits = [e]
+        for _ in range(node.hi - node.lo):  # optional copies
+            cs, ce = _build_nfa(child, nfa, alphabet)
+            nfa.eps[e].add(cs)
+            e = ce
+            tail_exits.append(e)
+        out = nfa.state()
+        for t in tail_exits:
+            nfa.eps[t].add(out)
+        return s, out
+    raise AssertionError(node.kind)
+
+
+def _eps_closure(nfa: _NFA, states: FrozenSet[int]) -> FrozenSet[int]:
+    seen = set(states)
+    stack = list(states)
+    while stack:
+        s = stack.pop()
+        for t in nfa.eps[s]:
+            if t not in seen:
+                seen.add(t)
+                stack.append(t)
+    return frozenset(seen)
+
+
+def _char_dfa(
+    pattern: str, alphabet: FrozenSet[str]
+) -> Tuple[List[Dict[str, int]], List[bool]]:
+    """Subset-construction DFA: returns (transitions, accepting) with state 0 the
+    start state; missing dict entries are dead."""
+    ast = _Parser(pattern).parse()
+    alphabet = frozenset(alphabet | _ast_chars(ast))
+    nfa = _NFA()
+    entry, exit_ = _build_nfa(ast, nfa, alphabet)
+    start = _eps_closure(nfa, frozenset([entry]))
+    index: Dict[FrozenSet[int], int] = {start: 0}
+    trans: List[Dict[str, int]] = [{}]
+    accepting: List[bool] = [exit_ in start]
+    work = [start]
+    while work:
+        stateset = work.pop()
+        si = index[stateset]
+        by_char: Dict[str, Set[int]] = {}
+        for s in stateset:
+            for charset, target in nfa.edges[s]:
+                for ch in charset:
+                    by_char.setdefault(ch, set()).add(target)
+        for ch, targets in by_char.items():
+            nxt = _eps_closure(nfa, frozenset(targets))
+            if nxt not in index:
+                index[nxt] = len(trans)
+                trans.append({})
+                accepting.append(exit_ in nxt)
+                work.append(nxt)
+            trans[si][ch] = index[nxt]
+    # char-level liveness: drop states that cannot reach an accepting state
+    n = len(trans)
+    live = [accepting[i] for i in range(n)]
+    changed = True
+    while changed:
+        changed = False
+        for i in range(n):
+            if not live[i] and any(live[t] for t in trans[i].values()):
+                live[i] = True
+                changed = True
+    if not live[0]:
+        raise ValueError(f"regex {pattern!r} matches no string")
+    for i in range(n):
+        trans[i] = {ch: t for ch, t in trans[i].items() if live[t]}
+    return trans, accepting
+
+
+# ---------------------------------------------------------------------------
+# Token projection.
+
+
+@dataclasses.dataclass(frozen=True)
+class TokenConstraint:
+    """One grammar projected onto a token vocabulary.
+
+    ``trans[s, t]``: state after emitting token id ``t`` from state ``s``
+    (meaningful only where ``allowed[s, t]``). ``allowed[s, t]``: whether token
+    ``t`` keeps the output inside the language from state ``s`` (for the EOS
+    column: whether the output so far is a complete sentence of it). State 0 is
+    the start state. Build with :func:`compile_regex` / :func:`literal_choice`.
+    """
+
+    trans: np.ndarray  # [S, V] int32
+    allowed: np.ndarray  # [S, V] bool
+    eos_id: int
+
+    @property
+    def n_states(self) -> int:
+        return int(self.trans.shape[0])
+
+    @property
+    def vocab_size(self) -> int:
+        return int(self.trans.shape[1])
+
+
+def compile_regex(pattern: str, vocab: Sequence[str], eos_id: int) -> TokenConstraint:
+    """Compile ``pattern`` (fullmatch semantics, like ``re.fullmatch``) into a
+    :class:`TokenConstraint` over ``vocab`` — ``vocab[t]`` is the decoded text
+    of token id ``t``. Empty-string tokens (pads, non-text specials) are never
+    allowed; ``eos_id`` is allowed exactly at accepting states. Raises if the
+    language is empty or no vocabulary tokenization can realize it."""
+    if not 0 <= eos_id < len(vocab):
+        raise ValueError(f"eos_id {eos_id} outside vocab of {len(vocab)}")
+    alphabet = frozenset(ch for tok in vocab for ch in tok)
+    ctrans, caccept = _char_dfa(pattern, alphabet)
+    n_char_states = len(ctrans)
+
+    # vectorized projection: fold each token's chars over ALL states at once
+    # (numpy gathers, -1 = dead) — O(V * len * S) array steps instead of a
+    # pure-Python walk per (state, token) pair, which matters at real-tokenizer
+    # vocab sizes (32k-128k) at server startup
+    chars = sorted({ch for row in ctrans for ch in row})
+    char_ix = {ch: i for i, ch in enumerate(chars)}
+    cmat = np.full((n_char_states, len(chars) + 1), -1, np.int64)  # last col = unknown char
+    for s, row in enumerate(ctrans):
+        for ch, t in row.items():
+            cmat[s, char_ix[ch]] = t
+
+    V = len(vocab)
+    trans = np.zeros((n_char_states, V), np.int32)
+    allowed = np.zeros((n_char_states, V), bool)
+    all_states = np.arange(n_char_states)
+    for t, text in enumerate(vocab):
+        if t == eos_id or text == "":
+            continue
+        cur = all_states
+        for ch in text:
+            ci = char_ix.get(ch, len(chars))
+            cur = np.where(cur >= 0, cmat[np.maximum(cur, 0), ci], -1)
+            if not (cur >= 0).any():
+                break
+        ok = cur >= 0
+        trans[ok, t] = cur[ok]
+        allowed[:, t] = ok
+    # token-level liveness: a char-live state can still be a dead end for THIS
+    # vocab (no token realizes an escaping path). Backwards fixed point; then
+    # transitions into token-dead states are disallowed, so every reachable
+    # state keeps >= 1 allowed token and the masked logits row is never all -inf.
+    live = np.asarray(caccept, bool).copy()
+    while True:
+        reach_live = (allowed & live[trans]).any(axis=1)
+        new_live = live | reach_live
+        if (new_live == live).all():
+            break
+        live = new_live
+    if not live[0]:
+        raise ValueError(
+            f"regex {pattern!r} is unreachable with this vocabulary "
+            "(no token sequence spells a sentence of it)"
+        )
+    allowed &= live[trans]
+    for s in np.flatnonzero(np.asarray(caccept, bool)):
+        trans[s, eos_id] = s  # terminal self-loop; the row is done after EOS
+        allowed[s, eos_id] = True
+    keep = np.flatnonzero(live)
+    remap = np.full(n_char_states, -1, np.int64)
+    remap[keep] = np.arange(len(keep))
+    trans = remap[trans[keep]].astype(np.int32)
+    trans[trans < 0] = 0  # disallowed entries; value never read
+    return TokenConstraint(trans=trans, allowed=allowed[keep], eos_id=eos_id)
+
+
+def literal_choice(choices: Sequence[str], vocab: Sequence[str], eos_id: int) -> TokenConstraint:
+    """Constrain output to exactly one of ``choices`` (an enum — classifier
+    labels, tool names). Sugar over :func:`compile_regex` with escaping."""
+    if not choices:
+        raise ValueError("choices must be non-empty")
+    escaped = ["".join("\\" + c if c in "\\.[](){}|*+?^$-" else c for c in s) for s in choices]
+    return compile_regex("|".join(escaped), vocab, eos_id)
+
+
+class ConstraintSet:
+    """A union of grammars in ONE table pair, renumbered so that a grammar is
+    nothing but a start state: ``starts[g]`` for grammar id ``g``. Grammar id 0
+    is always FREE (every token allowed, nothing enforced) so unconstrained and
+    constrained rows batch together; user grammars get ids 1..n in the order
+    given. One compiled decode program serves every member."""
+
+    def __init__(self, constraints: Sequence[TokenConstraint]):
+        if not constraints:
+            raise ValueError("ConstraintSet needs at least one TokenConstraint")
+        V = constraints[0].vocab_size
+        eos = constraints[0].eos_id
+        for c in constraints:
+            if c.vocab_size != V or c.eos_id != eos:
+                raise ValueError("all constraints must share one vocab and eos_id")
+        # FREE grammar: one state, all tokens allowed, self-loop
+        blocks_t = [np.zeros((1, V), np.int32)]
+        blocks_a = [np.ones((1, V), bool)]
+        starts = [0]
+        offset = 1
+        for c in constraints:
+            blocks_t.append(c.trans + offset)
+            blocks_a.append(c.allowed)
+            starts.append(offset)
+            offset += c.n_states
+        self.trans = np.concatenate(blocks_t, axis=0)
+        self.allowed = np.concatenate(blocks_a, axis=0)
+        self.starts = np.asarray(starts, np.int32)
+        self.eos_id = eos
+
+    @property
+    def n_grammars(self) -> int:
+        """Including the implicit FREE grammar at id 0."""
+        return len(self.starts)
+
+    @property
+    def vocab_size(self) -> int:
+        return int(self.trans.shape[1])
+
+    def start_states(self, grammar_ids: Sequence[int]) -> np.ndarray:
+        ids = np.asarray(grammar_ids, np.int64)
+        if ids.size and (ids.min() < 0 or ids.max() >= self.n_grammars):
+            raise ValueError(
+                f"grammar id out of range [0, {self.n_grammars}) in {list(grammar_ids)}"
+            )
+        return self.starts[ids].astype(np.int32)

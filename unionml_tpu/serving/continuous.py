@@ -93,6 +93,10 @@ class _Session:
     row_start: int = 0
     #: the ORIGINAL prompt from submit(); a resume prefills prompt + echo
     prompt: "List[int]" = dataclasses.field(default_factory=list)
+    #: grammar id into the generator's ConstraintSet (0 = FREE); the request's
+    #: DFA state is a pure function of (grammar, echo), so preemption resume
+    #: recovers it by a host-side walk over the emitted tokens
+    grammar: int = 0
 
 
 class _TokenStream:
@@ -426,6 +430,10 @@ class ContinuousBatcher:
         # jit outputs NamedSharding)
         key = jax.jit(jax.random.PRNGKey)(self._seed)
         if self._spec is None:
+            if self.gen._cs is not None:
+                # per-slot DFA state rides as the decode carry's tail, exactly
+                # as in Generator._finish_prefill (free slots sit at FREE's 0)
+                return (cache, tok, lengths, done, key, jnp.zeros((self.slots,), jnp.int32))
             return (cache, tok, lengths, done, key)
         draft_gen = self._spec._draft
         if self.block_size is not None:
@@ -455,6 +463,7 @@ class ContinuousBatcher:
         gen: Optional[Generator] = None,
         prefix: Optional[PrefixCache] = None,
         budget: Optional[int] = None,
+        dfa_state: Optional[int] = None,
     ):
         """Prefill one prompt at batch 1 into a fresh [1, cache_len] cache using
         the Generator's own jitted machinery — identical numerics and the same
@@ -496,6 +505,9 @@ class ContinuousBatcher:
         )
         key = jax.random.fold_in(jax.random.PRNGKey(self._seed), seed)
         row_valid = jnp.ones((1,), bool)
+        # the request's current DFA state masks the prompt-sampled token, same
+        # as Generator._start's cstate tail (batch-1 row here)
+        cstate = () if dfa_state is None else (jnp.asarray([dfa_state], jnp.int32),)
         if prefix is not None:
             chunk = cfg.prefill_chunk or bucket
             aligned = chunk_aligned(bucket, chunk)  # ragged tails would cost one
@@ -509,10 +521,10 @@ class ContinuousBatcher:
             last, row_cache = gen._chunked_prefill_loop(
                 tokens, lengths, row_cache, row_valid, chunk, start=p0
             )
-            tok0 = gen._first_token(gen.params, last, key)
+            tok0 = gen._first_token(gen.params, last, key, *cstate)
         else:
             tok0, row_cache, _ = gen._prefill(
-                gen.params, jnp.asarray(tokens), lengths, row_cache, key, row_valid
+                gen.params, jnp.asarray(tokens), lengths, row_cache, key, row_valid, *cstate
             )
         return tok0, lengths, row_cache
 
@@ -551,13 +563,18 @@ class ContinuousBatcher:
     # ------------------------------------------------------------------ public API
 
     def submit(
-        self, prompt: Sequence[int], *, max_new_tokens: Optional[int] = None
+        self, prompt: Sequence[int], *, max_new_tokens: Optional[int] = None,
+        constraint: Optional[int] = None,
     ) -> Iterator[np.ndarray]:
         """Enqueue a prompt; returns an iterator of 1-D int32 arrays of new
         tokens (first item is the prompt-sampled token). Blocks-free: the
         iterator blocks its consumer, not the engine. Safe from any thread.
         ``max_new_tokens`` caps THIS request below the config budget (the cache
-        is sized for the config's budget, so larger values are rejected)."""
+        is sized for the config's budget, so larger values are rejected).
+        ``constraint`` selects THIS request's grammar from the generator's
+        ``config.constraints`` (0 = FREE) — per-request structured output with
+        zero extra compiles, since a grammar is just a start state in the
+        set's shared table (models/structured.py)."""
         if len(prompt) == 0:
             raise ValueError("prompt must be non-empty")
         budget = self.gen.config.max_new_tokens
@@ -567,8 +584,14 @@ class ContinuousBatcher:
                     f"max_new_tokens must be in [1, {budget}] (the config budget the cache is sized for)"
                 )
             budget = max_new_tokens
+        grammar = 0
+        if constraint is not None:
+            if self.gen._cs is None:
+                raise ValueError("constraint= requires GenerationConfig.constraints on the Generator")
+            self.gen._cs.start_states([constraint])  # range check
+            grammar = int(constraint)
         session = _Session(
-            slot=-1, out=queue.Queue(), max_new=budget,
+            slot=-1, out=queue.Queue(), max_new=budget, grammar=grammar,
             # the original prompt is retained only where preemption can resume it
             prompt=list(prompt) if self.block_size is not None else [],
         )
@@ -795,8 +818,20 @@ class ContinuousBatcher:
                 self._seed += 1
                 seed = self._seed
             remaining = session.max_new - session.produced
+            dfa_state = None
+            if self.gen._cs is not None:
+                # the DFA state is a pure function of (grammar, emitted tokens):
+                # a fresh admission starts at the grammar's start state, a
+                # preemption resume walks the echo — the resumed row continues
+                # masking exactly where the evicted one left off
+                cs = self.gen._cs
+                dfa_state = int(cs.starts[session.grammar])
+                for t in session.echo:
+                    dfa_state = int(cs.trans[dfa_state, t])
             try:
-                tok0, row_len, row_cache = self._prefill_row(prompt, seed, budget=remaining)
+                tok0, row_len, row_cache = self._prefill_row(
+                    prompt, seed, budget=remaining, dfa_state=dfa_state
+                )
                 if self._spec is not None:
                     # the draft's cache row: same prompt through the draft model
                     # with the DRAFT's prefix rows (its prompt-sampled token is
@@ -826,7 +861,7 @@ class ContinuousBatcher:
             # produced carries across preemptions; this residency adds one token
             start_done = hit_eos or session.produced + 1 >= session.max_new
             if self._spec is None:
-                cache, tok, lengths, done, key = self._carry
+                cache, tok, lengths, done, key, *cst = self._carry
                 if blocks_row is not None:
                     cache, tok, lengths, done = self._paged_admit_fn(
                         cache, row_cache, tok, lengths, done, jnp.int32(slot), tok0, row_len,
@@ -836,7 +871,12 @@ class ContinuousBatcher:
                     cache, tok, lengths, done = self._admit_fn(
                         cache, row_cache, tok, lengths, done, jnp.int32(slot), tok0, row_len
                     )
-                self._carry = (cache, tok, lengths, done, key)
+                if dfa_state is not None:
+                    # advance past the (constrained) prompt-sampled token and
+                    # activate the slot's DFA state in the carry tail
+                    nxt_state = int(self.gen._cs.trans[dfa_state, int(first[0])])
+                    cst = [cst[0].at[slot].set(nxt_state)]
+                self._carry = (cache, tok, lengths, done, key, *cst)
             else:
                 t_cache, d_cache, tok, lengths, done, produced, out_buf, rounds, acc, key = self._carry
                 if blocks_row is not None:
@@ -990,7 +1030,7 @@ class ContinuousBatcher:
         if self._spec is not None:
             return self._spec_chunk()
         cfg = self.gen.config
-        toks, carry = self.gen._decode(self.gen.params, *self._carry, self.decode_chunk)
+        toks, carry = self.gen._decode(self.gen.params, *self._carry, steps=self.decode_chunk)
         self._carry = carry
         toks_np = np.asarray(toks)  # [S, chunk]; also fences the dispatch
         done_np = np.asarray(carry[3])
