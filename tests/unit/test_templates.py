@@ -125,8 +125,28 @@ def test_text_generation_template_trains_generates_and_serves(render, tmp_path):
     kv = generation["kv_blocks"]
     assert kv["block_size"] == 16 and kv["used"] == 0
 
+    # structured output: an '@<grammar> ' prefix constrains THAT request's
+    # continuation by device-side token-DFA masking, on /predict and on the
+    # continuously-batched single-prompt stream — and the two routes agree
+    # token-exactly (greedy)
+    import re
+
+    g_prompt = "@word the quick brown "
+    g_out = module.model.predict(features=[g_prompt, "plain "])
+    cont = g_out[0][len("the quick brown ") :]
+    assert cont and re.fullmatch(r"[a-z]+", cont), g_out[0]
+    # an un-prefixed prompt decodes FREE, unaffected by its constrained
+    # batchmate: equal to its solo free run, not merely prompt-prefixed
+    assert g_out[1] == module.model.predict(features=["plain "])[0]
+    streamed_word = asyncio.run(consume_one(g_prompt))
+    assert "the quick brown " + streamed_word == g_out[0]
+
     # speculative decoding through the Generator façade: greedy-exact vs the
-    # plain predictor (the half-depth draft changes speed, never tokens)
+    # plain predictor (the half-depth draft changes speed, never tokens).
+    # Spec prompts must be un-prefixed: speculative_generator builds its own
+    # constraint-free config, and its continuation must equal the FREE-grammar
+    # predictor output (eos_id differs: the predictor config uses PAD as eos,
+    # which the trained model never argmaxes)
     spec = module.speculative_generator(module.model.artifact.model_object)
     spec_out = spec([module.encode(p) for p in prompts])
     assert [p + module.decode(r) for p, r in zip(prompts, spec_out)] == outputs

@@ -9,6 +9,11 @@ KV-cached generation engine (``unionml_tpu.models.generate``).
 
 Swap ``CORPUS`` for your own text, scale ``LlamaConfig`` up, and add
 ``MeshSpec(...)``/``llama_partition_rules()`` to the TrainerConfig to shard.
+
+Structured output: prefix a prompt with ``@<grammar> `` (see ``GRAMMARS``) and
+that request's continuation is constrained to the grammar's regex by
+device-side token-DFA masking — per request, on both ``/predict`` and the
+continuously-batched ``/predict-stream``.
 """
 
 from typing import List, Optional, Tuple
@@ -21,7 +26,15 @@ import pandas as pd
 from flax.training import train_state
 
 from unionml_tpu import Dataset, Model, TrainerConfig
-from unionml_tpu.models import GenerationConfig, Generator, Llama, LlamaConfig, causal_lm_loss
+from unionml_tpu.models import (
+    ConstraintSet,
+    GenerationConfig,
+    Generator,
+    Llama,
+    LlamaConfig,
+    causal_lm_loss,
+    compile_regex,
+)
 
 SEQ_LEN = 32
 NEW_TOKENS = 48
@@ -121,6 +134,36 @@ def feature_loader(raw) -> List[str]:
     return [str(p) for p in raw]
 
 
+#: canned output grammars (structured decoding): a prompt of the form
+#: "@<name> <prompt text>" constrains THAT request's continuation to the named
+#: grammar — the regex compiles to device-side token-DFA tables
+#: (unionml_tpu.models.structured) and rides the shared decode program, so
+#: per-request grammars cost zero extra compiles. Plain prompts decode freely.
+GRAMMARS = {"word": r"[a-z]+", "sentence": r"[a-z][a-z ]*[.!]"}
+
+
+def _constraint_set():
+    texts = [""] * VOCAB_SIZE
+    for i, c in ITOS.items():
+        texts[i] = c
+    # PAD doubles as EOS for constrained rows: decode() already strips it, and
+    # the model never emits it unprompted (no PAD in the training windows)
+    return ConstraintSet([compile_regex(p, texts, eos_id=PAD_ID) for p in GRAMMARS.values()])
+
+
+_CONSTRAINTS = _constraint_set()
+
+
+def _split_grammar(feature: str) -> Tuple[int, str]:
+    """'@word the quick' -> (grammar id of 'word', 'the quick'); plain prompts
+    ride the FREE grammar (id 0)."""
+    if feature.startswith("@"):
+        name, _, rest = feature[1:].partition(" ")
+        if name in GRAMMARS:
+            return list(GRAMMARS).index(name) + 1, rest
+    return 0, feature
+
+
 _generators: dict = {}
 
 
@@ -133,7 +176,10 @@ def _generator_for(state: train_state.TrainState) -> Generator:
         gen = Generator(
             module,
             state.params,
-            GenerationConfig(max_new_tokens=NEW_TOKENS, temperature=0.0, prompt_buckets=(SEQ_LEN,)),
+            GenerationConfig(
+                max_new_tokens=NEW_TOKENS, temperature=0.0, prompt_buckets=(SEQ_LEN,),
+                eos_id=PAD_ID, constraints=_CONSTRAINTS,
+            ),
         )
         _generators.clear()  # one live state at a time; drop stale compiled engines
         _generators[id(state)] = (state, gen)
@@ -146,8 +192,9 @@ def _encode_prompts(features: List[str]) -> List[List[int]]:
 
 @model.predictor
 def predictor(state: train_state.TrainState, features: List[str]) -> List[str]:
-    out = _generator_for(state)(_encode_prompts(features))
-    return [p + decode(row) for p, row in zip(features, out)]
+    gids, prompts = zip(*(_split_grammar(f) for f in features))
+    out = _generator_for(state)(_encode_prompts(list(prompts)), constraint=list(gids))
+    return [p + decode(row) for p, row in zip(prompts, out)]
 
 
 import threading
@@ -202,12 +249,13 @@ def stream_predictor(state: train_state.TrainState, features: List[str]):
     concatenating a prompt's pieces reproduces the /predict continuation.
     Single-prompt requests (the typical streaming call) ride the shared
     continuous-batching loop; multi-prompt requests stream as one batch."""
-    prompts = _encode_prompts(features)
+    gids, texts = zip(*(_split_grammar(f) for f in features))
+    prompts = _encode_prompts(list(texts))
     if len(prompts) == 1:
-        for chunk in _continuous_for(state).submit(prompts[0]):
+        for chunk in _continuous_for(state).submit(prompts[0], constraint=gids[0]):
             yield [decode(chunk)]
         return
-    for chunk in _generator_for(state).stream(prompts, chunk_size=8):
+    for chunk in _generator_for(state).stream(prompts, chunk_size=8, constraint=list(gids)):
         yield [decode(row) for row in chunk]
 
 
@@ -232,6 +280,11 @@ def speculative_generator(state: train_state.TrainState, draft_params=None, gamm
         )["params"]
     cfg = GenerationConfig(
         max_new_tokens=NEW_TOKENS, temperature=0.0, prompt_buckets=(SEQ_LEN,),
+        # eos matches the predictor config so the greedy-exact oracle (spec
+        # output == /predict output) holds by construction, not by the
+        # model-never-argmaxes-PAD assumption; constraints stay off (they do
+        # not compose with drafts)
+        eos_id=PAD_ID,
         draft=DraftSpec(module=draft_module, params=draft_params, gamma=gamma),
     )
     return Generator(module, state.params, cfg)
