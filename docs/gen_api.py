@@ -38,6 +38,7 @@ MODULES = [
     "unionml_tpu.parallel.collectives",
     "unionml_tpu.parallel.pipeline",
     "unionml_tpu.models.generate",
+    "unionml_tpu.models.structured",
     "unionml_tpu.models.speculative",
     "unionml_tpu.models.layers",
     "unionml_tpu.models.llama",

@@ -202,6 +202,70 @@ def test_empty_string_tokens_never_allowed():
     assert not c.allowed[:, 2].any()
 
 
+def test_json_object_grammar():
+    import json as jsonlib
+
+    from unionml_tpu.models import json_object
+
+    chars = sorted(set('abcdefghijklmnopqrstuvwxyz0123456789"{}:,.-+eE \t\ntruefalsnul'))
+    vocab = [""] + chars
+    g = json_object({"name": "string", "age": "integer", "ok": "boolean"}, vocab, eos_id=0)
+
+    def accepts(text: str) -> bool:
+        st = 0
+        for ch in text:
+            t = vocab.index(ch)
+            if not g.allowed[st, t]:
+                return False
+            st = int(g.trans[st, t])
+        return bool(g.allowed[st, 0])
+
+    good = '{"name": "ada", "age": 36, "ok": true}'
+    assert accepts(good) and jsonlib.loads(good)["age"] == 36
+    assert accepts('{"name":"x","age":0,"ok":false}')  # minimal whitespace
+    assert not accepts('{"name": "ada"}')  # missing keys
+    assert not accepts('{"age": 36, "name": "ada", "ok": true}')  # wrong order
+    assert not accepts('{"name": "ada", "age": 01, "ok": true}')  # leading zero
+    with pytest.raises(ValueError, match="non-empty"):
+        json_object({}, vocab, eos_id=0)
+    with pytest.raises(ValueError, match="JSON escaping"):
+        json_object({'a"b': "string"}, vocab, eos_id=0)
+    with pytest.raises(ValueError, match="unknown value type"):
+        json_object({"ok": "bool"}, vocab, eos_id=0)  # typo for 'boolean'
+
+
+def test_vocab_from_tokenizer_gpt2_bpe(tmp_path):
+    """An offline GPT2-style BPE tokenizer round-trips through the extracted
+    vocab: joining per-id texts over encode(s) reproduces s (the property the
+    grammar compiler needs)."""
+    import json as jsonlib
+
+    transformers = pytest.importorskip("transformers")
+
+    vocab = {"<|endoftext|>": 0, "a": 1, "b": 2, "ab": 3, "Ġ": 4, "Ġa": 5,
+             "c": 6, "1": 7, "2": 8, "12": 9}
+    (tmp_path / "vocab.json").write_text(jsonlib.dumps(vocab))
+    (tmp_path / "merges.txt").write_text("#version: 0.2\na b\nĠ a\n1 2\n")
+    tok = transformers.GPT2Tokenizer(str(tmp_path / "vocab.json"), str(tmp_path / "merges.txt"))
+
+    from unionml_tpu.models import compile_regex, vocab_from_tokenizer
+
+    texts = vocab_from_tokenizer(tok)
+    assert texts[0] == ""  # special token masked out
+    assert texts[4] == " " and texts[5] == " a"  # BPE space marker decoded
+    s = "ab a12"
+    ids = tok.encode(s, add_special_tokens=False)
+    assert "".join(texts[t] for t in ids) == s
+
+    # and the extracted vocab drives the compiler: 'ab' reachable, digits too
+    c = compile_regex(r"(ab)+ a[0-9]+", texts, eos_id=0)
+    st = 0
+    for t in ids:  # "ab" " a" "12" spells a sentence of the language
+        assert c.allowed[st, t]
+        st = int(c.trans[st, t])
+    assert c.allowed[st, 0]
+
+
 def test_constraint_set_layout():
     vocab = ["", "a", "b"]
     g1 = compile_regex("a+", vocab, eos_id=0)

@@ -14,7 +14,9 @@ from unionml_tpu.models.structured import (  # noqa: F401
     ConstraintSet,
     TokenConstraint,
     compile_regex,
+    json_object,
     literal_choice,
+    vocab_from_tokenizer,
 )
 from unionml_tpu.models.llama import (  # noqa: F401
     Llama,

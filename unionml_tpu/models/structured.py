@@ -36,11 +36,18 @@ sentence of it.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+from typing import Any, Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
-__all__ = ["TokenConstraint", "ConstraintSet", "compile_regex", "literal_choice"]
+__all__ = [
+    "TokenConstraint",
+    "ConstraintSet",
+    "compile_regex",
+    "literal_choice",
+    "json_object",
+    "vocab_from_tokenizer",
+]
 
 
 # ---------------------------------------------------------------------------
@@ -467,8 +474,101 @@ def literal_choice(choices: Sequence[str], vocab: Sequence[str], eos_id: int) ->
     labels, tool names). Sugar over :func:`compile_regex` with escaping."""
     if not choices:
         raise ValueError("choices must be non-empty")
-    escaped = ["".join("\\" + c if c in "\\.[](){}|*+?^$-" else c for c in s) for s in choices]
-    return compile_regex("|".join(escaped), vocab, eos_id)
+    return compile_regex("|".join(_escape(s) for s in choices), vocab, eos_id)
+
+
+_ESCAPE_META = "\\.[](){}|*+?^$-"
+
+
+def _escape(text: str) -> str:
+    return "".join("\\" + c if c in _ESCAPE_META else c for c in text)
+
+
+#: regex fragments for flat JSON values (no nesting — nested JSON is not
+#: regular; bound the shape instead of the grammar)
+JSON_VALUE_PATTERNS = {
+    "string": r'"[^"\\]*"',
+    "number": r"-?(0|[1-9][0-9]*)(\.[0-9]+)?([eE][+-]?[0-9]+)?",
+    "integer": r"-?(0|[1-9][0-9]*)",
+    "boolean": r"(true|false)",
+    "null": r"null",
+}
+
+
+def json_object(
+    fields: Dict[str, str], vocab: Sequence[str], eos_id: int, *, whitespace: bool = True
+) -> TokenConstraint:
+    """A grammar for a FLAT JSON object with exactly these keys, in order.
+
+    ``fields`` maps key -> value pattern: a name from
+    :data:`JSON_VALUE_PATTERNS` (``"string"``, ``"number"``, ``"integer"``,
+    ``"boolean"``, ``"null"``) or a raw regex for the value (e.g. an enum
+    ``'("red"|"green")'``). Keys are emitted in dict order — fixed key order is
+    what makes the object a REGULAR language (arbitrary key order is factorial
+    in alternations; nesting is not regular at all — for those, generate into a
+    string field and parse downstream).
+
+    >>> g = json_object({"name": "string", "age": "integer"}, vocab, eos_id)
+    >>> # accepts {"name": "ada", "age": 36} modulo whitespace
+
+    ``whitespace=True`` permits up to 4 blanks/newlines where JSON allows them
+    — BOUNDED on purpose: an unbounded ``[ \\t\\n]*`` lets a
+    whitespace-leaning model burn the whole token budget on blanks without
+    ever reaching the accept state (observed with an untrained model).
+    """
+    if not fields:
+        raise ValueError("fields must be non-empty")
+    ws = r"[ \t\n]{0,4}" if whitespace else ""
+    parts = []
+    for key, value in fields.items():
+        if any(c in key for c in '"\\') or any(ord(c) < 0x20 for c in key):
+            # such keys would need JSON string escaping inside the emitted
+            # text; refusing beats silently forcing invalid JSON
+            raise ValueError(f"key {key!r} contains characters needing JSON escaping")
+        if value not in JSON_VALUE_PATTERNS and value.isidentifier():
+            # identifier-shaped non-names are almost certainly typos ('bool'
+            # for 'boolean'); a raw-regex value always contains metachars/quotes
+            raise ValueError(
+                f"unknown value type {value!r}; expected one of {sorted(JSON_VALUE_PATTERNS)} "
+                "or a raw regex"
+            )
+        value_pat = JSON_VALUE_PATTERNS.get(value, value)
+        # plain (...) groups: this dialect has no captures, so grouping is free
+        parts.append(f'"{_escape(key)}"{ws}:{ws}({value_pat})')
+    body = (f"{ws},{ws}").join(parts)
+    return compile_regex(f"\\{{{ws}{body}{ws}\\}}", vocab, eos_id)
+
+
+def vocab_from_tokenizer(tokenizer: Any) -> List[str]:
+    """Best-effort ``token id -> decoded text`` list for a Hugging Face
+    tokenizer, for :func:`compile_regex`. Decodes each id in isolation
+    (``convert_ids_to_tokens`` + ``convert_tokens_to_string``) so BPE space
+    markers (``Ġ``/``Ċ``) and sentencepiece ``▁`` become real characters;
+    special tokens (bos/eos/pad/unk/additional) map to ``""`` so the compiler
+    never allows them mid-output. Caveat: tokenizers whose detokenization is
+    context-dependent beyond leading-space markers (rare) can drift — spot-check
+    ``"".join(vocab[t] for t in tokenizer.encode(s, add_special_tokens=False))
+    == s`` on your data before trusting a grammar with it."""
+    size = int(tokenizer.vocab_size)
+    extra = getattr(tokenizer, "added_tokens_encoder", {}) or {}
+    size = max([size] + [i + 1 for i in extra.values()])
+    special = set(getattr(tokenizer, "all_special_ids", []) or [])
+    out: List[str] = []
+    for i in range(size):
+        if i in special:
+            out.append("")
+            continue
+        try:
+            token = tokenizer.convert_ids_to_tokens(i)
+            if token is None:
+                out.append("")
+                continue
+            text = tokenizer.convert_tokens_to_string([token])
+        except Exception:
+            out.append("")
+            continue
+        out.append(text)
+    return out
 
 
 class ConstraintSet:
