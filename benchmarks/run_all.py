@@ -35,6 +35,7 @@ SCRIPTS = {
     "serving": "bench_serving.py",
     "serving_jit": "bench_serving_jit.py",
     "generate": "bench_generate.py",
+    "structured": "bench_structured.py",
     "speculative": "bench_speculative.py",
     "continuous": "bench_continuous.py",
     "int8_matmul": "bench_int8_matmul.py",

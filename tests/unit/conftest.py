@@ -5,3 +5,25 @@ The shared app fixtures (synthetic frame + sklearn LogisticRegression app,
 the analog of the reference's fixture re-export conftest
 (/root/reference/tests/unit/conftest.py:1-7).
 """
+
+import pytest
+
+
+@pytest.fixture(scope="session")
+def micro_lm():
+    """Vocab-6 Llama for exhaustive-search oracles — small enough that every
+    token sequence can be enumerated (shared by test_beam and the constrained
+    beam oracles in test_structured; one definition so the micro-model shape
+    cannot drift between the two files)."""
+    import jax
+    import jax.numpy as jnp
+
+    from unionml_tpu.models import Llama, LlamaConfig
+
+    config = LlamaConfig.tiny(
+        vocab_size=6, dim=32, n_layers=2, n_heads=4, n_kv_heads=2, hidden_dim=64,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    module = Llama(config)
+    params = module.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))["params"]
+    return module, params, config

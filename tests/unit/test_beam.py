@@ -15,15 +15,6 @@ import jax.numpy as jnp
 from unionml_tpu.models import GenerationConfig, Generator, Llama, LlamaConfig
 
 
-@pytest.fixture(scope="module")
-def tiny():
-    config = LlamaConfig.tiny(
-        vocab_size=6, dim=32, n_layers=2, n_heads=4, n_kv_heads=2, hidden_dim=64,
-        dtype=jnp.float32, param_dtype=jnp.float32,
-    )
-    module = Llama(config)
-    params = module.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))["params"]
-    return module, params, config
 
 
 def brute_force_best(module, params, prompt, steps, vocab):
@@ -39,8 +30,8 @@ def brute_force_best(module, params, prompt, steps, vocab):
     return list(best), best_score
 
 
-def test_full_width_beam_equals_exhaustive_search(tiny):
-    module, params, config = tiny
+def test_full_width_beam_equals_exhaustive_search(micro_lm):
+    module, params, config = micro_lm
     steps, vocab = 3, config.vocab_size
     gen = Generator(
         module, params, GenerationConfig(max_new_tokens=steps, temperature=0.0, prompt_buckets=(8,))
@@ -52,8 +43,8 @@ def test_full_width_beam_equals_exhaustive_search(tiny):
         assert out[0].tolist() == expected, prompt
 
 
-def test_beam_one_equals_greedy(tiny):
-    module, params, _ = tiny
+def test_beam_one_equals_greedy(micro_lm):
+    module, params, _ = micro_lm
     gen = Generator(
         module, params, GenerationConfig(max_new_tokens=8, temperature=0.0, prompt_buckets=(8,))
     )
@@ -61,9 +52,9 @@ def test_beam_one_equals_greedy(tiny):
     np.testing.assert_array_equal(gen.beam_search(prompts, num_beams=1), gen(prompts))
 
 
-def test_beam_width_improves_or_matches_score(tiny):
+def test_beam_width_improves_or_matches_score(micro_lm):
     """A wider beam can only find an equal-or-better-scoring sequence."""
-    module, params, config = tiny
+    module, params, config = micro_lm
     steps = 4
     gen = Generator(
         module, params, GenerationConfig(max_new_tokens=steps, temperature=0.0, prompt_buckets=(8,))
@@ -80,10 +71,10 @@ def test_beam_width_improves_or_matches_score(tiny):
     assert all(b >= a - 1e-5 for a, b in zip(scores, scores[1:])), scores
 
 
-def test_beam_eos_finishes_and_pads(tiny):
+def test_beam_eos_finishes_and_pads(micro_lm):
     """Some eos choice must surface in its constrained run (tiny vocab: sweep
     them all), and everything after the first eos must be pad."""
-    module, params, config = tiny
+    module, params, config = micro_lm
     seen_eos = False
     for eos in range(1, config.vocab_size):
         gen = Generator(

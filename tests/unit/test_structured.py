@@ -266,6 +266,33 @@ def test_vocab_from_tokenizer_gpt2_bpe(tmp_path):
     assert c.allowed[st, 0]
 
 
+def test_vocab_from_tokenizer_sentencepiece_space():
+    """transformers' sentencepiece detok strips a word-initial ▁'s space when
+    the token is first in the sequence — per-id extraction makes EVERY token
+    first, which would silently drop all inter-word spaces. The extractor must
+    re-prepend it."""
+    from unionml_tpu.models import vocab_from_tokenizer
+
+    class FakeSP:
+        vocab_size = 5
+        all_special_ids = [0]
+        added_tokens_encoder = {}
+        _toks = {0: "<s>", 1: "▁the", 2: "ing", 3: "▁", 4: "a"}
+
+        def convert_ids_to_tokens(self, i):
+            return self._toks[i]
+
+        def convert_tokens_to_string(self, tokens):
+            # mimic LlamaTokenizer: strip the FIRST token's leading ▁
+            first = tokens[0]
+            if first.startswith("▁"):
+                first = first[1:]
+            return first + "".join(t.replace("▁", " ") for t in tokens[1:])
+
+    texts = vocab_from_tokenizer(FakeSP())
+    assert texts == ["", " the", "ing", " ", "a"]
+
+
 def test_constraint_set_layout():
     vocab = ["", "a", "b"]
     g1 = compile_regex("a+", vocab, eos_id=0)
@@ -380,14 +407,94 @@ def test_wrong_constraint_arity_raises(tiny, cs):
         gen([[1, 2]], constraint=[1, 2])
 
 
-def test_beam_search_rejects_constraints(tiny, cs):
-    module, params, _ = tiny
+
+
+MICRO_TEXTS = ["", "a", "b", "c", "d", ""]  # ids 1-4 decode a-d; 5 = eos
+MICRO_EOS = 5
+
+
+def _micro_cs(pattern: str) -> ConstraintSet:
+    return ConstraintSet([compile_regex(pattern, MICRO_TEXTS, eos_id=MICRO_EOS)])
+
+
+def _constrained_brute_force(module, params, cset, grammar, prompt, steps):
+    """Enumerate every DFA-legal continuation (eos freezes the row; pads
+    after), scoring with the CONSTRAINED policy: logits masked by the state's
+    allowed set, then log-renormalized — exactly beam_fn's logprobs. Walks
+    the ConstraintSet's union table from the grammar's start state."""
+    import itertools
+
+    best, best_score = None, -np.inf
+    for cont in itertools.product(range(len(MICRO_TEXTS)), repeat=steps):
+        tokens, score, finished, legal = list(prompt), 0.0, False, True
+        state = int(cset.starts[grammar])
+        for t in cont:
+            if finished:
+                legal = t == 0  # pad after eos
+                if not legal:
+                    break
+                continue
+            if not cset.allowed[state, t]:
+                legal = False
+                break
+            logits = module.apply({"params": params}, jnp.asarray([tokens], jnp.int32))
+            row = np.asarray(logits[0, -1], np.float64)
+            row[~np.asarray(cset.allowed[state], bool)] = -np.inf
+            m = row.max()
+            lp = row - (np.log(np.sum(np.exp(row - m))) + m)
+            score += float(lp[t])
+            state = int(cset.trans[state, t])
+            tokens.append(t)
+            if t == MICRO_EOS:
+                finished = True
+        if legal and score > best_score:
+            best, best_score = list(cont), score
+    return best, best_score
+
+
+def test_constrained_full_width_beam_equals_exhaustive(micro_lm):
+    module, params, _ = micro_lm
+    steps = 3
+    cset = _micro_cs("[a-c]{2,3}")
     gen = Generator(
         module, params,
-        GenerationConfig(max_new_tokens=4, temperature=0.0, prompt_buckets=(8,), constraints=cs),
+        GenerationConfig(max_new_tokens=steps, temperature=0.0, eos_id=MICRO_EOS,
+                         prompt_buckets=(8,), constraints=cset),
     )
-    with pytest.raises(NotImplementedError, match="beam"):
-        gen.beam_search([[1, 2]])
+    for prompt in ([1, 4, 2], [3, 2]):
+        best, _ = _constrained_brute_force(module, params, cset, 1, prompt, steps)
+        out = gen.beam_search([prompt], num_beams=len(MICRO_TEXTS) ** (steps - 1), constraint=1)
+        assert out[0].tolist() == best, (prompt, best)
+        # and the winner spells a sentence (or budget-truncated prefix) of the language
+        text = "".join(MICRO_TEXTS[t] for t in out[0] if t not in (0, MICRO_EOS))
+        assert re.fullmatch(r"[a-c]{2,3}", text) or (len(text) <= 3 and all(ch in "abc" for ch in text))
+
+
+def test_constrained_beam_one_equals_greedy(micro_lm):
+    module, params, _ = micro_lm
+    cset = _micro_cs("[a-c]{2,4}")
+    gen = Generator(
+        module, params,
+        GenerationConfig(max_new_tokens=6, temperature=0.0, eos_id=MICRO_EOS,
+                         prompt_buckets=(8,), constraints=cset),
+    )
+    prompts = [[1, 2, 3], [4, 2]]
+    greedy = gen(prompts, constraint=[1, 1])
+    beam = gen.beam_search(prompts, num_beams=1, constraint=[1, 1])
+    assert np.array_equal(beam, greedy)
+
+
+def test_constrained_beam_free_grammar_matches_unconstrained(micro_lm):
+    module, params, _ = micro_lm
+    cset = _micro_cs("[a-c]+")
+    kw = dict(max_new_tokens=5, temperature=0.0, prompt_buckets=(8,))
+    gen_cs = Generator(module, params, GenerationConfig(constraints=cset, **kw))
+    gen_plain = Generator(module, params, GenerationConfig(**kw))
+    prompts = [[1, 2], [3]]
+    assert np.array_equal(
+        gen_cs.beam_search(prompts, num_beams=3, constraint=0),
+        gen_plain.beam_search(prompts, num_beams=3),
+    )
 
 
 def test_draft_with_constraints_rejected(tiny, cs):

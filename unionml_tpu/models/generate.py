@@ -646,19 +646,7 @@ class Generator:
 
         cstate: tuple = ()
         if self._cs is not None:
-            # grammar id -> start state; synthetic padding rows ride FREE (id 0)
-            gids = np.zeros((batch,), np.int64)
-            if constraint is not None:
-                con = np.asarray(constraint)
-                if con.ndim == 0:
-                    gids[:n] = int(con)
-                elif con.shape[0] == n:
-                    gids[:n] = con
-                else:
-                    raise ValueError(
-                        f"constraint has {con.shape[0]} entries for {n} prompts"
-                    )
-            cstate = (jnp.asarray(self._cs.start_states(gids)),)
+            cstate = (jnp.asarray(self._cs.start_states(self._grammar_ids(constraint, n, batch))),)
 
         sp = (
             cfg.sp_prefill
@@ -723,6 +711,20 @@ class Generator:
             )
             last = jnp.where(has[:, None], chunk_last, last)
         return last, cache
+
+    def _grammar_ids(self, constraint: Optional[Any], n: int, batch: int) -> np.ndarray:
+        """Normalize a ``constraint=`` argument (int, or one int per prompt) to
+        per-row grammar ids; synthetic padding rows ride FREE (id 0)."""
+        gids = np.zeros((batch,), np.int64)
+        if constraint is not None:
+            con = np.asarray(constraint)
+            if con.ndim == 0:
+                gids[:n] = int(con)
+            elif con.shape[0] == n:
+                gids[:n] = con
+            else:
+                raise ValueError(f"constraint has {con.shape[0]} entries for {n} prompts")
+        return gids
 
     def _finish_prefill(self, n, tok0, last, cache, lengths_dev, row_valid, key, cstate=()):
         eos = self.config.eos_id
@@ -818,6 +820,7 @@ class Generator:
         *,
         num_beams: int = 4,
         length_penalty: float = 0.0,
+        constraint: Optional[Any] = None,
     ) -> np.ndarray:
         """Deterministic beam search: returns the highest-sum-log-prob continuation
         of ``max_new_tokens`` per prompt (``[n_prompts, max_new]`` int32).
@@ -831,15 +834,17 @@ class Generator:
         keeps competing with its score frozen, padding from there on. With
         ``length_penalty`` > 0 final scores are divided by
         ``((5 + len) / 6) ** length_penalty`` (GNMT convention).
+
+        ``constraint`` (an int or one per prompt, indexing ``config.constraints``)
+        runs the search inside the grammar: each beam carries its DFA state
+        (gathered alongside cache rows on reorder), candidate scores are the
+        log-probs of the CONSTRAINED policy (logits masked by the beam's
+        allowed set, then renormalized — the same distribution sampling draws
+        from), and EOS competes only at accepting states.
         """
         cfg = self.config
         if num_beams < 1:
             raise ValueError("num_beams must be >= 1")
-        if self._cs is not None:
-            raise NotImplementedError(
-                "beam_search does not compose with constrained decoding yet: beam "
-                "reordering would need to gather DFA states alongside cache rows"
-            )
         n = len(prompts)
         # pad whole GROUPS (not rows) so the batch is exactly groups * num_beams;
         # a multiple of the data axis keeps both the prefill batch (groups) and
@@ -851,16 +856,23 @@ class Generator:
         # prefill each UNIQUE prompt once (synthetic padding groups get _start's
         # row_valid masking, keeping them out of routed-expert capacity), then
         # tile every cache row to its num_beams slots — beams share the prompt
-        _, _, last, (cache, _, lengths, _, _) = self._start(prompts, 0, batch_override=groups)
+        _, _, last, carry = self._start(prompts, 0, batch_override=groups, constraint=constraint)
+        cache, lengths = carry[0], carry[2]
         tile = jnp.arange(groups * num_beams) // num_beams
         cache = jax.tree_util.tree_map(lambda c: c[tile], cache)
         last, lengths = last[tile], lengths[tile]
         done = tile >= n  # synthetic groups only
+        cstate = ()
+        if self._cs is not None:
+            # the search seeds from the PREFILL distribution (not _start's
+            # sampled tok0), so every beam starts at its grammar's START state
+            gids = self._grammar_ids(constraint, n, groups)
+            cstate = (jnp.asarray(self._cs.start_states(gids))[tile],)
         fn = self._beam_fns.get(num_beams)
         if fn is None:
             fn = self._build_beam_fn(num_beams)
             self._beam_fns[num_beams] = fn
-        out, scores, _ = fn(self.params, cache, last, lengths, done)
+        out, scores, _ = fn(self.params, cache, last, lengths, done, *cstate)
         out = np.asarray(out).reshape(groups, num_beams, -1)[:n]
         scores = np.asarray(scores).reshape(groups, num_beams)[:n]
         if cfg.eos_id is not None and length_penalty > 0.0:
@@ -874,21 +886,28 @@ class Generator:
         cfg = self.config
         eos = cfg.eos_id
         pad = jnp.int32(cfg.pad_id)
+        cs = self._cs
 
-        def beam_fn(p, cache, last, lengths, done):
+        def beam_fn(p, cache, last, lengths, done, *cstate):
             p = self._dequant_params(p)
             batch = last.shape[0]
             groups = batch // num_beams
             compute_dtype = getattr(getattr(self.module, "config", None), "dtype", jnp.bfloat16)
 
-            def logprobs(hidden):
-                return jax.nn.log_softmax(self._head_fn(p, hidden), axis=-1)
+            def logprobs(hidden, st=None):
+                logits = self._head_fn(p, hidden)
+                if st is not None:
+                    # the CONSTRAINED policy's distribution: mask, then
+                    # renormalize — the same law sampling draws from
+                    logits = jnp.where(self._cs_allowed[st], logits, -jnp.inf)
+                return jax.nn.log_softmax(logits, axis=-1)
 
+            st = cstate[0] if cs is not None else None
             # first expansion from the PREFILL distribution: all beams of a group
             # share the prompt, so its top tokens seed distinct beams. With
             # num_beams > vocab only vocab distinct seeds exist; the surplus beams
             # start at -inf and join the pool as the tree widens in later steps.
-            lp0 = logprobs(last.astype(compute_dtype)).reshape(groups, num_beams, -1)
+            lp0 = logprobs(last.astype(compute_dtype), st).reshape(groups, num_beams, -1)
             vocab = lp0.shape[-1]
             k0 = min(num_beams, vocab)
             seed_scores, seed_tokens = jax.lax.top_k(lp0[:, 0], k0)  # [G, k0]
@@ -897,16 +916,19 @@ class Generator:
             tok = jnp.where(done, pad, first_tokens.reshape(batch))
             beam_done = done | ((tok == eos) if eos is not None else jnp.zeros_like(done))
             out = jnp.full((batch, cfg.max_new_tokens), pad, jnp.int32).at[:, 0].set(tok)
+            if cs is not None:
+                st = jnp.where(done, st, self._cs_trans[st, tok])
 
             def body(carry, col):
-                cache, tok, lengths, scores, beam_done, out = carry
+                cache, tok, lengths, scores, beam_done, out, *cst = carry
                 # feed each beam's pending token (decode convention: positions =
                 # filled length; lengths advance after the feed)
                 hidden, cache = self._apply_fn(
                     p, tok[:, None], lengths[:, None], cache, (~beam_done)[:, None]
                 )
                 lengths = lengths + jnp.where(beam_done, 0, 1)
-                lp = logprobs(hidden[:, 0]).reshape(groups, num_beams, vocab)
+                lp = logprobs(hidden[:, 0], cst[0] if cs is not None else None)
+                lp = lp.reshape(groups, num_beams, vocab)
                 flat_done = beam_done.reshape(groups, num_beams)
                 # finished beams contribute exactly one frozen-score candidate
                 # (their pad continuation); active beams expand over the vocab
@@ -927,13 +949,18 @@ class Generator:
                 tok = token.reshape(batch)
                 beam_done = prev_done | ((tok == eos) if eos is not None else jnp.zeros_like(prev_done))
                 out = jax.vmap(lambda row, t: row.at[col].set(t))(out, jnp.where(prev_done, pad, tok))
-                return (cache, tok, lengths, top_scores, beam_done, out), None
+                if cs is not None:
+                    # DFA states follow their parent beams, then advance on the
+                    # freshly chosen token (pad candidates keep their state)
+                    stp = cst[0][flat_parent]
+                    cst = (jnp.where(prev_done, stp, self._cs_trans[stp, tok]),)
+                return (cache, tok, lengths, top_scores, beam_done, out, *cst), None
 
-            carry = (cache, tok, lengths, scores, beam_done, out)
+            carry = (cache, tok, lengths, scores, beam_done, out) + ((st,) if cs is not None else ())
             steps = cfg.max_new_tokens - 1
             if steps > 0:
                 carry, _ = jax.lax.scan(body, carry, jnp.arange(1, steps + 1))
-            cache, tok, lengths, scores, beam_done, out = carry
+            cache, tok, lengths, scores, beam_done, out = carry[:6]
             # the final cache rides along so the donated input can alias
             return out, scores.reshape(batch), cache
 

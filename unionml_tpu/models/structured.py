@@ -487,7 +487,9 @@ def _escape(text: str) -> str:
 #: regex fragments for flat JSON values (no nesting — nested JSON is not
 #: regular; bound the shape instead of the grammar)
 JSON_VALUE_PATTERNS = {
-    "string": r'"[^"\\]*"',
+    # control chars excluded: JSON forbids raw \n/\t/\r inside strings, and a
+    # grammar that allows them forces output json.loads rejects
+    "string": r'"[^"\\\n\t\r]*"',
     "number": r"-?(0|[1-9][0-9]*)(\.[0-9]+)?([eE][+-]?[0-9]+)?",
     "integer": r"-?(0|[1-9][0-9]*)",
     "boolean": r"(true|false)",
@@ -564,6 +566,13 @@ def vocab_from_tokenizer(tokenizer: Any) -> List[str]:
                 out.append("")
                 continue
             text = tokenizer.convert_tokens_to_string([token])
+            # sentencepiece detok strips a word-initial ▁'s space when the
+            # token is FIRST in the sequence (transformers
+            # LlamaTokenizer.convert_tokens_to_string) — but per-id extraction
+            # makes every token first, which would drop every inter-word
+            # space; re-prepend it (the same correction outlines/guidance make)
+            if token.startswith("▁") and not text.startswith(" "):
+                text = " " + text
         except Exception:
             out.append("")
             continue
