@@ -497,47 +497,113 @@ def test_constrained_beam_free_grammar_matches_unconstrained(micro_lm):
     )
 
 
-def test_draft_with_constraints_rejected(tiny, cs):
+# -------------------------------------------------- speculative composition
+
+
+def _draft_pair(tiny):
+    """A half-trained 'draft': same architecture, different init — realistic
+    imperfect agreement with the target."""
     module, params, _ = tiny
-    with pytest.raises(ValueError, match="speculative"):
-        Generator(
-            module, params,
-            GenerationConfig(
-                max_new_tokens=4, prompt_buckets=(8,), constraints=cs,
-                draft=DraftSpec(module=module, params=params),
-            ),
-        )
+    d_params = module.init(jax.random.PRNGKey(7), jnp.zeros((1, 8), jnp.int32))["params"]
+    return module, d_params
 
 
-def test_speculative_generator_rejects_constraints_directly(tiny, cs):
-    """Both SpeculativeGenerator constructors strip draft from the config,
-    which would bypass Generator.__init__'s guard — the shared init body must
-    reject a constraints-bearing config itself."""
-    from unionml_tpu.models import SpeculativeGenerator
-
+def test_speculative_constrained_greedy_equals_target_only(tiny, cs):
+    """The composition oracle: greedy speculative decoding under a grammar is
+    token-exact against the constrained PLAIN Generator — the draft can change
+    speed, never tokens, constrained or not."""
     module, params, _ = tiny
-    with pytest.raises(ValueError, match="speculative"):
-        SpeculativeGenerator(
-            module, params, module, params,
-            GenerationConfig(max_new_tokens=4, temperature=0.0, prompt_buckets=(8,), constraints=cs),
-        )
+    d_module, d_params = _draft_pair(tiny)
+    plain = Generator(
+        module, params,
+        GenerationConfig(max_new_tokens=10, temperature=0.0, eos_id=EOS,
+                         prompt_buckets=(8,), constraints=cs),
+    )
+    spec = Generator(
+        module, params,
+        GenerationConfig(
+            max_new_tokens=10, temperature=0.0, eos_id=EOS, prompt_buckets=(8,),
+            constraints=cs, draft=DraftSpec(module=d_module, params=d_params, gamma=3),
+        ),
+    )
+    prompts = [[3, 14, 15], [7, 7, 9]]
+    for gids in ([1, 2], [2, 1], [0, 1]):
+        assert np.array_equal(spec(prompts, constraint=gids), plain(prompts, constraint=gids)), gids
 
 
-def test_draft_path_rejects_constraint_argument(tiny):
-    """A structured-output request must never be silently dropped on the
-    speculative early-return in __call__/stream."""
+def test_speculative_constrained_sampled_satisfies_grammar(tiny, cs):
+    module, params, _ = tiny
+    d_module, d_params = _draft_pair(tiny)
+    spec = Generator(
+        module, params,
+        GenerationConfig(
+            max_new_tokens=12, temperature=1.0, eos_id=EOS, prompt_buckets=(8,),
+            constraints=cs, draft=DraftSpec(module=d_module, params=d_params, gamma=3),
+        ),
+    )
+    for seed in range(3):
+        text = decode_text(spec([[2, 3]], seed=seed, constraint=1)[0])
+        assert re.fullmatch(r"[a-c]{3,5}", text) or (
+            len(text) < 3 and all(ch in "abc" for ch in text)
+        ), (seed, text)
+
+
+def test_speculative_constrained_stream_matches_call(tiny, cs):
+    """The draft path's stream() must thread constraint= too: per-row ragged
+    chunks concatenate to exactly __call__'s emitted tokens."""
+    module, params, _ = tiny
+    d_module, d_params = _draft_pair(tiny)
+    spec = Generator(
+        module, params,
+        GenerationConfig(
+            max_new_tokens=9, temperature=0.0, eos_id=EOS, prompt_buckets=(8,),
+            constraints=cs, draft=DraftSpec(module=d_module, params=d_params, gamma=3),
+        ),
+    )
+    prompts = [[3, 14, 15], [7, 9]]
+    ref = spec(prompts, constraint=[1, 2])
+    rows = [[] for _ in prompts]
+    for chunk in spec.stream(prompts, chunk_size=3, constraint=[1, 2]):
+        for i, arr in enumerate(chunk):
+            rows[i].extend(int(t) for t in arr)
+    for i, got in enumerate(rows):
+        assert got == ref[i, : len(got)].tolist(), i
+        # stream stops at eos; __call__ pads the remainder
+        assert all(int(t) == 0 for t in ref[i, len(got) :]), i
+
+
+def test_speculative_constrained_composes_with_prefix(tiny, cs):
+    """The full matrix cell: draft x grammar x shared system prompt."""
+    module, params, _ = tiny
+    d_module, d_params = _draft_pair(tiny)
+    spec = Generator(
+        module, params,
+        GenerationConfig(
+            max_new_tokens=6, temperature=0.0, eos_id=EOS, prompt_buckets=(8,),
+            constraints=cs, draft=DraftSpec(module=d_module, params=d_params, gamma=2),
+        ),
+    )
+    prefix = spec.cache_prefix([11, 12, 13])
+    out = spec([[3, 14]], prefix=prefix, constraint=1)
+    full = spec([[11, 12, 13, 3, 14]], constraint=1)
+    assert np.array_equal(out, full)
+
+
+def test_continuous_rejects_speculative_with_constraints(tiny, cs):
+    """The batcher's spec carry doesn't thread per-slot DFA state yet — the
+    combo must fail loudly at construction, not decode unconstrained."""
+    from unionml_tpu.serving import ContinuousBatcher
+
     module, params, _ = tiny
     gen = Generator(
         module, params,
         GenerationConfig(
-            max_new_tokens=4, temperature=0.0, prompt_buckets=(8,),
-            draft=DraftSpec(module=module, params=params),
+            max_new_tokens=4, temperature=0.0, eos_id=EOS, prompt_buckets=(8,),
+            constraints=cs, draft=DraftSpec(module=module, params=params),
         ),
     )
-    with pytest.raises(ValueError, match="constraint= does not compose"):
-        gen([[1, 2]], constraint=1)
-    with pytest.raises(ValueError, match="constraint= does not compose"):
-        next(iter(gen.stream([[1, 2]], constraint=1)))
+    with pytest.raises(ValueError, match="speculative decoding with"):
+        ContinuousBatcher(gen, slots=1)
 
 
 # ------------------------------------------------------------------ continuous

@@ -340,14 +340,10 @@ class Generator:
 
         cs = config.constraints
         if cs is not None:
-            if config.draft is not None:
-                raise ValueError(
-                    "constraints do not compose with speculative decoding yet: the "
-                    "draft's proposals would need the same per-row DFA masking to "
-                    "keep the verify law exact"
-                )
             # the tables ride to the device once; inside the jitted step the
-            # constraint is two gathers and a where (see models/structured.py)
+            # constraint is two gathers and a where (see models/structured.py).
+            # With config.draft also set, the speculative engine threads the
+            # same per-row DFA state along the draft path (speculative.py).
             self._cs_trans = jnp.asarray(cs.trans)
             self._cs_allowed = jnp.asarray(cs.allowed)
         self._cs = cs
@@ -801,11 +797,7 @@ class Generator:
         (an int, or one int per prompt, indexing ``config.constraints``; 0 = the
         FREE grammar) masks each row's decoding by its grammar's token DFA."""
         if self.config.draft is not None:
-            if constraint is not None:
-                # must not silently drop a structured-output request: the
-                # speculative path has no DFA masking (see __init__'s guard)
-                raise ValueError("constraint= does not compose with speculative decoding yet")
-            return self._speculative()(prompts, seed=seed, prefix=prefix)
+            return self._speculative()(prompts, seed=seed, prefix=prefix, constraint=constraint)
         n, tok0, _, carry = self._start(prompts, seed, prefix=prefix, constraint=constraint)
         steps = self.config.max_new_tokens - 1
         first = np.asarray(tok0)[:, None]
@@ -987,10 +979,8 @@ class Generator:
         if chunk_size < 1:
             raise ValueError("chunk_size must be >= 1")
         if cfg.draft is not None:
-            if constraint is not None:
-                raise ValueError("constraint= does not compose with speculative decoding yet")
             yield from self._speculative().stream(
-                prompts, seed=seed, chunk_size=chunk_size, prefix=prefix
+                prompts, seed=seed, chunk_size=chunk_size, prefix=prefix, constraint=constraint
             )
             return
         # the last chunk may overshoot max_new_tokens; give its cache writes room

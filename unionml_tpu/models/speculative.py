@@ -107,16 +107,6 @@ class SpeculativeGenerator:
         cannot drift."""
         if gamma < 1:
             raise ValueError("gamma must be >= 1")
-        if config.constraints is not None:
-            # the same contract Generator.__init__ enforces for draft=; checked
-            # here too because both constructors strip draft from the config,
-            # which would otherwise bypass that guard and crash later on the
-            # constrained carry layout
-            raise ValueError(
-                "constraints do not compose with speculative decoding yet: the "
-                "draft's proposals would need the same per-row DFA masking to "
-                "keep the verify law exact"
-            )
         self.config = config
         self.gamma = int(gamma)
         self.rounds = 0
@@ -173,27 +163,48 @@ class SpeculativeGenerator:
         from unionml_tpu.models.generate import filtered_logits, policy_probs
 
         greedy_mode = cfg.temperature == 0.0
+        cs = cfg.constraints
+        if cs is not None:
+            # the same tables the target Generator placed on device: both
+            # models' policies mask by the DFA state along the PROPOSED path,
+            # so q and p are the constrained distributions and the rejection
+            # law stays exact (q's support is within p's allowed set)
+            cs_trans, cs_allowed = target._cs_trans, target._cs_allowed
 
-        def spec_round(tp, dp, t_cache, d_cache, tok, lengths, done, produced, out_buf, key, budget):
+        def spec_round(tp, dp, t_cache, d_cache, tok, lengths, done, produced, out_buf, key, budget, *st):
             key, draft_key, corr_key = jax.random.split(key, 3)
             accept_keys = jax.random.split(draft_key, gamma + 1)
 
             # --- draft: gamma policy-sampled steps (small-model cached decode) ---
             def draft_body(carry, step_key):
-                cache, t, ln = carry
+                cache, t, ln, *s = carry
                 logits, cache = draft_apply(dp, t[:, None], ln[:, None], cache)
                 lg = logits[:, 0]
+                if cs is not None:
+                    lg = jnp.where(cs_allowed[s[0]], lg, -jnp.inf)
                 if greedy_mode:
                     nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
                 else:
                     nxt = jax.random.categorical(step_key, filtered_logits(lg, cfg)).astype(jnp.int32)
-                return (cache, nxt, ln + 1), (nxt, lg)
+                s_out = (s[0],) if cs is not None else ()
+                s_next = (cs_trans[s[0], nxt],) if cs is not None else ()
+                # emit the MASKED logits (q must be the constrained proposal
+                # distribution) and the state BEFORE this position
+                return (cache, nxt, ln + 1, *s_next), (nxt, lg, *s_out)
 
-            (d_cache, _, _), (drafts, draft_logits) = jax.lax.scan(
-                draft_body, (d_cache, tok, lengths), jax.random.split(accept_keys[gamma], gamma)
+            carry_out, scanned = jax.lax.scan(
+                draft_body, (d_cache, tok, lengths, *st), jax.random.split(accept_keys[gamma], gamma)
             )
+            d_cache = carry_out[0]
+            drafts, draft_logits = scanned[0], scanned[1]
             drafts = drafts.T  # [B, gamma]
             draft_logits = jnp.swapaxes(draft_logits, 0, 1)  # [B, gamma, V]
+            if cs is not None:
+                # states along the proposed path: st_ext[:, i] = state BEFORE
+                # position i, for i in [0, gamma] (the bonus position included)
+                st_ext = jnp.concatenate(
+                    [jnp.swapaxes(scanned[2], 0, 1), carry_out[3][:, None]], axis=1
+                )
 
             # --- draft-cache completeness: the scan fed [tok, drafts[:gamma-1]],
             # so drafts[gamma-1]'s K/V slot is never written; on an all-accept
@@ -213,6 +224,10 @@ class SpeculativeGenerator:
             # over the full [B, gamma+1] verify width
             verify_mask = jnp.broadcast_to((~done)[:, None], inputs.shape)
             logits, t_cache = target_apply(tp, inputs, positions, t_cache, verify_mask)
+            if cs is not None:
+                # target logits at position i masked by the state its row
+                # reached after drafts[:i] — p becomes the constrained policy
+                logits = jnp.where(cs_allowed[st_ext], logits, -jnp.inf)
 
             # --- rejection sampling against the policy distributions ---
             # (greedy is the one-hot special case: accept iff argmaxes agree, the
@@ -278,7 +293,17 @@ class SpeculativeGenerator:
             lengths = lengths + jnp.where(done, 0, n_emit)
             produced = produced + n_emit
             acc_count = jnp.where(done, 0, jnp.minimum(accepted, room)).sum()
-            return t_cache, d_cache, tok, lengths, new_done, produced, out_buf, acc_count, key
+            st_next = ()
+            if cs is not None:
+                # the next round's DFA state: advance past the LAST emitted
+                # token. Emitted tokens are a prefix of the proposed path
+                # (drafts[:accepted] then the correction), so the state before
+                # position j is st_ext[:, j] regardless of eos/budget clipping.
+                j = jnp.maximum(n_emit - 1, 0)
+                st_before = jnp.take_along_axis(st_ext, j[:, None], axis=1)[:, 0]
+                last_tok = jnp.take_along_axis(emitted, j[:, None], axis=1)[:, 0]
+                st_next = (jnp.where(n_emit > 0, cs_trans[st_before, last_tok], st[0]),)
+            return t_cache, d_cache, tok, lengths, new_done, produced, out_buf, acc_count, key, *st_next
 
         def spec_loop(tp, dp, state, floor, budget):
             """Post-prefill generation as ONE device-side while_loop — per-round
@@ -297,11 +322,11 @@ class SpeculativeGenerator:
                 return jnp.any(~done_rows & (produced_rows < floor))
 
             def body(state):
-                t_cache, d_cache, tok, lengths, done, produced, out_buf, rounds, acc_total, key = state
-                t_cache, d_cache, tok, lengths, done, produced, out_buf, acc, key = spec_round(
-                    tp, dp, t_cache, d_cache, tok, lengths, done, produced, out_buf, key, budget
+                t_cache, d_cache, tok, lengths, done, produced, out_buf, rounds, acc_total, key, *st = state
+                t_cache, d_cache, tok, lengths, done, produced, out_buf, acc, key, *st = spec_round(
+                    tp, dp, t_cache, d_cache, tok, lengths, done, produced, out_buf, key, budget, *st
                 )
-                return (t_cache, d_cache, tok, lengths, done, produced, out_buf, rounds + 1, acc_total + acc, key)
+                return (t_cache, d_cache, tok, lengths, done, produced, out_buf, rounds + 1, acc_total + acc, key, *st)
 
             return jax.lax.while_loop(cond, body, state)
 
@@ -334,37 +359,47 @@ class SpeculativeGenerator:
         return built
 
     def _start_state(
-        self, prompts: Sequence[Sequence[int]], seed: int, prefix: Optional[PrefixCache] = None
+        self,
+        prompts: Sequence[Sequence[int]],
+        seed: int,
+        prefix: Optional[PrefixCache] = None,
+        constraint: Optional[Any] = None,
     ):
         """Prefill both models and assemble the device-side loop state:
         ``(t_cache, d_cache, tok, lengths, done, produced, out_buf, rounds,
-        accepted, key)``. With ``prefix``, both models get their own prefix rows
-        pasted and prefill only the suffix at a ``p0`` offset — lengths then
-        include the prefix, so the round loop needs no changes."""
+        accepted, key[, dfa_state])``. With ``prefix``, both models get their own
+        prefix rows pasted and prefill only the suffix at a ``p0`` offset —
+        lengths then include the prefix, so the round loop needs no changes.
+        With constraints, the target's post-tok0 DFA state rides as the state's
+        tail element."""
         cfg = self.config
         if self._round_fn is None:
             self._round_fn = self._build_round()
         # prefill both models; extra cache headroom for the last round's overshoot
-        n, tok0_t, _, (t_cache, _, lengths, done_t, _) = self._target._start(
-            prompts, seed, extra_cache=self.gamma + 1, prefix=prefix
+        n, tok0_t, _, t_carry = self._target._start(
+            prompts, seed, extra_cache=self.gamma + 1, prefix=prefix, constraint=constraint
         )
-        _, _, _, (d_cache, _, d_lengths, _, _) = self._draft._start(
+        t_cache, lengths, done_t = t_carry[0], t_carry[2], t_carry[3]
+        _, _, _, d_carry = self._draft._start(
             prompts, seed, extra_cache=self.gamma + 1,
             prefix=self.draft_prefix(prefix) if prefix is not None else None,
+            constraint=constraint,
         )
-        del d_lengths  # same values as lengths (same prompts, same prefix length)
+        d_cache = d_carry[0]  # d_carry's lengths equal `lengths` (same prompts/prefix)
 
         batch = int(tok0_t.shape[0])
         cap = cfg.max_new_tokens + self.gamma + 1
         out_buf = jnp.full((batch, cap), cfg.pad_id, jnp.int32)
-        # the prompt-sampled token is emission #1 (same as Generator's tok0)
+        # the prompt-sampled token is emission #1 (same as Generator's tok0;
+        # with constraints the target's _start already masked it)
         out_buf = out_buf.at[:, 0].set(tok0_t)
         produced = jnp.ones((batch,), jnp.int32)
         done = done_t | (produced >= cfg.max_new_tokens)
         key = jax.random.fold_in(jax.random.PRNGKey(seed), 1)
+        st = (t_carry[5],) if cfg.constraints is not None else ()
         return n, (
             t_cache, d_cache, tok0_t, lengths, done, produced, out_buf,
-            jnp.int32(0), jnp.int32(0), key,
+            jnp.int32(0), jnp.int32(0), key, *st,
         )
 
     def __call__(
@@ -373,13 +408,17 @@ class SpeculativeGenerator:
         *,
         seed: int = 0,
         prefix: Optional[PrefixCache] = None,
+        constraint: Optional[Any] = None,
     ) -> np.ndarray:
         """Generate under the config's decoding policy; greedy output is exactly
         the target-only sequence, sampled output is target-distributed. With
         ``prefix`` (from the target's ``cache_prefix``), prompts are suffixes
-        after the shared prefix in BOTH models."""
+        after the shared prefix in BOTH models. ``constraint`` (grammar ids into
+        ``config.constraints``) masks both the draft's proposals and the
+        target's verify by each row's DFA state — same output law as the
+        constrained plain Generator."""
         cfg = self.config
-        n, state = self._start_state(prompts, seed, prefix=prefix)
+        n, state = self._start_state(prompts, seed, prefix=prefix, constraint=constraint)
         budget = jnp.full(state[2].shape, cfg.max_new_tokens, jnp.int32)
         state = self._round_fn(self._target.params, self._draft.params, state, budget, budget)
         out_buf, rounds, accepted = state[6], state[7], state[8]
@@ -394,6 +433,7 @@ class SpeculativeGenerator:
         seed: int = 0,
         chunk_size: int = 16,
         prefix: Optional[PrefixCache] = None,
+        constraint: Optional[Any] = None,
     ):
         """Incremental speculative generation: yields a LIST of ``len(prompts)``
         1-D int32 arrays of newly materialized tokens per row (the first yield is
@@ -406,7 +446,7 @@ class SpeculativeGenerator:
         cfg = self.config
         if chunk_size < 1:
             raise ValueError("chunk_size must be >= 1")
-        n, state = self._start_state(prompts, seed, prefix=prefix)
+        n, state = self._start_state(prompts, seed, prefix=prefix, constraint=constraint)
         prev = np.ones((n,), np.int64)
         first = np.asarray(state[6][:n, :1])  # one fetch, not one per row
         yield [first[i] for i in range(n)]

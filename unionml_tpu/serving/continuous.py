@@ -190,6 +190,14 @@ class ContinuousBatcher:
         #: drives the SpeculativeGenerator's batch round loop (per-row floors
         #: and budgets), so concurrent streams share draft+verify dispatches
         #: and each greedy stream still equals its solo target-only run
+        if cfg.draft is not None and generator._cs is not None:
+            # the solo SpeculativeGenerator composes with constraints, but the
+            # batcher's spec carry/admit impls don't thread per-slot DFA state
+            # through the round loop yet
+            raise ValueError(
+                "continuous batching does not compose speculative decoding with "
+                "constraints yet; drop GenerationConfig.constraints or draft"
+            )
         self._spec = generator._speculative() if cfg.draft is not None else None
         if prefix is not None and not isinstance(prefix, PrefixCache):
             raise TypeError(f"prefix must be a PrefixCache (from generator.cache_prefix), got {type(prefix).__name__}")
