@@ -589,21 +589,33 @@ def test_speculative_constrained_composes_with_prefix(tiny, cs):
     assert np.array_equal(out, full)
 
 
-def test_continuous_rejects_speculative_with_constraints(tiny, cs):
-    """The batcher's spec carry doesn't thread per-slot DFA state yet — the
-    combo must fail loudly at construction, not decode unconstrained."""
+@pytest.mark.parametrize("paged", [False, True], ids=["dense", "paged"])
+def test_continuous_speculative_constrained_matches_solo(tiny, cs, paged):
+    """The last matrix cell: concurrent speculative streams with per-request
+    grammars through the shared batcher equal their solo constrained runs."""
     from unionml_tpu.serving import ContinuousBatcher
 
     module, params, _ = tiny
+    d_module, d_params = _draft_pair(tiny)
     gen = Generator(
         module, params,
         GenerationConfig(
-            max_new_tokens=4, temperature=0.0, eos_id=EOS, prompt_buckets=(8,),
-            constraints=cs, draft=DraftSpec(module=module, params=params),
+            max_new_tokens=8, temperature=0.0, eos_id=EOS, prompt_buckets=(8,),
+            constraints=cs, draft=DraftSpec(module=d_module, params=d_params, gamma=3),
         ),
     )
-    with pytest.raises(ValueError, match="speculative decoding with"):
-        ContinuousBatcher(gen, slots=1)
+    prompts = [[3, 14, 15], [7, 7, 9], [1, 2]]
+    gids = [1, 2, 0]
+    solo = [_solo_until_eos(gen, p, g) for p, g in zip(prompts, gids)]
+    batcher = ContinuousBatcher(
+        gen, slots=2, decode_chunk=2, **(dict(block_size=4) if paged else {})
+    )
+    try:
+        streams = [batcher.submit(p, constraint=g) for p, g in zip(prompts, gids)]
+        for got_stream, ref in zip(streams, solo):
+            assert _collect(got_stream) == ref
+    finally:
+        batcher.close()
 
 
 # ------------------------------------------------------------------ continuous
@@ -672,6 +684,30 @@ def test_continuous_constraint_survives_preemption(tiny, cs):
             assert _collect(got_stream) == ref
     finally:
         batcher.close()
+
+
+def test_continuous_engine_death_mid_admission_errors_the_stream(tiny):
+    """A session popped from pending but not yet resident is reachable by
+    NEITHER of the engine's death handlers — an engine-fatal crash during its
+    admission must error its stream, not strand its consumer forever (found
+    live: a constrained-draft prefill crash hung the submitting thread)."""
+    from unionml_tpu.serving import ContinuousBatcher
+
+    module, params, _ = tiny
+    gen = Generator(
+        module, params,
+        GenerationConfig(max_new_tokens=4, temperature=0.0, eos_id=EOS, prompt_buckets=(8,)),
+    )
+    batcher = ContinuousBatcher(gen, slots=1)
+
+    def boom(*a, **k):
+        raise RuntimeError("injected engine-fatal admission failure")
+
+    batcher._prefill_row = boom
+    stream = batcher.submit([1, 2])
+    with pytest.raises(RuntimeError, match="injected"):
+        next(iter(stream))
+    batcher.close()
 
 
 def test_continuous_rejects_constraint_without_set(tiny):

@@ -190,14 +190,6 @@ class ContinuousBatcher:
         #: drives the SpeculativeGenerator's batch round loop (per-row floors
         #: and budgets), so concurrent streams share draft+verify dispatches
         #: and each greedy stream still equals its solo target-only run
-        if cfg.draft is not None and generator._cs is not None:
-            # the solo SpeculativeGenerator composes with constraints, but the
-            # batcher's spec carry/admit impls don't thread per-slot DFA state
-            # through the round loop yet
-            raise ValueError(
-                "continuous batching does not compose speculative decoding with "
-                "constraints yet; drop GenerationConfig.constraints or draft"
-            )
         self._spec = generator._speculative() if cfg.draft is not None else None
         if prefix is not None and not isinstance(prefix, PrefixCache):
             raise TypeError(f"prefix must be a PrefixCache (from generator.cache_prefix), got {type(prefix).__name__}")
@@ -460,9 +452,12 @@ class ContinuousBatcher:
         cap = cfg.max_new_tokens + self._spec.gamma + 1
         out_buf = jnp.full((self.slots, cap), cfg.pad_id, jnp.int32)
         produced = jnp.zeros((self.slots,), jnp.int32)
-        # spec-loop state layout (speculative.py): rounds/accepted counters ride along
+        # spec-loop state layout (speculative.py): rounds/accepted counters ride
+        # along; with constraints the per-slot DFA state is the tail element
+        # (same convention as the plain carry — existing indices unchanged)
+        st = (jnp.zeros((self.slots,), jnp.int32),) if self.gen._cs is not None else ()
         return (cache, d_cache, tok, lengths, done, produced, out_buf,
-                jnp.int32(0), jnp.int32(0), key)
+                jnp.int32(0), jnp.int32(0), key, *st)
 
     def _prefill_row(
         self,
@@ -844,11 +839,56 @@ class ContinuousBatcher:
                     # the draft's cache row: same prompt through the draft model
                     # with the DRAFT's prefix rows (its prompt-sampled token is
                     # discarded — emission #1 is the target's, exactly as in
-                    # SpeculativeGenerator._start_state)
+                    # SpeculativeGenerator._start_state). dfa_state rides along:
+                    # the draft Generator shares the constraints config, so its
+                    # prefill closure requires the state argument too
                     _, _, d_row = self._prefill_row(
                         prompt, seed, gen=self._spec._draft, prefix=self._draft_prefix,
-                        budget=remaining,
+                        budget=remaining, dfa_state=dfa_state,
                     )
+                if self._carry is None:
+                    self._carry = self._init_carry()
+                first = np.asarray(tok0)
+                hit_eos = cfg.eos_id is not None and int(first[0]) == cfg.eos_id
+                # produced carries across preemptions; this residency adds one token
+                start_done = hit_eos or session.produced + 1 >= session.max_new
+                if self._spec is None:
+                    cache, tok, lengths, done, key, *cst = self._carry
+                    if blocks_row is not None:
+                        cache, tok, lengths, done = self._paged_admit_fn(
+                            cache, row_cache, tok, lengths, done, jnp.int32(slot), tok0, row_len,
+                            jnp.asarray(blocks_row), len(self._shared_prefix_blocks),
+                        )
+                    else:
+                        cache, tok, lengths, done = self._admit_fn(
+                            cache, row_cache, tok, lengths, done, jnp.int32(slot), tok0, row_len
+                        )
+                    self._carry = (cache, tok, lengths, done, key, *cst)
+                else:
+                    t_cache, d_cache, tok, lengths, done, produced, out_buf, rounds, acc, key, *cst = self._carry
+                    if blocks_row is not None:
+                        t_cache, d_cache, out_buf, tok, lengths, done, produced = self._paged_spec_admit_fn(
+                            t_cache, d_cache, out_buf, row_cache, d_row, tok, lengths, done, produced,
+                            jnp.int32(slot), tok0, row_len, jnp.asarray([start_done]),
+                            jnp.int32(cfg.pad_id), jnp.asarray(blocks_row),
+                            len(self._shared_prefix_blocks),
+                        )
+                    else:
+                        t_cache, d_cache, out_buf, tok, lengths, done, produced = self._spec_admit_fn(
+                            t_cache, d_cache, out_buf, row_cache, d_row, tok, lengths, done, produced,
+                            jnp.int32(slot), tok0, row_len, jnp.asarray([start_done]),
+                            jnp.int32(cfg.pad_id),
+                        )
+                    self._carry = (t_cache, d_cache, tok, lengths, done, produced, out_buf, rounds, acc, key, *cst)
+                if dfa_state is not None:
+                    # advance past the (constrained) prompt-sampled token and
+                    # activate the slot's DFA state — the carry TAIL in both the
+                    # plain and speculative layouts (one copy of the rule)
+                    state = list(self._carry)
+                    state[-1] = state[-1].at[slot].set(
+                        int(self.gen._cs.trans[dfa_state, int(first[0])])
+                    )
+                    self._carry = tuple(state)
             except ValueError as exc:
                 # a bad prompt (e.g. longer than the cache can hold) fails its
                 # own stream; the engine and other residents keep going. The
@@ -862,45 +902,17 @@ class ContinuousBatcher:
                         session.finished = True
                         session.out.put(exc)
                 continue
-            if self._carry is None:
-                self._carry = self._init_carry()
-            first = np.asarray(tok0)
-            hit_eos = cfg.eos_id is not None and int(first[0]) == cfg.eos_id
-            # produced carries across preemptions; this residency adds one token
-            start_done = hit_eos or session.produced + 1 >= session.max_new
-            if self._spec is None:
-                cache, tok, lengths, done, key, *cst = self._carry
-                if blocks_row is not None:
-                    cache, tok, lengths, done = self._paged_admit_fn(
-                        cache, row_cache, tok, lengths, done, jnp.int32(slot), tok0, row_len,
-                        jnp.asarray(blocks_row), len(self._shared_prefix_blocks),
-                    )
-                else:
-                    cache, tok, lengths, done = self._admit_fn(
-                        cache, row_cache, tok, lengths, done, jnp.int32(slot), tok0, row_len
-                    )
-                if dfa_state is not None:
-                    # advance past the (constrained) prompt-sampled token and
-                    # activate the slot's DFA state in the carry tail
-                    nxt_state = int(self.gen._cs.trans[dfa_state, int(first[0])])
-                    cst = [cst[0].at[slot].set(nxt_state)]
-                self._carry = (cache, tok, lengths, done, key, *cst)
-            else:
-                t_cache, d_cache, tok, lengths, done, produced, out_buf, rounds, acc, key = self._carry
-                if blocks_row is not None:
-                    t_cache, d_cache, out_buf, tok, lengths, done, produced = self._paged_spec_admit_fn(
-                        t_cache, d_cache, out_buf, row_cache, d_row, tok, lengths, done, produced,
-                        jnp.int32(slot), tok0, row_len, jnp.asarray([start_done]),
-                        jnp.int32(cfg.pad_id), jnp.asarray(blocks_row),
-                        len(self._shared_prefix_blocks),
-                    )
-                else:
-                    t_cache, d_cache, out_buf, tok, lengths, done, produced = self._spec_admit_fn(
-                        t_cache, d_cache, out_buf, row_cache, d_row, tok, lengths, done, produced,
-                        jnp.int32(slot), tok0, row_len, jnp.asarray([start_done]),
-                        jnp.int32(cfg.pad_id),
-                    )
-                self._carry = (t_cache, d_cache, tok, lengths, done, produced, out_buf, rounds, acc, key)
+            except BaseException as exc:
+                # engine-fatal failure mid-admission (prefill, carry init, or
+                # the admit dispatch): this session is in NEITHER _pending NOR
+                # _sessions (popped above, not yet registered), so
+                # _engine_loop's death handler cannot reach its queue — notify
+                # it here or its consumer blocks forever, then let the engine die
+                with self._lock:
+                    if not session.finished:
+                        session.finished = True
+                        session.out.put(exc)
+                raise
             with self._lock:
                 if session.finished:
                     # cancelled during the unlocked prefill window (neither
