@@ -28,6 +28,18 @@ def _tiny():
     return module, params
 
 
+def _letters_cs(pattern):
+    """a-z char vocab over the tiny model's 96 ids (last id = eos) + one
+    compiled grammar — shared by the constraint-composition tests."""
+    from unionml_tpu.models import ConstraintSet, compile_regex
+
+    texts = [""] * 96
+    for i in range(26):
+        texts[1 + i] = chr(ord("a") + i)
+    eos = 95
+    return ConstraintSet([compile_regex(pattern, texts, eos_id=eos)]), eos
+
+
 @pytest.mark.parametrize("spec", [dict(data=4, model=2), dict(model=4), dict(data=4, fsdp=2)])
 def test_sharded_generation_matches_unsharded(spec):
     module, params = _tiny()
@@ -171,14 +183,8 @@ def test_sharded_constrained_generation_matches_unsharded():
     """Constraints x TP: the DFA tables replicate over the mesh (tiny int32/bool
     arrays), the per-row state rides the sharded decode carry, and tokens equal
     the unsharded constrained run — grammar masking adds no sharding hazards."""
-    from unionml_tpu.models import ConstraintSet, compile_regex
-
     module, params = _tiny()
-    texts = [""] * 96
-    for i in range(26):
-        texts[1 + i] = chr(ord("a") + i)
-    eos = 95
-    cs = ConstraintSet([compile_regex(r"[a-c]{2,6}", texts, eos_id=eos)])
+    cs, eos = _letters_cs(r"[a-c]{2,6}")
     cfg = GenerationConfig(
         max_new_tokens=8, temperature=0.0, eos_id=eos, prompt_buckets=(16,), constraints=cs
     )
@@ -189,3 +195,23 @@ def test_sharded_constrained_generation_matches_unsharded():
     mesh = MeshSpec(data=4, model=2).build()
     sharded = Generator(module, params, cfg, mesh=mesh, partition_rules=llama_partition_rules())
     np.testing.assert_array_equal(sharded(prompts, constraint=gids), expected)
+
+
+def test_sequence_parallel_prefill_composes_with_constraints():
+    """Long-context x grammar: the constrained first token is sampled inside
+    the sequence-parallel prefill (the cstate tail threads through sp_prefill),
+    and decode continues masking — tokens equal the plain constrained engine."""
+    module, params = _tiny()
+    cs, eos = _letters_cs(r"[a-c]{2,6}")
+    base = GenerationConfig(
+        max_new_tokens=6, temperature=0.0, eos_id=eos, prompt_buckets=(16,), constraints=cs
+    )
+    prompts = [[3, 1, 4, 1, 5, 9, 2, 6], [7, 1, 8], [2, 8, 1, 8], [4, 6]]
+    gids = [1, 0, 1, 0]
+
+    plain = Generator(module, params, base)(prompts, constraint=gids)
+    import dataclasses
+
+    mesh = MeshSpec(data=2, sequence=4).build()
+    sp = Generator(module, params, dataclasses.replace(base, sp_prefill="ring"), mesh=mesh)
+    np.testing.assert_array_equal(sp(prompts, constraint=gids), plain)

@@ -390,6 +390,25 @@ def test_prefix_cache_composes_with_constraint(tiny, cs):
     assert np.array_equal(out, full)
 
 
+def test_int8_quantized_generation_composes_with_constraints(tiny, cs):
+    """Weight-only int8 x grammar: the mask applies to logits after the
+    dequant-fused forward, so quantized constrained outputs still satisfy the
+    grammar (exact token equality with bf16 is not expected — quantization
+    legitimately perturbs logits)."""
+    module, params, _ = tiny
+    gen = Generator(
+        module, params,
+        GenerationConfig(max_new_tokens=10, temperature=0.0, eos_id=EOS,
+                         prompt_buckets=(8,), constraints=cs),
+        quantize="int8",
+    )
+    text = decode_text(gen([[3, 14, 15]], constraint=1)[0])
+    # binding: the DFA forbids eos before 3 chars and forces it by 5, and the
+    # 10-token budget always covers 5 single-char tokens — a correct run MUST
+    # full-match (a prefix fallback would also accept an early-eos mask bug)
+    assert re.fullmatch(r"[a-c]{3,5}", text), text
+
+
 def test_constraint_without_set_raises(tiny):
     module, params, _ = tiny
     gen = Generator(module, params, GenerationConfig(max_new_tokens=4, prompt_buckets=(8,)))

@@ -846,6 +846,32 @@ class ContinuousBatcher:
                         prompt, seed, gen=self._spec._draft, prefix=self._draft_prefix,
                         budget=remaining, dfa_state=dfa_state,
                     )
+            except ValueError as exc:
+                # a bad prompt (e.g. longer than the cache can hold) fails its
+                # own stream; the engine and other residents keep going —
+                # _prefill_row builds only a fresh [1, ...] row and never
+                # touches the shared carry, so continuing is safe. The finished
+                # flip + enqueue happen under the lock, mirroring _cancel's
+                # guarded pattern — otherwise a concurrent _cancel could
+                # interleave its sentinel before (or instead of) the error
+                with self._lock:
+                    self._free.append(slot)
+                    self._release_blocks_locked(slot)
+                    if not session.finished:
+                        session.finished = True
+                        session.out.put(exc)
+                continue
+            except BaseException as exc:
+                # engine-fatal: this session is in NEITHER _pending NOR
+                # _sessions (popped above, not yet registered), so
+                # _engine_loop's death handler cannot reach its queue — notify
+                # it here or its consumer blocks forever, then let the engine die
+                with self._lock:
+                    if not session.finished:
+                        session.finished = True
+                        session.out.put(exc)
+                raise
+            try:
                 if self._carry is None:
                     self._carry = self._init_carry()
                 first = np.asarray(tok0)
@@ -889,25 +915,15 @@ class ContinuousBatcher:
                         int(self.gen._cs.trans[dfa_state, int(first[0])])
                     )
                     self._carry = tuple(state)
-            except ValueError as exc:
-                # a bad prompt (e.g. longer than the cache can hold) fails its
-                # own stream; the engine and other residents keep going. The
-                # finished flip + enqueue happen under the lock, mirroring
-                # _cancel's guarded pattern — otherwise a concurrent _cancel
-                # could interleave its sentinel before (or instead of) the error
-                with self._lock:
-                    self._free.append(slot)
-                    self._release_blocks_locked(slot)
-                    if not session.finished:
-                        session.finished = True
-                        session.out.put(exc)
-                continue
             except BaseException as exc:
-                # engine-fatal failure mid-admission (prefill, carry init, or
-                # the admit dispatch): this session is in NEITHER _pending NOR
-                # _sessions (popped above, not yet registered), so
-                # _engine_loop's death handler cannot reach its queue — notify
-                # it here or its consumer blocks forever, then let the engine die
+                # ANY failure here — carry init or the donating admit
+                # dispatches — is engine-fatal: donation may already have
+                # invalidated the carry's buffers, so treating it as a
+                # per-request failure would leave the engine decoding deleted
+                # arrays (or, past the carry reassignment, a freed slot's
+                # ride-along writes corrupting reallocated pages). Notify the
+                # in-flight session (reachable by neither death handler), then
+                # let the engine die.
                 with self._lock:
                     if not session.finished:
                         session.finished = True
