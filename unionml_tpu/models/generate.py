@@ -340,21 +340,25 @@ class Generator:
 
         cs = config.constraints
         if cs is not None:
-            # the tables ride to the device once; inside the jitted step the
-            # constraint is two gathers and a where (see models/structured.py).
-            # With config.draft also set, the speculative engine threads the
-            # same per-row DFA state along the draft path (speculative.py).
-            self._cs_trans = jnp.asarray(cs.trans)
-            self._cs_allowed = jnp.asarray(cs.allowed)
+            # the tables ride to the device once and are MEMOIZED on the set —
+            # plain/target/draft engines over one ConstraintSet share a single
+            # copy; inside the jitted step the constraint is two gathers and a
+            # where (see models/structured.py). With config.draft also set, the
+            # speculative engine threads the same per-row DFA state along the
+            # draft path (speculative.py).
+            self._cs_trans, self._cs_allowed = cs.device_tables()
         self._cs = cs
 
         def constrain(logits: jax.Array, cstate: tuple) -> jax.Array:
-            """Mask ``[B, V]`` logits by each row's DFA state (``cstate`` is the
-            variadic tail — empty when the generator is unconstrained, so every
-            unconstrained signature and carry layout stays exactly as before)."""
+            """Mask ``[..., V]`` logits by each row's DFA state (``cstate`` is
+            the variadic tail — empty when the generator is unconstrained, so
+            every unconstrained signature and carry layout stays exactly as
+            before)."""
             if cs is None:
                 return logits
             return jnp.where(self._cs_allowed[cstate[0]], logits, -jnp.inf)
+
+        self._constrain = constrain  # shared by sp_prefill and beam search
 
         def apply(p: Any, tokens: jax.Array, positions: jax.Array, cache: Any, token_mask: Any):
             hidden, cache = module.apply(
@@ -544,9 +548,7 @@ class Generator:
                     }
                 new_cache.append(layer)
             last = jnp.take_along_axis(hidden, (lengths - 1)[:, None, None], axis=1)[:, 0]
-            logits = self._head_fn(p, last.astype(compute_dtype))
-            if cstate:
-                logits = jnp.where(self._cs_allowed[cstate[0]], logits, -jnp.inf)
+            logits = self._constrain(self._head_fn(p, last.astype(compute_dtype)), cstate)
             tok0 = sample_tokens(logits, key, cfg)
             return tok0, tuple(new_cache), last.astype(jnp.float32)
 
@@ -891,7 +893,7 @@ class Generator:
                 if st is not None:
                     # the CONSTRAINED policy's distribution: mask, then
                     # renormalize — the same law sampling draws from
-                    logits = jnp.where(self._cs_allowed[st], logits, -jnp.inf)
+                    logits = self._constrain(logits, (st,))
                 return jax.nn.log_softmax(logits, axis=-1)
 
             st = cstate[0] if cs is not None else None

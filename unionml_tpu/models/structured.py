@@ -609,6 +609,18 @@ class ConstraintSet:
         self.allowed = np.concatenate(blocks_a, axis=0)
         self.starts = np.asarray(starts, np.int32)
         self.eos_id = eos
+        self._device_tables: Optional[Tuple[Any, Any]] = None
+
+    def device_tables(self) -> Tuple[Any, Any]:
+        """Memoized device copies ``(trans, allowed)`` shared by every engine
+        built over this set — a real-tokenizer set is tens of MB ([S, 128k]
+        int32 + bool), and a constrained speculative stack builds THREE
+        Generators (plain, target, draft) that must not each ship their own."""
+        if self._device_tables is None:
+            import jax.numpy as jnp
+
+            self._device_tables = (jnp.asarray(self.trans), jnp.asarray(self.allowed))
+        return self._device_tables
 
     @property
     def n_grammars(self) -> int:
