@@ -113,6 +113,24 @@ def test_sample_tokens_top_p_masks_tail():
     assert draws <= {0, 1} and 0 in draws
 
 
+def test_sample_tokens_min_p_adapts_to_confidence():
+    from unionml_tpu.models.generate import filtered_logits
+
+    cfg = GenerationConfig(temperature=1.0, min_p=0.2)
+    # confident head: 0.2 * 0.7 = 0.14 cuts the 0.1 and 0.05 tails
+    sharp = jnp.log(jnp.asarray([[0.70, 0.15, 0.10, 0.05]]))
+    kept = jnp.isfinite(filtered_logits(sharp, cfg))[0]
+    assert kept.tolist() == [True, True, False, False]
+    # flat distribution: 0.2 * 0.28 = 0.056 keeps everything — the filter is
+    # permissive exactly when the model is unsure (unlike a fixed top_k)
+    flat = jnp.log(jnp.asarray([[0.28, 0.26, 0.24, 0.22]]))
+    assert bool(jnp.isfinite(filtered_logits(flat, cfg)).all())
+    # composes with top_k: k=1 still wins after the min_p cut
+    cfg2 = GenerationConfig(temperature=1.0, min_p=0.2, top_k=1)
+    kept2 = jnp.isfinite(filtered_logits(sharp, cfg2))[0]
+    assert kept2.tolist() == [True, False, False, False]
+
+
 def test_stream_matches_call(tiny):
     module, params, _ = tiny
     gen = Generator(

@@ -106,6 +106,12 @@ class GenerationConfig:
     #: logits are masked by its grammar's token-DFA inside the decode scan.
     #: Excluded from equality/repr — it carries the DFA tables.
     constraints: Optional[Any] = dataclasses.field(default=None, compare=False, repr=False)
+    #: keep only tokens whose probability is at least ``min_p`` times the most
+    #: likely token's (applied after temperature, before top-k/top-p) — an
+    #: adaptive nucleus: permissive when the model is unsure, sharp when it is
+    #: confident. 0.0 disables. Appended last so existing positional
+    #: construction is unaffected.
+    min_p: float = 0.0
 
 
 def chunk_aligned(length: int, chunk: int) -> int:
@@ -237,6 +243,11 @@ def filtered_logits(logits: jax.Array, config: GenerationConfig) -> jax.Array:
     logits (masked entries become -inf). ``softmax`` of the result IS the policy's
     sampling distribution — speculative sampling rejects against exactly this."""
     logits = logits / config.temperature
+    if config.min_p > 0.0:
+        # prob(x) >= min_p * prob(argmax)  <=>  logit(x) >= max_logit + log(min_p)
+        # (softmax normalizers cancel), so the filter needs no softmax at all
+        cutoff = jnp.max(logits, axis=-1, keepdims=True) + jnp.log(config.min_p)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     if config.top_k > 0:
         kth = jnp.sort(logits, axis=-1)[..., -config.top_k][..., None]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
