@@ -26,6 +26,9 @@ os.environ.setdefault("BENCH_BERT_BATCH", "256")
 os.environ.setdefault("BENCH_BERT_STEPS_PER_CALL", "30")
 os.environ.setdefault("BENCH_BERT_STEPS", "80")  # 90 batches -> [30, 30, 30]
 os.environ.setdefault("BENCH_BERT_METRIC", "bert_base_sst2_mfu_frontier")
+# bf16 first moment halves one of AdamW's f32 state passes (frontier-only;
+# the canonical bench_bert keeps full-f32 optimizer state)
+os.environ.setdefault("BENCH_BERT_MU_DTYPE", "bfloat16")
 
 from benchmarks import bench_bert  # noqa: E402
 
