@@ -676,6 +676,8 @@ def test_continuous_constrained_streams_match_solo(tiny, cs, paged):
         streams = [batcher.submit(p, constraint=g) for p, g in zip(prompts, gids)]
         for got_stream, ref in zip(streams, solo):
             assert _collect(got_stream) == ref
+        # /metrics telemetry: one submission per grammar id recorded
+        assert batcher.stats()["grammar_submissions"] == {0: 1, 1: 1, 2: 1}
     finally:
         batcher.close()
 

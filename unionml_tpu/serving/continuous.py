@@ -284,6 +284,8 @@ class ContinuousBatcher:
         self.decoded_rows = 0
         self.preemptions = 0
         self._admit_counter = 0
+        #: submissions per grammar id (constrained engines): /metrics telemetry
+        self._grammar_counts: Dict[int, int] = {}
         # high-water marks of the carry's ride-along counters, so the spec
         # engine's rounds/accepted_tokens telemetry gets per-dispatch deltas
         self._spec_rounds_seen = 0
@@ -601,6 +603,8 @@ class ContinuousBatcher:
         with self._lock:
             if self._closed:
                 raise RuntimeError("ContinuousBatcher is closed")
+            if self.gen._cs is not None:
+                self._grammar_counts[grammar] = self._grammar_counts.get(grammar, 0) + 1
             self._pending.append((list(prompt), session))
             if self._thread is None:
                 self._thread = threading.Thread(target=self._engine_loop, daemon=True)
@@ -678,6 +682,7 @@ class ContinuousBatcher:
         with self._lock:
             self.decode_dispatches = 0
             self.decoded_rows = 0
+            self._grammar_counts.clear()  # warmup probes all ride FREE (id 0)
             if self._spec is not None:
                 # the carry's device-side ride-along counters are NOT reset;
                 # the high-water marks already equal them, so future deltas
@@ -712,6 +717,10 @@ class ContinuousBatcher:
                 snapshot["acceptance_rate"] = round(
                     self._spec.accepted_tokens / (self._spec.rounds * self._spec.gamma), 3
                 )
+            if self.gen._cs is not None:
+                # structured-output adoption: how many submissions rode each
+                # grammar (0 = FREE) — the signal for sizing the ConstraintSet
+                snapshot["grammar_submissions"] = dict(sorted(self._grammar_counts.items()))
             return snapshot
 
     def close(self, wait: bool = True) -> None:
