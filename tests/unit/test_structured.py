@@ -516,6 +516,80 @@ def test_constrained_beam_free_grammar_matches_unconstrained(micro_lm):
     )
 
 
+def test_stop_sequences_automaton_matches_re_search():
+    """Property check vs re.search over all token sequences up to depth 4: a
+    walk is allowed exactly while no stop string has completed strictly inside
+    an emitted token, and the must-EOS state is entered exactly when the text
+    ends with a stop."""
+    from unionml_tpu.models import stop_sequences
+
+    vocab = ["", "a", "b", "ab", "ba", "bb"]
+    stops = ["abb", "bb"]
+    c = stop_sequences(stops, vocab, eos_id=0)
+
+    def ends_with_stop(text):
+        return any(text.endswith(s) for s in stops)
+
+    def contains_stop_inside(prev, tok):
+        # a stop completing strictly before the token's last char
+        text = prev + tok
+        for i in range(len(prev) + 1, len(text)):
+            if any(text[:i].endswith(s) for s in stops):
+                return True
+        return False
+
+    seqs = [((0, ""),)]
+    frontier = [(0, "")]
+    for _ in range(4):
+        nxt = []
+        for state, text in frontier:
+            at_stop = ends_with_stop(text)
+            for t in range(1, len(vocab)):
+                ok = bool(c.allowed[state, t])
+                if at_stop:
+                    assert not ok, (text, vocab[t])
+                    continue
+                expected = not contains_stop_inside(text, vocab[t])
+                assert ok == expected, (text, vocab[t])
+                if ok:
+                    nxt.append((int(c.trans[state, t]), text + vocab[t]))
+            assert bool(c.allowed[state, 0])  # eos always available
+        frontier = nxt
+
+
+def test_stop_sequences_end_generation(tiny):
+    """Engine-level: with a stop constraint, greedy output either ends with the
+    stop string (followed by eos) or never contains it."""
+    from unionml_tpu.models import stop_sequences
+
+    module, params, _ = tiny
+    stops = ["ab", "ca"]
+    cset = ConstraintSet([stop_sequences(stops, TEXTS, eos_id=EOS)])
+    gen = Generator(
+        module, params,
+        GenerationConfig(max_new_tokens=12, temperature=0.0, eos_id=EOS,
+                         prompt_buckets=(8,), constraints=cset),
+    )
+    for seed_prompt in ([3, 14, 15], [1, 2], [7, 9]):
+        row = gen([seed_prompt], constraint=1)[0].tolist()
+        text, hit_eos, n_emitted = "", False, 0
+        for t in row:
+            n_emitted += 1
+            if t == EOS:
+                hit_eos = True
+                break
+            text += TEXTS[t]
+        occurrences = [i for s in stops for i in range(len(text)) if text[i:].startswith(s)]
+        if any(text.endswith(s) for s in stops):
+            # stop completed -> eos is FORCED on the very next step (only a
+            # budget that ran out exactly at the stop's last token excuses it)
+            assert hit_eos or n_emitted == 12, (text, row)
+            # and the stop appears ONLY at the very end
+            assert all(i + len(s) >= len(text) for s in stops for i in occurrences if text[i:].startswith(s))
+        else:
+            assert not occurrences, text
+
+
 # -------------------------------------------------- speculative composition
 
 

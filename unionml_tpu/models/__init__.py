@@ -16,6 +16,7 @@ from unionml_tpu.models.structured import (  # noqa: F401
     compile_regex,
     json_object,
     literal_choice,
+    stop_sequences,
     vocab_from_tokenizer,
 )
 from unionml_tpu.models.llama import (  # noqa: F401

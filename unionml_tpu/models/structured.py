@@ -35,6 +35,7 @@ sentence of it.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 from typing import Any, Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
 
@@ -46,6 +47,7 @@ __all__ = [
     "compile_regex",
     "literal_choice",
     "json_object",
+    "stop_sequences",
     "vocab_from_tokenizer",
 ]
 
@@ -539,6 +541,97 @@ def json_object(
         parts.append(f'"{_escape(key)}"{ws}:{ws}({value_pat})')
     body = (f"{ws},{ws}").join(parts)
     return compile_regex(f"\\{{{ws}{body}{ws}\\}}", vocab, eos_id)
+
+
+def stop_sequences(stops: Sequence[str], vocab: Sequence[str], eos_id: int) -> TokenConstraint:
+    """A constraint enforcing STOP STRINGS: generation is free until any of
+    ``stops`` completes in the emitted text, after which only EOS is allowed —
+    the stream ends with the stop string, one token later (the OpenAI-style
+    ``stop=`` knob, expressed as a grammar so every engine and composition —
+    batcher, speculative, beam, paged, preemption-resume — inherits it with
+    zero new machinery).
+
+    Built directly as an Aho-Corasick automaton over the stop strings (the
+    "text not containing X" language needs complement/lookahead the regex
+    dialect deliberately lacks). Token rule: a token whose text completes a
+    stop AT ITS END transitions to the must-EOS state; a token that would run
+    PAST a completion mid-text is disallowed (the model takes a shorter
+    tokenization of the same text — single-char tokens keep this live); EOS is
+    allowed everywhere (free generation may end at will)."""
+    if not stops or any(not s for s in stops):
+        raise ValueError("stops must be non-empty strings")
+    if not 0 <= eos_id < len(vocab):
+        raise ValueError(f"eos_id {eos_id} outside vocab of {len(vocab)}")
+    # Aho-Corasick: trie states over stop prefixes + failure links -> a total
+    # transition function (a DFA) with match flags
+    trie: List[Dict[str, int]] = [{}]
+    match: List[bool] = [False]
+    for stop in stops:
+        s = 0
+        for ch in stop:
+            if ch not in trie[s]:
+                trie.append({})
+                match.append(False)
+                trie[s][ch] = len(trie) - 1
+            s = trie[s][ch]
+        match[s] = True
+    fail = [0] * len(trie)
+    dq = collections.deque(trie[0].values())
+    while dq:
+        s = dq.popleft()
+        for ch, t in trie[s].items():
+            dq.append(t)
+            f = fail[s]
+            while f and ch not in trie[f]:
+                f = fail[f]
+            fail[t] = trie[f][ch] if ch in trie[f] and trie[f][ch] != t else 0
+            match[t] = match[t] or match[fail[t]]
+
+    def step(s: int, ch: str) -> int:
+        while s and ch not in trie[s]:
+            s = fail[s]
+        return trie[s].get(ch, 0)
+
+    # totalize into a dense char table so the token projection is the same
+    # vectorized numpy fold compile_regex uses — a pure-Python per-(state,
+    # token, char) walk is seconds of host startup at real vocab sizes
+    chars = sorted({ch for s in stops for ch in s})
+    char_ix = {ch: i for i, ch in enumerate(chars)}
+    S = len(trie)
+    cmat = np.zeros((S, len(chars) + 1), np.int64)  # last col: any other char -> root
+    for s in range(S):
+        for ci, ch in enumerate(chars):
+            cmat[s, ci] = step(s, ch)
+    match_arr = np.asarray(match, bool)
+
+    n_states = S + 1  # + the terminal must-EOS state
+    must_eos = S
+    V = len(vocab)
+    trans = np.zeros((n_states, V), np.int32)
+    allowed = np.zeros((n_states, V), bool)
+    all_states = np.arange(S)
+    for t, text in enumerate(vocab):
+        if t == eos_id or text == "":
+            continue
+        cur = all_states
+        early = np.zeros((S,), bool)  # a stop completed STRICTLY inside the token
+        for i, ch in enumerate(text):
+            cur = cmat[cur, char_ix.get(ch, len(chars))]
+            if i < len(text) - 1:
+                early |= match_arr[cur]
+        ok = ~early
+        trans[:S][ok, t] = np.where(match_arr[cur[ok]], must_eos, cur[ok])
+        allowed[:S][ok, t] = True
+    allowed[:, eos_id] = True  # free generation may end at will; forced at must_eos
+    trans[:, eos_id] = np.arange(n_states)  # terminal self-loops
+    # match trie states are unreachable as targets (completing tokens map to
+    # must_eos) but collapse their rows too; must-EOS allows ONLY eos
+    for s in np.flatnonzero(match_arr):
+        allowed[s, :] = False
+        allowed[s, eos_id] = True
+    allowed[must_eos, :] = False
+    allowed[must_eos, eos_id] = True
+    return TokenConstraint(trans=trans, allowed=allowed, eos_id=eos_id)
 
 
 def vocab_from_tokenizer(tokenizer: Any) -> List[str]:
