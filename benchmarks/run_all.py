@@ -44,8 +44,18 @@ SCRIPTS = {
     "paged_attention": "bench_paged_attention.py",
 }
 #: scripts that initialize the (tunneled) accelerator backend; everything else is
-#: CPU-substrate by design (sklearn/serving) and launches unprobed
-CPU_ONLY = {"digits", "serving"}
+#: CPU-substrate by design (sklearn/serving) and launches unprobed.
+#: RUNALL_CPU_ONLY extends the set for one invocation — e.g. capture
+#: serving_jit on the CPU backend during a long tunnel wedge (its emit labels
+#: the platform; a later TPU run's success replaces it by accretion)
+_cpu_extra = {
+    name.strip() for name in os.environ.get("RUNALL_CPU_ONLY", "").split(",") if name.strip()
+}
+if _cpu_extra - set(SCRIPTS):
+    # a typo'd name would silently skip the CPU pin and launch the bench
+    # against the wedged tunnel — the exact hang the operator set this to avoid
+    raise SystemExit(f"RUNALL_CPU_ONLY names not in SCRIPTS: {sorted(_cpu_extra - set(SCRIPTS))}")
+CPU_ONLY = {"digits", "serving"} | _cpu_extra
 
 PROBE_RETRY_S = 600.0
 #: per-script cap: a healthy run of the longest script (generate, ~15 min with
@@ -147,6 +157,12 @@ def main() -> None:
         path = (Path(__file__).parent / script).resolve()
         _log(f"=== {name} ({path.name}) ===")
         start = time.perf_counter()
+        child_env = os.environ.copy()
+        if name in CPU_ONLY:
+            # CPU-substrate children must never init the tunneled plugin (the
+            # ambient env pins JAX_PLATFORMS to axon, and a wedged tunnel would
+            # hang an unprobed CPU bench at jax.devices())
+            child_env["JAX_PLATFORMS"] = "cpu"
         try:
             proc = subprocess.run(
                 [sys.executable, str(path)],
@@ -154,6 +170,7 @@ def main() -> None:
                 text=True,
                 cwd=ROOT,
                 timeout=SCRIPT_TIMEOUT_S,
+                env=child_env,
             )
         except subprocess.TimeoutExpired as exc:
             _log(f"{name} timed out after {SCRIPT_TIMEOUT_S:.0f}s (backend wedged mid-run?)")
@@ -188,6 +205,16 @@ def main() -> None:
         if not _is_success(payload):
             _log(f"{name}: CPU-fallback result")
             _record_failure(results, out, name, payload)
+            continue
+        if (
+            payload.get("platform") == "cpu"
+            and _is_success(results.get(name))
+            and results[name].get("platform") != "cpu"
+        ):
+            # a platform-labeled CPU capture (RUNALL_CPU_ONLY) must never
+            # replace a real-chip capture — same accretion contract as the
+            # *_cpu_fallback class
+            _log(f"{name}: keeping the existing non-cpu capture over a cpu-platform run")
             continue
         results[name] = payload
         _log(lines[-1])

@@ -538,7 +538,6 @@ def test_stop_sequences_automaton_matches_re_search():
                 return True
         return False
 
-    seqs = [((0, ""),)]
     frontier = [(0, "")]
     for _ in range(4):
         nxt = []
