@@ -142,14 +142,15 @@ def test_text_generation_template_trains_generates_and_serves(render, tmp_path):
     assert "the quick brown " + streamed_word == g_out[0]
 
     # speculative decoding through the Generator façade: greedy-exact vs the
-    # plain predictor (the half-depth draft changes speed, never tokens).
-    # Spec prompts must be un-prefixed: speculative_generator builds its own
-    # constraint-free config, and its continuation must equal the FREE-grammar
-    # predictor output (eos_id differs: the predictor config uses PAD as eos,
-    # which the trained model never argmaxes)
+    # plain predictor (the half-depth draft changes speed, never tokens) —
+    # including under a grammar, since the spec config shares the predictor's
+    # constraint set and the DFA state threads along the draft's proposals
     spec = module.speculative_generator(module.model.artifact.model_object)
     spec_out = spec([module.encode(p) for p in prompts])
     assert [p + module.decode(r) for p, r in zip(prompts, spec_out)] == outputs
+    word_gid, _ = module._split_grammar(g_prompt)  # the serving path's own mapping
+    spec_word = spec([module.encode("the quick brown ")], constraint=word_gid)
+    assert "the quick brown " + module.decode(spec_word[0]) == g_out[0]
 
 
 def test_serverless_template_trains_and_scores(render):

@@ -280,11 +280,12 @@ def speculative_generator(state: train_state.TrainState, draft_params=None, gamm
         )["params"]
     cfg = GenerationConfig(
         max_new_tokens=NEW_TOKENS, temperature=0.0, prompt_buckets=(SEQ_LEN,),
-        # eos matches the predictor config so the greedy-exact oracle (spec
-        # output == /predict output) holds by construction, not by the
-        # model-never-argmaxes-PAD assumption; constraints stay off (they do
-        # not compose with drafts)
+        # the SAME eos + grammar set as the predictor config, so the
+        # greedy-exact oracle (spec output == /predict output) holds by
+        # construction for plain AND grammar-constrained calls — the DFA state
+        # threads along the draft's proposed path (models/speculative.py)
         eos_id=PAD_ID,
+        constraints=_CONSTRAINTS,
         draft=DraftSpec(module=draft_module, params=draft_params, gamma=gamma),
     )
     return Generator(module, state.params, cfg)
