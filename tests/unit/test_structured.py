@@ -717,8 +717,8 @@ def _collect(stream) -> List[int]:
     return [int(t) for chunk in stream for t in np.atleast_1d(chunk)]
 
 
-def _solo_until_eos(gen, prompt, gid) -> List[int]:
-    row = gen([prompt], constraint=gid)[0].tolist()
+def _solo_until_eos(gen, prompt, gid, prefix=None) -> List[int]:
+    row = gen([prompt], constraint=gid, prefix=prefix)[0].tolist()
     out = []
     for t in row:
         out.append(t)
@@ -802,6 +802,43 @@ def test_continuous_engine_death_mid_admission_errors_the_stream(tiny):
     with pytest.raises(RuntimeError, match="injected"):
         next(iter(stream))
     batcher.close()
+
+
+def test_everything_composes_at_once(tiny, cs):
+    """The capstone: int8 weights + int8 KV cache + paged block pool + shared
+    system-prompt prefix + speculative decoding + per-request grammars, all in
+    one continuously-batched engine — every concurrent stream token-exact
+    against its solo run through the same maximal config."""
+    from unionml_tpu.serving import ContinuousBatcher
+
+    module, params, _ = tiny
+    d_module, d_params = _draft_pair(tiny)
+    gen = Generator(
+        module, params,
+        GenerationConfig(
+            max_new_tokens=8, temperature=0.0, eos_id=EOS, prompt_buckets=(8,),
+            kv_cache_dtype="int8", constraints=cs,
+            draft=DraftSpec(module=d_module, params=d_params, gamma=2),
+        ),
+        quantize="int8",
+    )
+    prefix = gen.cache_prefix([11, 12, 13])
+    prompts = [[3, 14, 15], [7, 7, 9], [1, 2]]
+    gids = [1, 2, 0]
+    solo = [_solo_until_eos(gen, p, g, prefix=prefix) for p, g in zip(prompts, gids)]
+    batcher = ContinuousBatcher(gen, slots=2, decode_chunk=2, prefix=prefix, block_size=4)
+    try:
+        streams = [batcher.submit(p, constraint=g) for p, g in zip(prompts, gids)]
+        for got_stream, ref, g in zip(streams, solo, gids):
+            got = _collect(got_stream)
+            assert got == ref, (g, got, ref)
+            if g == 1:
+                text = decode_text(got)
+                assert re.fullmatch(r"[a-c]{3,5}", text) or (
+                    len(text) < 3 and all(c in "abc" for c in text)
+                ), text
+    finally:
+        batcher.close()
 
 
 def test_continuous_rejects_constraint_without_set(tiny):
