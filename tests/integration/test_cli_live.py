@@ -51,7 +51,14 @@ def _served(args, cwd, env, log_path, startup_s):
             yield base
         finally:
             proc.terminate()
-            proc.wait(timeout=10)
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                # a server blocked in native XLA compile ignores SIGTERM; a
+                # TimeoutExpired here would mask the diagnostic AssertionError
+                # and leak the process + port for the rest of the run
+                proc.kill()
+                proc.wait()
 
 
 def test_serve_workers_flag_boots_multiprocess_server(cli_project, tmp_path):

@@ -841,6 +841,47 @@ def test_everything_composes_at_once(tiny, cs):
         batcher.close()
 
 
+def test_continuous_randomized_stress_matches_solo(tiny, cs):
+    """Seeded randomized stress: a dozen streams with random prompts, lengths,
+    budgets, and grammar ids through a small paged pool (preemption-prone) —
+    every stream token-exact against its solo (prompt, grammar, budget) run.
+    Broadens the targeted oracles to arbitrary mixes (budget x grammar
+    truncation, bucket variety, slot churn)."""
+    from unionml_tpu.serving import ContinuousBatcher
+
+    module, params, _ = tiny
+    rng = np.random.default_rng(42)
+    gen = Generator(
+        module, params,
+        GenerationConfig(max_new_tokens=8, temperature=0.0, eos_id=EOS,
+                         prompt_buckets=(8,), constraints=cs),
+    )
+    jobs = []
+    for _ in range(12):
+        plen = int(rng.integers(1, 8))
+        prompt = [int(t) for t in rng.integers(1, 40, size=plen)]
+        gid = int(rng.integers(0, 3))
+        budget = int(rng.integers(1, 9))
+        jobs.append((prompt, gid, budget))
+
+    # greedy truncation law: a budget-b run is the first b tokens of the
+    # full-budget run (the budget only cuts the scan short), so one solo
+    # generator + a slice serves every budget without extra compiles
+    refs = [_solo_until_eos(gen, prompt, gid)[:budget] for prompt, gid, budget in jobs]
+    batcher = ContinuousBatcher(gen, slots=3, decode_chunk=2, block_size=2, pool_blocks=9)
+    try:
+        streams = [
+            batcher.submit(prompt, constraint=gid, max_new_tokens=budget)
+            for prompt, gid, budget in jobs
+        ]
+        for i, (stream, ref) in enumerate(zip(streams, refs)):
+            got = _collect(stream)
+            assert got == ref, (i, jobs[i], got, ref)
+        assert batcher.stats()["kv_blocks"]["used"] == 0  # allocator balanced
+    finally:
+        batcher.close()
+
+
 def test_continuous_rejects_constraint_without_set(tiny):
     from unionml_tpu.serving import ContinuousBatcher
 
