@@ -251,3 +251,175 @@ def test_continuous_batching_constrained_over_tp_mesh():
             assert got == ref
     finally:
         batcher.close()
+
+
+def test_continuous_batching_with_sp_prefill():
+    """Long-context admission (the round-4 hole at continuous.py): each
+    admission's batch-1 row prefills ring-sequence-parallel over the mesh's
+    sequence axis, pastes into the pool, and concurrent streams equal the plain
+    single-device engine's tokens."""
+    import dataclasses
+
+    from unionml_tpu.serving import ContinuousBatcher
+
+    module, params = _tiny()
+    cfg = GenerationConfig(max_new_tokens=8, temperature=0.0, prompt_buckets=(16,))
+    prompts = [[3, 1, 4, 1, 5, 9, 2, 6, 5, 3], [9, 2, 6], [7, 1, 8, 2, 8, 1]]
+    expected = [list(r) for r in Generator(module, params, cfg)(prompts)]
+
+    mesh = MeshSpec(data=1, sequence=4).build(jax.devices()[:4])
+    sp_gen = Generator(module, params, dataclasses.replace(cfg, sp_prefill="ring"), mesh=mesh)
+    batcher = ContinuousBatcher(sp_gen, slots=2, decode_chunk=3)
+    try:
+        streams = [batcher.submit(p) for p in prompts]
+        results = [
+            [int(t) for chunk in s for t in np.asarray(chunk).ravel()] for s in streams
+        ]
+        assert results == expected
+    finally:
+        batcher.close()
+
+
+def test_continuous_batching_sp_prefill_paged():
+    """sp admission x paged pool: the ring-prefilled row scatters into pool
+    blocks like any dense row — the two round-4 composition holes close
+    together, not just separately."""
+    import dataclasses
+
+    from unionml_tpu.serving import ContinuousBatcher
+
+    module, params = _tiny()
+    cfg = GenerationConfig(max_new_tokens=6, temperature=0.0, prompt_buckets=(8,))
+    prompts = [[3, 1, 4, 1, 5], [9, 2, 6], [7, 1]]
+    expected = [list(r) for r in Generator(module, params, cfg)(prompts)]
+
+    mesh = MeshSpec(data=1, sequence=2).build(jax.devices()[:2])
+    sp_gen = Generator(module, params, dataclasses.replace(cfg, sp_prefill="ring"), mesh=mesh)
+    batcher = ContinuousBatcher(sp_gen, slots=2, decode_chunk=2, block_size=4)
+    try:
+        streams = [batcher.submit(p) for p in prompts]
+        results = [
+            [int(t) for chunk in s for t in np.asarray(chunk).ravel()] for s in streams
+        ]
+        assert results == expected
+    finally:
+        batcher.close()
+
+
+def test_sp_prefill_resume_width_falls_back_to_dense():
+    """A preemption resume's exact-width row can exceed every configured bucket;
+    when its sequence-aligned width would overflow the cache, admission falls
+    back to the (token-identical) dense prefill instead of failing the stream."""
+    import dataclasses
+
+    from unionml_tpu.serving import ContinuousBatcher
+
+    module, params = _tiny()
+    base = GenerationConfig(max_new_tokens=4, temperature=0.0, prompt_buckets=(8,))
+    mesh = MeshSpec(data=1, sequence=4).build(jax.devices()[:4])
+    sp_gen = Generator(module, params, dataclasses.replace(base, sp_prefill="ring"), mesh=mesh)
+    batcher = ContinuousBatcher(sp_gen, slots=2, decode_chunk=2)
+    try:
+        # cache_len = 8 + 4 + 2 = 14; a 13-token resume fits exactly (13 + 1)
+        # but chunk_aligned(13, 4) = 16 > 14 — the sp branch must not raise
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8, 9]
+        assert batcher.cache_len == 14
+        tok0, lengths, _ = batcher._prefill_row(prompt, 0, budget=1)
+        expected = Generator(module, params, base)([prompt])
+        assert int(np.asarray(tok0).ravel()[0]) == int(expected[0][0])
+    finally:
+        batcher.close()
+
+
+def test_speculative_continuous_with_sp_prefill():
+    """Speculative x sp x continuous: both the target's and the draft's batch-1
+    admission rows prefill sequence-parallel (the draft Generator inherits the
+    mesh and sp_prefill config), rounds advance through the shared spec loop,
+    and each greedy stream equals the target-only solo run — the speculative
+    exactness law survives ring-prefilled admission."""
+    import dataclasses
+
+    from unionml_tpu.models import DraftSpec
+    from unionml_tpu.serving import ContinuousBatcher
+
+    module, params = _tiny()
+    draft_cfg = LlamaConfig.tiny(
+        vocab_size=96, dim=32, n_layers=1, n_heads=2, n_kv_heads=1, hidden_dim=64,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    draft = Llama(draft_cfg)
+    dp = draft.init(jax.random.PRNGKey(5), jnp.zeros((1, 8), jnp.int32))["params"]
+
+    base = GenerationConfig(max_new_tokens=8, temperature=0.0, prompt_buckets=(16,))
+    prompts = [[3, 1, 4, 1, 5, 9, 2, 6], [7, 1, 8], [2, 8]]
+    expected = [list(r) for r in Generator(module, params, base)(prompts)]
+
+    mesh = MeshSpec(data=1, sequence=2).build(jax.devices()[:2])
+    cfg = dataclasses.replace(
+        base, sp_prefill="ring", draft=DraftSpec(module=draft, params=dp, gamma=3)
+    )
+    sp_gen = Generator(module, params, cfg, mesh=mesh)
+    batcher = ContinuousBatcher(sp_gen, slots=2, decode_chunk=2)
+    try:
+        streams = [batcher.submit(p) for p in prompts]
+        results = [
+            [int(t) for chunk in s for t in np.asarray(chunk).ravel()] for s in streams
+        ]
+        assert results == expected
+    finally:
+        batcher.close()
+
+
+def test_paged_kv_over_tp_mesh():
+    """Paged KV x TP (the round-4 hole at continuous.py): the heads-major pools
+    shard over the model axis, tables replicate, and paged decode against
+    model-sharded params emits exactly the unsharded dense engine's tokens —
+    including through a pool small enough to force admissions to wait."""
+    from unionml_tpu.serving import ContinuousBatcher
+
+    module, params = _tiny()
+    cfg = GenerationConfig(max_new_tokens=8, temperature=0.0, prompt_buckets=(16,))
+    prompts = [[3, 1, 4, 1, 5], [9, 2, 6], [7, 1], [2, 8, 1, 8]]
+    expected = [list(r) for r in Generator(module, params, cfg)(prompts)]
+
+    mesh = MeshSpec(data=1, model=4).build(jax.devices()[:4])
+    sharded = Generator(module, params, cfg, mesh=mesh, partition_rules=llama_partition_rules())
+    batcher = ContinuousBatcher(sharded, slots=3, decode_chunk=4, block_size=8)
+    try:
+        streams = [batcher.submit(p) for p in prompts]
+        results = [
+            [int(t) for chunk in s for t in np.asarray(chunk).ravel()] for s in streams
+        ]
+        assert results == expected
+        assert batcher.stats()["kv_blocks"]["total"] == batcher.pool_blocks
+    finally:
+        batcher.close()
+
+
+def test_paged_kv_with_prefix_over_tp_mesh():
+    """Paged x TP x shared prefix: shared prefix pages seeded once into the
+    model-sharded pool, per-request suffixes allocated privately — tokens equal
+    the unsharded engine run WITH the same prefix."""
+    from unionml_tpu.serving import ContinuousBatcher
+
+    module, params = _tiny()
+    cfg = GenerationConfig(max_new_tokens=6, temperature=0.0, prompt_buckets=(8,))
+    prefix_tokens = [11, 12, 13, 14, 15, 16, 17, 18]
+    prompts = [[3, 1, 4], [9, 2], [7, 1, 8, 2]]
+
+    plain = Generator(module, params, cfg)
+    plain_prefix = plain.cache_prefix(prefix_tokens)
+    expected = [list(r) for r in plain(prompts, prefix=plain_prefix)]
+
+    mesh = MeshSpec(data=1, model=2).build(jax.devices()[:2])
+    tp_gen = Generator(module, params, cfg, mesh=mesh, partition_rules=llama_partition_rules())
+    tp_prefix = tp_gen.cache_prefix(prefix_tokens)
+    batcher = ContinuousBatcher(tp_gen, slots=2, decode_chunk=3, prefix=tp_prefix, block_size=4)
+    try:
+        streams = [batcher.submit(p) for p in prompts]
+        results = [
+            [int(t) for chunk in s for t in np.asarray(chunk).ravel()] for s in streams
+        ]
+        assert results == expected
+    finally:
+        batcher.close()

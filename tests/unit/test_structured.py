@@ -202,6 +202,38 @@ def test_empty_string_tokens_never_allowed():
     assert not c.allowed[:, 2].any()
 
 
+def test_anchors_are_noops_under_fullmatch():
+    """``^[ab]+$`` — the most common full-match spelling — must compile to the
+    same language as ``[ab]+``, not demand literal '^'/'$' characters."""
+    vocab = ["", "a", "b", "^", "$"]
+    c = compile_regex(r"^[ab]+$", vocab, eos_id=0)
+    assert c.allowed[0, 1] and c.allowed[0, 2]
+    assert not c.allowed[0, 3] and not c.allowed[0, 4]  # no literal anchors
+    s = int(c.trans[0, 1])
+    assert c.allowed[s, 0]  # "a" is a full match
+    # redundant / repeated anchors and top-level per-branch anchors, as re allows
+    for pat, tok in ((r"^^a$$", 1), (r"^a|b$", 1), (r"^a|^b", 1)):
+        c = compile_regex(pat, vocab, eos_id=0)
+        st = int(c.trans[0, tok])
+        assert c.allowed[st, 0], pat
+
+
+def test_mid_pattern_anchor_raises_escaped_is_literal():
+    """Anchors anywhere but top-level pattern edges are parse errors: mid-branch
+    they match nothing under fullmatch, and at GROUP branch edges (`(a$)b`,
+    `a(^b)`) a no-op would silently accept strings re.fullmatch rejects."""
+    for pat in (r"a^b", r"a$b", r"a+$b", r"(a$)b", r"a(^b)", r"(^a)b", r"(^a)|(b$)"):
+        with pytest.raises(ValueError, match="anchor"):
+            compile_regex(pat, ["", "a", "b"], eos_id=0)
+    vocab = ["", "a", "^", "$"]
+    c = compile_regex(r"\^a\$", vocab, eos_id=0)  # escaped = literal, as before
+    s = int(c.trans[0, 2])
+    s = int(c.trans[s, 1])
+    s = int(c.trans[s, 3])
+    assert c.allowed[s, 0]
+    assert re.fullmatch(r"\^a\$", "^a$")
+
+
 def test_json_object_grammar():
     import json as jsonlib
 

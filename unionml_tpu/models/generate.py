@@ -588,6 +588,25 @@ class Generator:
 
         return jax.tree_util.tree_map(lambda a: jax.device_put(a, spec(a)), cache)
 
+    def _place_paged_cache(self, cache: Any) -> Any:
+        """Mesh placement for a PAGED pool (:func:`init_paged_cache`): the
+        heads-major ``[H_kv, n_blocks, block_size, D]`` pools shard their head
+        dim over the model axis — the same axis the dense ``[B, L, H, D]``
+        cache shards in :meth:`_place_cache` — and the ``[slots, max_blocks]``
+        block tables replicate (every shard needs the full table to gather its
+        own heads' blocks)."""
+        if self.mesh is None:
+            return cache
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def spec(a: jax.Array) -> NamedSharding:
+            model = "model" if "model" in self.mesh.axis_names else None
+            if a.ndim != 4 or (model is not None and a.shape[0] % self.mesh.shape["model"] != 0):
+                model = None  # tables, or KV heads indivisible by the axis: replicate
+            return NamedSharding(self.mesh, P(model))
+
+        return jax.tree_util.tree_map(lambda a: jax.device_put(a, spec(a)), cache)
+
     # ------------------------------------------------------------------ generate
 
     def cache_prefix(self, prefix_tokens: Sequence[int]) -> PrefixCache:

@@ -16,6 +16,8 @@ from __future__ import annotations
 
 from typing import Any, Callable, Dict, Optional
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
@@ -60,6 +62,68 @@ class RMSNorm(nn.Module):
         x32 = x.astype(jnp.float32)
         norm = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.epsilon)
         return (norm * scale).astype(self.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _embed_lookup(embedding: jax.Array, tokens: jax.Array, num_embeddings: int) -> jax.Array:
+    return jnp.take(embedding, tokens, axis=0)
+
+
+def _embed_lookup_fwd(embedding, tokens, num_embeddings):
+    return jnp.take(embedding, tokens, axis=0), tokens
+
+
+def _embed_lookup_bwd(num_embeddings, res, g):
+    tokens = res  # g.dtype == the lookup's (and so the table operand's) dtype
+    # dW as a one-hot matmul instead of take's scatter-add: with the table
+    # vocab/dim-sharded the scatter cannot be partitioned and XLA falls back to
+    # involuntary full rematerialization; the dot reduce-scatters cleanly, the
+    # one-hot iota fuses into its tiles ([tokens, vocab] never materializes),
+    # and a frozen table's dW (LoRA) is still dead-code-eliminated
+    one_hot = jax.nn.one_hot(tokens, num_embeddings, dtype=g.dtype)
+    axes = tuple(range(g.ndim - 1))
+    dw = jax.lax.dot_general(
+        one_hot, g, (((axes), (axes)), ((), ())), preferred_element_type=jnp.float32
+    )
+    return (dw.astype(g.dtype), None)
+
+
+_embed_lookup.defvjp(_embed_lookup_fwd, _embed_lookup_bwd)
+
+
+class IotaEmbed(nn.Module):
+    """``nn.Embed`` with an SPMD-clean backward: gather forward, one-hot
+    matmul backward (the train-side half of maxtext's ``use_iota_embed``).
+
+    ``nn.Embed`` lowers to gather forward / scatter-add backward; with the
+    table vocab/dim-sharded (Megatron vocab-parallel, the llama/moe partition
+    rules) the SPMD partitioner cannot reshard the batch-sharded update into
+    the table layout and falls back to "involuntary full rematerialization" —
+    a per-step (per-microbatch, under grad accumulation) all-gather of the
+    residual gradient. The backward here is a dot against a one-hot iota
+    (same shapes as the lm_head matmul), which reduce-scatters cleanly.
+
+    The FORWARD stays a gather on purpose: a full one-hot matmul would stream
+    the whole table per call, which is irrelevant in training but ruinous in
+    decode (a [B, 1] lookup reads rows, not gigabytes). Param path, shape,
+    init, and looked-up values are identical to ``nn.Embed``, so partition
+    rules and checkpoints are unaffected.
+    """
+
+    num_embeddings: int
+    features: int
+    dtype: Dtype = jnp.bfloat16
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array) -> jax.Array:
+        embedding = self.param(
+            "embedding",
+            nn.initializers.variance_scaling(1.0, "fan_in", "normal", out_axis=0),
+            (self.num_embeddings, self.features),
+            self.param_dtype,
+        )
+        return _embed_lookup(embedding.astype(self.dtype), tokens, self.num_embeddings)
 
 
 def rotary_embedding(x: jax.Array, positions: jax.Array, theta: float = 10000.0) -> jax.Array:

@@ -18,7 +18,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 from jax.sharding import PartitionSpec as P
 
-from unionml_tpu.models.layers import RMSNorm, TransformerBlock
+from unionml_tpu.models.layers import IotaEmbed, RMSNorm, TransformerBlock
 from unionml_tpu.parallel.sharding import PartitionRules
 
 
@@ -83,7 +83,9 @@ class Llama(nn.Module):
         masking already hides right-padding from real tokens."""
         del token_mask
         cfg = self.config
-        x = nn.Embed(
+        # one-hot-matmul lookup: same params as nn.Embed, SPMD-clean backward
+        # (nn.Embed's scatter-add cannot partition into the vocab-sharded table)
+        x = IotaEmbed(
             cfg.vocab_size, cfg.dim, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="embed"
         )(tokens)
         if positions is None:

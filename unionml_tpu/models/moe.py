@@ -31,7 +31,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 from jax.sharding import PartitionSpec as P
 
-from unionml_tpu.models.layers import MLP, Attention, RMSNorm
+from unionml_tpu.models.layers import MLP, Attention, IotaEmbed, RMSNorm
 from unionml_tpu.parallel.sharding import PartitionRules
 
 Dtype = Any
@@ -265,7 +265,7 @@ class MoETransformer(nn.Module):
         from unionml_tpu.models.layers import TransformerBlock
 
         cfg = self.config
-        x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="embed")(tokens)
+        x = IotaEmbed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="embed")(tokens)
         if positions is None:
             positions = jnp.arange(tokens.shape[1])
         new_cache = []

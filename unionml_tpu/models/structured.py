@@ -111,23 +111,52 @@ class _Parser:
         return ch
 
     def parse(self) -> _Node:
-        node = self.alt()
+        node = self.alt(depth=0)
         if self.i != len(self.p):
             raise self.error(f"unexpected {self.p[self.i]!r}")
         return node
 
-    def alt(self) -> _Node:
-        branches = [self.concat()]
+    def alt(self, depth: int = 1) -> _Node:
+        branches = [self.concat(depth)]
         while self.peek() == "|":
             self.next()
-            branches.append(self.concat())
+            branches.append(self.concat(depth))
         if len(branches) == 1:
             return branches[0]
         return _Node("alt", children=tuple(branches))
 
-    def concat(self) -> _Node:
+    def concat(self, depth: int = 1) -> _Node:
         parts: List[_Node] = []
         while self.peek() not in (None, "|", ")"):
+            # Anchors are redundant under the promised fullmatch semantics —
+            # but ONLY at top-level branch edges, where a branch edge IS a
+            # string edge. There `^`/`$` are no-ops (the common `^...$`
+            # spelling just works). Everywhere else — mid-branch, or anywhere
+            # inside a group, where a branch edge is a mid-string position
+            # (e.g. `(a$)b`, `a(^b)`) — re.fullmatch semantics differ from
+            # both "literal" and "no-op", so an explicit error beats silently
+            # compiling a different language.
+            if self.peek() == "^":
+                if parts or depth > 0:
+                    raise self.error(
+                        "'^' anchor is only supported at the pattern start "
+                        "(fullmatch makes it redundant there; use \\^ for a literal '^')"
+                    )
+                self.next()
+                continue
+            if self.peek() == "$":
+                if depth > 0:
+                    raise self.error(
+                        "'$' anchor is only supported at the pattern end "
+                        "(fullmatch makes it redundant there; use \\$ for a literal '$')"
+                    )
+                self.next()
+                if self.peek() not in (None, "|", "$"):
+                    raise self.error(
+                        "'$' anchor mid-pattern never matches under fullmatch "
+                        "semantics (use \\$ for a literal '$')"
+                    )
+                continue
             parts.append(self.repeat())
         return _Node("concat", children=tuple(parts))
 

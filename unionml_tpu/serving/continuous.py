@@ -182,8 +182,6 @@ class ContinuousBatcher:
         if block_size is not None and block_size < 1:
             raise ValueError("block_size must be >= 1")
         cfg = generator.config
-        if cfg.sp_prefill:
-            raise ValueError("continuous batching does not compose with sp_prefill yet")
         self.gen = generator
         #: speculative mode: with ``config.draft`` set, resident rows advance by
         #: draft-and-verify ROUNDS instead of single decode steps — the engine
@@ -210,6 +208,18 @@ class ContinuousBatcher:
         p0 = prefix.length if prefix is not None else 0
         widest = max(cfg.prompt_buckets, default=64)
         self.cache_len = p0 + widest + cfg.max_new_tokens + overshoot
+        #: sp admission (sp_prefill + a >1 "sequence" mesh axis, no shared
+        #: prefix — the same dispatch rule as Generator._start): each bucket
+        #: pads to a sequence-axis multiple so every shard gets equal columns,
+        #: and the row cache must hold that aligned width
+        self._sp_seq = (
+            int(generator.mesh.shape.get("sequence", 1)) if generator.mesh is not None else 1
+        )
+        if cfg.sp_prefill and self._sp_seq > 1 and prefix is None:
+            sp_aligned = max(
+                chunk_aligned(b, self._sp_seq) for b in (cfg.prompt_buckets or (widest,))
+            )
+            self.cache_len = max(self.cache_len, sp_aligned)
         if prefix is not None and cfg.prefill_chunk:
             # the offset chunked prefill pads each bucket to a chunk multiple and
             # writes that full aligned width at [p0, p0+aligned) — with a large
@@ -237,8 +247,9 @@ class ContinuousBatcher:
                     )
         self.block_size = block_size
         if block_size is not None:
-            if generator.mesh is not None:
-                raise ValueError("paged KV does not compose with a sharded Generator yet")
+            # paged x TP composes: the heads-major pools shard over the model
+            # axis (Generator._place_paged_cache), tables replicate, and
+            # admission's row scatter touches only unsharded pool dims
             self.max_blocks = -(-self.cache_len // block_size)
             self.pool_blocks = pool_blocks if pool_blocks is not None else slots * self.max_blocks
             if self.pool_blocks < self.max_blocks:
@@ -414,9 +425,11 @@ class ContinuousBatcher:
             # pool_blocks + 1: the extra block is scratch (see __init__); tables
             # start all-scratch so never-admitted slots' ride-along writes are
             # harmless from the first dispatch
-            cache = init_paged_cache(
-                self.gen.module.config, self.slots, self.pool_blocks + 1, self.block_size,
-                self.max_blocks, kv_dtype=cfg.kv_cache_dtype, fill_block=self._scratch_block,
+            cache = self.gen._place_paged_cache(
+                init_paged_cache(
+                    self.gen.module.config, self.slots, self.pool_blocks + 1, self.block_size,
+                    self.max_blocks, kv_dtype=cfg.kv_cache_dtype, fill_block=self._scratch_block,
+                )
             )
             if self._shared_prefix_blocks:
                 cache = self._seed_shared_prefix(cache, self.prefix.layers)
@@ -441,9 +454,11 @@ class ContinuousBatcher:
         if self.block_size is not None:
             # the draft's pool has the same BLOCK COUNT (different shapes), so
             # one host allocation addresses both caches
-            d_cache = init_paged_cache(
-                draft_gen.module.config, self.slots, self.pool_blocks + 1, self.block_size,
-                self.max_blocks, kv_dtype=cfg.kv_cache_dtype, fill_block=self._scratch_block,
+            d_cache = draft_gen._place_paged_cache(
+                init_paged_cache(
+                    draft_gen.module.config, self.slots, self.pool_blocks + 1, self.block_size,
+                    self.max_blocks, kv_dtype=cfg.kv_cache_dtype, fill_block=self._scratch_block,
+                )
             )
             if self._shared_prefix_blocks:
                 d_cache = self._seed_shared_prefix(d_cache, self._draft_prefix.layers)
@@ -527,6 +542,32 @@ class ContinuousBatcher:
                 tokens, lengths, row_cache, row_valid, chunk, start=p0
             )
             tok0 = gen._first_token(gen.params, last, key, *cstate)
+        elif (
+            gen.config.sp_prefill
+            and gen.mesh is not None
+            and int(gen.mesh.shape.get("sequence", 1)) > 1
+            and chunk_aligned(bucket, int(gen.mesh.shape["sequence"])) <= self.cache_len
+        ):
+            # long-context admission: the batch-1 row prefills SEQUENCE-PARALLEL
+            # through the Generator's own ring/ulysses shard_map machinery
+            # (columns split over the sequence axis; data/fsdp axes are 1 by the
+            # mesh guard above), then the row pastes into the pool exactly like
+            # a dense admission — same numerics, same bounded compile set.
+            # When the sequence-aligned width would overflow the cache — a
+            # PREEMPTION RESUME's exact-width bucket can outgrow every
+            # configured bucket while fitting contiguously — the row falls
+            # through to the dense prefill below instead of failing the stream:
+            # dense and sp prefill are token-identical, so the resume stays
+            # invisible to the consumer (the contract docs/generation.md states)
+            seq = int(gen.mesh.shape["sequence"])
+            aligned = chunk_aligned(bucket, seq)
+            if aligned > bucket:
+                tokens = np.pad(tokens, ((0, 0), (0, aligned - bucket)), constant_values=cfg.pad_id)
+            if gen._sp_prefill_fn is None:
+                gen._sp_prefill_fn = gen._build_sp_prefill()
+            tok0, row_cache, _ = gen._sp_prefill_fn(
+                gen.params, jnp.asarray(tokens), lengths, row_cache, key, row_valid, *cstate
+            )
         else:
             tok0, row_cache, _ = gen._prefill(
                 gen.params, jnp.asarray(tokens), lengths, row_cache, key, row_valid, *cstate

@@ -142,9 +142,29 @@ def make_train_step(
         return single_step
 
     def accum_step(state: Any, batch: Any) -> Tuple[Any, Dict[str, jax.Array]]:
+        # Shardings pinned by fit() (see _pin_accum_shardings): the scan carry
+        # follows the param layout and the reshaped microbatch stack keeps the
+        # batch layout, instead of leaving both to partitioner inference. The
+        # round-4 "Involuntary full rematerialization" in this loop turned out
+        # to be the embed scatter-add (fixed at its root in layers.IotaEmbed);
+        # the pins make the intended layouts explicit so a future inference
+        # change cannot silently reintroduce a per-microbatch reshard — the
+        # dryrun asserts the SPMD log stays warning-free either way.
+        param_sh, micro_sh, micro_div = accum_step.pinned_shardings
+
+        def pin_grads(tree: Any) -> Any:
+            if param_sh is None:
+                return tree
+            return jax.lax.with_sharding_constraint(tree, param_sh)
+
         def split(leaf: jax.Array) -> jax.Array:
             b = leaf.shape[0]
-            return leaf.reshape((grad_accum_steps, b // grad_accum_steps) + leaf.shape[1:])
+            micro = leaf.reshape((grad_accum_steps, b // grad_accum_steps) + leaf.shape[1:])
+            # pin only when the microbatch dim divides evenly over the batch
+            # axes — the indivisible-final-batch fallback arrives replicated
+            if micro_sh is not None and micro.shape[1] % micro_div == 0:
+                micro = jax.lax.with_sharding_constraint(micro, micro_sh)
+            return micro
 
         microbatches = jax.tree_util.tree_map(split, batch)
 
@@ -155,17 +175,38 @@ def make_train_step(
             else:
                 loss, grads = grad_fn(state.params, microbatch)
                 aux = {}
-            grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
+            grads_acc = pin_grads(jax.tree_util.tree_map(jnp.add, grads_acc, grads))
             return (grads_acc, loss_acc + loss), aux
 
-        zeros = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+        zeros = pin_grads(jax.tree_util.tree_map(jnp.zeros_like, state.params))
         (grads, loss_sum), aux_stacked = jax.lax.scan(body, (zeros, jnp.zeros(())), microbatches)
         grads = jax.tree_util.tree_map(lambda g: g / grad_accum_steps, grads)
         new_state = state.apply_gradients(grads=grads)
         aux_mean = jax.tree_util.tree_map(lambda a: a.mean(axis=0), aux_stacked)
         return new_state, {"loss": loss_sum / grad_accum_steps, **aux_mean}
 
+    accum_step.pinned_shardings = (None, None, 1)
     return accum_step
+
+
+def _pin_accum_shardings(step_fn: Any, state_shardings: Any, mesh) -> None:
+    """If ``step_fn`` is a grad-accumulation step from :func:`make_train_step`,
+    pin its scan-carry gradient shardings to the param shardings and its
+    microbatch stack to ``P(None, *batch_spec)`` so the partitioner cannot
+    choose a conflicting layout inside the scan (re-read at each trace, so one
+    step_fn reused across fits on different meshes re-pins correctly)."""
+    if not hasattr(step_fn, "pinned_shardings"):
+        return
+    try:
+        param_sh = state_shardings.params
+    except AttributeError:  # state without a .params subtree: skip the carry pin
+        param_sh = None
+    from unionml_tpu.parallel.sharding import batch_axis_size
+
+    batch_sh = batch_sharding(mesh)
+    micro_spec = jax.sharding.PartitionSpec(None, *batch_sh.spec)
+    micro_sh = jax.sharding.NamedSharding(mesh, micro_spec)
+    step_fn.pinned_shardings = (param_sh, micro_sh, batch_axis_size(mesh))
 
 
 def _sync_fence(tree: Any) -> None:
@@ -234,6 +275,7 @@ def fit(
         state = unbox_partitioned(state)
         state = shard_pytree(state, state_shardings)
         batch_sh = batch_sharding(mesh)
+        _pin_accum_shardings(step_fn, state_shardings, mesh)
 
         donate = (0,) if (config.donate and not config.debug_disable_donation) else ()
         # batch in_sharding is left unconstrained: batches arrive pre-placed by the
