@@ -28,6 +28,16 @@ def _tiny():
     return module, params
 
 
+def _until_eos(row, eos):
+    """Solo-run rows pad past eos; streams stop at it — truncate for comparison."""
+    out = []
+    for t in row:
+        out.append(int(t))
+        if t == eos:
+            break
+    return out
+
+
 def _letters_cs(pattern):
     """a-z char vocab over the tiny model's 96 ids (last id = eos) + one
     compiled grammar — shared by the constraint-composition tests."""
@@ -231,15 +241,7 @@ def test_continuous_batching_constrained_over_tp_mesh():
     prompts = [[3, 1, 4, 1], [9, 2, 6], [7, 1]]
     gids = [1, 0, 1]
     plain = Generator(module, params, cfg)
-    solo = []
-    for p, g in zip(prompts, gids):
-        row = plain([p], constraint=g)[0].tolist()
-        out = []
-        for t in row:
-            out.append(t)
-            if t == eos:
-                break
-        solo.append(out)
+    solo = [_until_eos(plain([p], constraint=g)[0], eos) for p, g in zip(prompts, gids)]
 
     mesh = MeshSpec(data=1, model=2).build(jax.devices()[:2])
     tp_gen = Generator(module, params, cfg, mesh=mesh, partition_rules=llama_partition_rules())
@@ -302,6 +304,56 @@ def test_continuous_batching_sp_prefill_paged():
             [int(t) for chunk in s for t in np.asarray(chunk).ravel()] for s in streams
         ]
         assert results == expected
+    finally:
+        batcher.close()
+
+
+def test_everything_composes_over_tp_mesh():
+    """The unit-ring capstone (int8 weights + int8 KV + paged pool + shared
+    prefix + speculative + per-request grammars in one continuous engine) with
+    the LAST axis added: a tensor-parallel mesh. Every concurrent stream stays
+    token-exact against its solo run through the same maximal UNSHARDED engine."""
+    from unionml_tpu.models import DraftSpec
+    from unionml_tpu.serving import ContinuousBatcher
+
+    module, params = _tiny()
+    cs, eos = _letters_cs(r"[a-c]{2,6}")
+    draft_cfg = LlamaConfig.tiny(
+        vocab_size=96, dim=32, n_layers=1, n_heads=2, n_kv_heads=1, hidden_dim=64,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    draft = Llama(draft_cfg)
+    dp = draft.init(jax.random.PRNGKey(5), jnp.zeros((1, 8), jnp.int32))["params"]
+    cfg = GenerationConfig(
+        max_new_tokens=8, temperature=0.0, eos_id=eos, prompt_buckets=(8,),
+        kv_cache_dtype="int8", constraints=cs,
+        draft=DraftSpec(module=draft, params=dp, gamma=2),
+    )
+    prompts = [[3, 14, 15], [7, 7, 9], [1, 2]]
+    gids = [1, 0, 1]
+
+    plain = Generator(module, params, cfg, quantize="int8")
+    plain_prefix = plain.cache_prefix([11, 12, 13, 14])
+    solo = [
+        _until_eos(plain([p], constraint=g, prefix=plain_prefix)[0], eos)
+        for p, g in zip(prompts, gids)
+    ]
+
+    mesh = MeshSpec(data=1, model=2).build(jax.devices()[:2])
+    tp_gen = Generator(
+        module, params, cfg, mesh=mesh, partition_rules=llama_partition_rules(),
+        quantize="int8",
+    )
+    tp_prefix = tp_gen.cache_prefix([11, 12, 13, 14])
+    batcher = ContinuousBatcher(
+        tp_gen, slots=2, decode_chunk=2, prefix=tp_prefix, block_size=4
+    )
+    try:
+        streams = [batcher.submit(p, constraint=g) for p, g in zip(prompts, gids)]
+        results = [
+            [int(t) for chunk in s for t in np.asarray(chunk).ravel()] for s in streams
+        ]
+        assert results == solo
     finally:
         batcher.close()
 
