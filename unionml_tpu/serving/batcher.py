@@ -63,6 +63,26 @@ class ServingConfig:
         return sizes
 
 
+def _signature(features: Any) -> Any:
+    """Concat-compatibility key: only like-shaped parts may share a batch.
+    Types ``_concat`` cannot merge get a per-object key, so they NEVER share a
+    batch — each rides the single-request path with exact solo semantics."""
+    try:
+        import pandas as pd
+
+        if isinstance(features, pd.DataFrame):
+            return ("df", tuple(features.columns))
+    except ImportError:  # pragma: no cover
+        pass
+    import numpy as np
+
+    if isinstance(features, np.ndarray):
+        return ("nd", features.shape[1:], str(features.dtype))
+    if isinstance(features, list):
+        return ("list",)
+    return ("other", id(features))
+
+
 def _num_rows(features: Any) -> int:
     try:
         return len(features)
@@ -116,6 +136,11 @@ class MicroBatcher:
         self._queue: "asyncio.Queue[Tuple[Any, int, asyncio.Future]]" = asyncio.Queue()
         self._worker: Optional[asyncio.Task] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
+        #: None until the first coalesced dispatch proves the predictor's
+        #: output row-aligned (splittable per request); False pins the solo
+        #: path so a structured-output predictor never pays a doomed combined
+        #: call more than once
+        self._row_aligned: Optional[bool] = None
 
     def _padding_active(self) -> bool:
         if callable(self._pad_to_bucket):
@@ -160,10 +185,12 @@ class MicroBatcher:
         return await future
 
     async def _run(self) -> None:
+        pending: "Optional[Tuple[Any, int, asyncio.Future]]" = None
         while True:
-            features, n, future = await self._queue.get()
-            batch = [(features, n, future)]
-            total = n
+            first = pending if pending is not None else await self._queue.get()
+            pending = None
+            batch = [first]
+            total = first[1]
             deadline = asyncio.get_event_loop().time() + self.config.max_wait_ms / 1000.0
             while total < self.config.max_batch_size:
                 timeout = deadline - asyncio.get_event_loop().time()
@@ -173,29 +200,113 @@ class MicroBatcher:
                     item = await asyncio.wait_for(self._queue.get(), timeout)
                 except asyncio.TimeoutError:
                     break
+                if _signature(item[0]) != _signature(first[0]):
+                    # concatenating mismatched column sets / row shapes would
+                    # silently produce a NaN-unioned frame; dispatch what we
+                    # have and start the next batch from the odd one out
+                    pending = item
+                    break
                 batch.append(item)
                 total += item[1]
 
-            parts = [b[0] for b in batch]
-            sizes = [b[1] for b in batch]
-            futures = [b[2] for b in batch]
-            try:
-                combined = _concat(parts)
-                if self._padding_active() and total > 0:
-                    # above the largest bucket we leave the batch unpadded: inventing
-                    # k*largest shapes would defeat the bounded-shape goal, and a
-                    # downstream CompiledPredictor chunks oversized batches itself
-                    bucket = next((b for b in self.config.buckets() if b >= total), None)
-                    if bucket is not None:
-                        from unionml_tpu.serving.compile import pad_rows
+            await self._dispatch(batch, total)
 
-                        combined = pad_rows(combined, bucket)
-                # run the (potentially blocking) TPU dispatch off the event loop
-                result = await asyncio.get_event_loop().run_in_executor(None, self._predict_fn, combined)
-                for fut, piece in zip(futures, _split(result, sizes)):
+    async def _dispatch(self, batch: List[Tuple[Any, int, asyncio.Future]], total: int) -> None:
+        parts = [b[0] for b in batch]
+        sizes = [b[1] for b in batch]
+        futures = [b[2] for b in batch]
+        loop = asyncio.get_event_loop()
+        try:
+            if len(batch) == 1 and not self._padding_active():
+                # single unpadded request: hand the predictor's output through
+                # whole — identical semantics to serving without a batcher, so
+                # non-row-aligned predictors (aggregates, dicts) keep working.
+                # With padding active even a solo request takes the padded
+                # path below, preserving the bounded-shape invariant ("the
+                # predictor sees only bucket shapes even on the eager path")
+                result = await loop.run_in_executor(None, self._predict_fn, parts[0])
+                if not futures[0].done():
+                    futures[0].set_result(result)
+                return
+            if self._row_aligned is False:
+                # proven structured-output predictor: skip the doomed combined
+                # call entirely, dispatch each request solo
+                for (features, _, fut) in batch:
+                    solo = await loop.run_in_executor(None, self._predict_fn, features)
                     if not fut.done():
-                        fut.set_result(piece)
-            except Exception as exc:  # propagate the batch failure to every caller
-                for fut in futures:
+                        fut.set_result(solo)
+                return
+            combined = _concat(parts)
+            if self._padding_active() and total > 0:
+                # above the largest bucket we leave the batch unpadded: inventing
+                # k*largest shapes would defeat the bounded-shape goal, and a
+                # downstream CompiledPredictor chunks oversized batches itself
+                bucket = next((b for b in self.config.buckets() if b >= total), None)
+                if bucket is not None:
+                    from unionml_tpu.serving.compile import pad_rows
+
+                    combined = pad_rows(combined, bucket)
+            # run the (potentially blocking) TPU dispatch off the event loop
+            result = await loop.run_in_executor(None, self._predict_fn, combined)
+            pieces = self._try_split(result, sizes, total)
+            if pieces is None:
+                # the predictor's output is not row-aligned (wrong length, or
+                # not a row-major container): coalescing is unsafe for this
+                # app — rerun each request individually, exact solo semantics,
+                # and pin the solo path for every later batch
+                self._row_aligned = False
+                for (features, _, fut) in batch:
+                    solo = await loop.run_in_executor(None, self._predict_fn, features)
                     if not fut.done():
-                        fut.set_exception(exc)
+                        fut.set_result(solo)
+                return
+            self._row_aligned = True
+            for fut, piece in zip(futures, pieces):
+                if not fut.done():
+                    fut.set_result(piece)
+        except Exception as exc:  # propagate the batch failure to every caller
+            for fut in futures:
+                if not fut.done():
+                    fut.set_exception(exc)
+
+    @staticmethod
+    def _row_major(result: Any) -> bool:
+        """Only containers whose ``[lo:hi]`` slice means "these rows" may be
+        split per request — a tuple/dict/str of coincidentally-matching length
+        (e.g. ``(predictions, probabilities)`` from a 2-row batch) must not be
+        sliced across callers."""
+        if isinstance(result, (list,)):
+            return True
+        try:
+            import pandas as pd
+
+            if isinstance(result, (pd.DataFrame, pd.Series)):
+                return True
+        except ImportError:  # pragma: no cover
+            pass
+        import numpy as np
+
+        if isinstance(result, np.ndarray):
+            return True
+        try:
+            import jax
+
+            if isinstance(result, jax.Array):
+                return True
+        except ImportError:  # pragma: no cover
+            pass
+        return False
+
+    def _try_split(self, result: Any, sizes: List[int], total: int) -> Optional[List[Any]]:
+        if not self._row_major(result):
+            return None
+        padded = self._padding_active()
+        n = len(result)
+        # padding legitimately returns bucket-many rows (>= total); without it
+        # the row count must match exactly for per-request slices to be valid
+        if (padded and n < total) or (not padded and n != total):
+            return None
+        try:
+            return _split(result, sizes)
+        except TypeError:
+            return None
