@@ -90,6 +90,15 @@ def test_fit_grad_accumulation():
     step = make_train_step(_loss(module), grad_accum_steps=4)
     result = fit(state, step, _make_data(), TrainerConfig(epochs=2, batch_size=128, mesh=MeshSpec(data=-1)))
     assert result.history[-1]["loss"] < 0.5
+    # fit pinned the scan-carry/microbatch layouts (driver._pin_accum_shardings):
+    # the grads carry follows the param shardings, the microbatch stack keeps
+    # the batch layout with a leading accum dim, and the divisor counts the
+    # batch-axis shards — the explicit layouts the dryrun's warning-free SPMD
+    # assertion depends on
+    param_sh, micro_sh, micro_div = step.pinned_shardings
+    assert param_sh is not None and micro_sh is not None
+    assert micro_sh.spec[0] is None  # accum dim replicated
+    assert micro_div == 8  # data=-1 on 8 emulated devices
 
 
 def test_fit_fsdp_shards_params():

@@ -191,6 +191,62 @@ def test_micro_batcher_unconcatenatable_features_never_share_a_batch():
     assert r1 == {"n": 2} and r2 == {"n": 10}
 
 
+def test_micro_batcher_same_unconcatenatable_object_dispatches_solo():
+    """A SHARED object (a memoized dict reused across requests) has
+    identity-equal signatures, so it CAN share a batch — the failed concat must
+    then degrade to solo dispatches, never a batched 500."""
+    shared = {"n": 4}
+
+    def predict(features):
+        return {"n": features["n"] * 2}
+
+    async def scenario():
+        batcher = MicroBatcher(predict, ServingConfig(max_batch_size=8, max_wait_ms=50, pad_to_bucket=False))
+        return await asyncio.gather(batcher.submit(shared), batcher.submit(shared))
+
+    r1, r2 = asyncio.run(scenario())
+    assert r1 == {"n": 8} and r2 == {"n": 8}
+
+
+def test_micro_batcher_ragged_list_rows_never_share_a_concat():
+    """List features whose rows have different widths must not concatenate
+    (the predictor would see a ragged batch): the width rides the signature."""
+    def predict(batch):
+        widths = {len(r) for r in batch}
+        assert len(widths) == 1, f"ragged batch reached the predictor: {widths}"
+        return [sum(r) for r in batch]
+
+    async def scenario():
+        batcher = MicroBatcher(predict, ServingConfig(max_batch_size=8, max_wait_ms=50, pad_to_bucket=False))
+        return await asyncio.gather(
+            batcher.submit([[1, 2]]), batcher.submit([[3, 4, 5]]), batcher.submit([[6, 7]])
+        )
+
+    r1, r2, r3 = asyncio.run(scenario())
+    assert (r1, r2, r3) == ([3], [12], [13])
+
+
+def test_micro_batcher_stats_count_solo_reruns_as_dispatches():
+    """avg_rows_per_dispatch must reflect REALIZED vectorization: an app pinned
+    to the solo path reads ~1 row per predictor invocation, not its batch size."""
+    def predict(batch):
+        return float(sum(batch))  # non-row-aligned: pins the solo path
+
+    async def scenario():
+        batcher = MicroBatcher(predict, ServingConfig(max_batch_size=8, max_wait_ms=50, pad_to_bucket=False))
+        await asyncio.gather(batcher.submit([1]), batcher.submit([2]))  # detection round
+        await asyncio.gather(batcher.submit([3]), batcher.submit([4]))  # pinned round
+        return batcher.stats()
+
+    stats = asyncio.run(scenario())
+    assert stats["row_aligned"] is False
+    assert stats["requests"] == 4 and stats["rows"] == 4
+    # >= one invocation per request (plus the one doomed detection call):
+    # avg rows/dispatch stays ~1, never inflated by counted-but-absent batching
+    assert stats["dispatches"] >= 4
+    assert stats["avg_rows_per_dispatch"] <= 1.0
+
+
 def test_serving_app_batches_by_default(sklearn_model):
     """Predictors registered without a ServingConfig still get a MicroBatcher
     (measured ~2x on the digits quickstart under 16-way concurrency); a
@@ -201,6 +257,20 @@ def test_serving_app_batches_by_default(sklearn_model):
     assert app.batcher is not None
     assert app.batcher.config.max_batch_size > 1
     assert app.batcher.config.warmup is False  # no config -> no AOT machinery
+
+
+def test_metrics_reports_micro_batcher_telemetry(trained_app):
+    """The coalescing lever is observable: /metrics carries dispatch/request/
+    row counters and the row-alignment pin state."""
+    body = json.dumps({"features": [{"x1": 1.0, "x2": 1.0}]}).encode()
+    for _ in range(3):
+        status, _, _ = _dispatch(trained_app, "POST", "/predict", body)
+        assert status == 200
+    status, payload, _ = _dispatch(trained_app, "GET", "/metrics")
+    assert status == 200
+    mb = payload["micro_batcher"]
+    assert mb["dispatches"] >= 1 and mb["requests"] >= mb["dispatches"]
+    assert set(mb) == {"dispatches", "requests", "rows", "avg_rows_per_dispatch", "row_aligned"}
 
 
 def test_serving_config_max_batch_size_one_disables_the_batcher(sklearn_model):

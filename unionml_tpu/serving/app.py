@@ -203,6 +203,11 @@ class ServingApp:
         batcher = getattr(self.model, "generation_batcher", None)
         if batcher is not None and hasattr(batcher, "stats"):
             snapshot["generation"] = batcher.stats()
+        if self.batcher is not None:
+            # coalescing effectiveness is the serving-throughput lever — make
+            # it observable (avg rows per dispatch -> how much of the
+            # vectorization win concurrency is actually realizing)
+            snapshot["micro_batcher"] = self.batcher.stats()
         return 200, snapshot, "application/json"
 
     async def _predict(self, body: bytes):

@@ -65,8 +65,10 @@ class ServingConfig:
 
 def _signature(features: Any) -> Any:
     """Concat-compatibility key: only like-shaped parts may share a batch.
-    Types ``_concat`` cannot merge get a per-object key, so they NEVER share a
-    batch — each rides the single-request path with exact solo semantics."""
+    Types ``_concat`` cannot merge get a per-object key so they rarely share a
+    batch — and the dispatch path additionally treats a failed concat as
+    "dispatch solo", so even identity-equal unconcatenatable objects never
+    turn into a batched 500."""
     try:
         import pandas as pd
 
@@ -79,7 +81,14 @@ def _signature(features: Any) -> Any:
     if isinstance(features, np.ndarray):
         return ("nd", features.shape[1:], str(features.dtype))
     if isinstance(features, list):
-        return ("list",)
+        # rows of different widths must not share a concat (the ndarray
+        # branch's shape[1:] guard, for the list-of-rows spelling)
+        if not features:
+            return ("list", "empty")
+        row = features[0]
+        if isinstance(row, (list, tuple)):
+            return ("list", "row-len", len(row))
+        return ("list", "scalar", type(row).__name__)
     return ("other", id(features))
 
 
@@ -141,6 +150,11 @@ class MicroBatcher:
         #: path so a structured-output predictor never pays a doomed combined
         #: call more than once
         self._row_aligned: Optional[bool] = None
+        #: /metrics telemetry: predictor dispatches vs requests/rows coalesced
+        #: into them (avg rows per dispatch = the realized vectorization win)
+        self.dispatches = 0
+        self.batched_requests = 0
+        self.batched_rows = 0
 
     def _padding_active(self) -> bool:
         if callable(self._pad_to_bucket):
@@ -191,6 +205,7 @@ class MicroBatcher:
             pending = None
             batch = [first]
             total = first[1]
+            first_sig = _signature(first[0])
             deadline = asyncio.get_event_loop().time() + self.config.max_wait_ms / 1000.0
             while total < self.config.max_batch_size:
                 timeout = deadline - asyncio.get_event_loop().time()
@@ -200,7 +215,7 @@ class MicroBatcher:
                     item = await asyncio.wait_for(self._queue.get(), timeout)
                 except asyncio.TimeoutError:
                     break
-                if _signature(item[0]) != _signature(first[0]):
+                if _signature(item[0]) != first_sig:
                     # concatenating mismatched column sets / row shapes would
                     # silently produce a NaN-unioned frame; dispatch what we
                     # have and start the next batch from the odd one out
@@ -211,33 +226,67 @@ class MicroBatcher:
 
             await self._dispatch(batch, total)
 
+    def stats(self) -> dict:
+        """Coalescing telemetry for ``GET /metrics``. ``dispatches`` counts
+        PREDICTOR INVOCATIONS (solo reruns included), so
+        ``avg_rows_per_dispatch`` is the realized vectorization win — an app
+        pinned to the solo path honestly reads ~1.0, not its batch size."""
+        return {
+            "dispatches": self.dispatches,
+            "requests": self.batched_requests,
+            "rows": self.batched_rows,
+            "avg_rows_per_dispatch": round(self.batched_rows / self.dispatches, 2)
+            if self.dispatches
+            else 0.0,
+            "row_aligned": self._row_aligned,
+        }
+
+    async def _call_predictor(self, features: Any) -> Any:
+        self.dispatches += 1
+        return await asyncio.get_event_loop().run_in_executor(None, self._predict_fn, features)
+
+    async def _solo_all(self, batch: List[Tuple[Any, int, asyncio.Future]]) -> None:
+        for (features, _, fut) in batch:
+            solo = await self._call_predictor(features)
+            if not fut.done():
+                fut.set_result(solo)
+
     async def _dispatch(self, batch: List[Tuple[Any, int, asyncio.Future]], total: int) -> None:
         parts = [b[0] for b in batch]
         sizes = [b[1] for b in batch]
         futures = [b[2] for b in batch]
-        loop = asyncio.get_event_loop()
+        self.batched_requests += len(batch)
+        self.batched_rows += total
+        # Padding-active configs predate default-on batching and keep their
+        # original contract exactly: concat -> pad to bucket -> split (an app
+        # that opted into bucket padding is declaring row-aligned outputs).
+        # The detection/fallback safety below exists for the DEFAULT batcher,
+        # where the app never opted into anything.
+        strict = not self._padding_active()
         try:
-            if len(batch) == 1 and not self._padding_active():
-                # single unpadded request: hand the predictor's output through
-                # whole — identical semantics to serving without a batcher, so
-                # non-row-aligned predictors (aggregates, dicts) keep working.
-                # With padding active even a solo request takes the padded
-                # path below, preserving the bounded-shape invariant ("the
-                # predictor sees only bucket shapes even on the eager path")
-                result = await loop.run_in_executor(None, self._predict_fn, parts[0])
+            if strict and len(batch) == 1:
+                # single request: hand the predictor's output through whole —
+                # identical semantics to serving without a batcher, so
+                # non-row-aligned predictors (aggregates, dicts) keep working
+                result = await self._call_predictor(parts[0])
                 if not futures[0].done():
                     futures[0].set_result(result)
                 return
-            if self._row_aligned is False:
+            if strict and self._row_aligned is False:
                 # proven structured-output predictor: skip the doomed combined
                 # call entirely, dispatch each request solo
-                for (features, _, fut) in batch:
-                    solo = await loop.run_in_executor(None, self._predict_fn, features)
-                    if not fut.done():
-                        fut.set_result(solo)
+                await self._solo_all(batch)
                 return
-            combined = _concat(parts)
-            if self._padding_active() and total > 0:
+            try:
+                combined = _concat(parts)
+            except TypeError:
+                if strict:
+                    # unconcatenatable feature type (identity-equal objects
+                    # can even share a signature): solo semantics, not a 500
+                    await self._solo_all(batch)
+                    return
+                raise
+            if not strict and total > 0:
                 # above the largest bucket we leave the batch unpadded: inventing
                 # k*largest shapes would defeat the bounded-shape goal, and a
                 # downstream CompiledPredictor chunks oversized batches itself
@@ -247,20 +296,20 @@ class MicroBatcher:
 
                     combined = pad_rows(combined, bucket)
             # run the (potentially blocking) TPU dispatch off the event loop
-            result = await loop.run_in_executor(None, self._predict_fn, combined)
-            pieces = self._try_split(result, sizes, total)
-            if pieces is None:
-                # the predictor's output is not row-aligned (wrong length, or
-                # not a row-major container): coalescing is unsafe for this
-                # app — rerun each request individually, exact solo semantics,
-                # and pin the solo path for every later batch
-                self._row_aligned = False
-                for (features, _, fut) in batch:
-                    solo = await loop.run_in_executor(None, self._predict_fn, features)
-                    if not fut.done():
-                        fut.set_result(solo)
-                return
-            self._row_aligned = True
+            result = await self._call_predictor(combined)
+            if strict:
+                pieces = self._try_split(result, sizes, total)
+                if pieces is None:
+                    # the predictor's output is not row-aligned (wrong length,
+                    # or not a row-major container): coalescing is unsafe for
+                    # this app — rerun each request individually, exact solo
+                    # semantics, and pin the solo path for every later batch
+                    self._row_aligned = False
+                    await self._solo_all(batch)
+                    return
+                self._row_aligned = True
+            else:
+                pieces = _split(result, sizes)
             for fut, piece in zip(futures, pieces):
                 if not fut.done():
                     fut.set_result(piece)
@@ -298,13 +347,9 @@ class MicroBatcher:
         return False
 
     def _try_split(self, result: Any, sizes: List[int], total: int) -> Optional[List[Any]]:
-        if not self._row_major(result):
-            return None
-        padded = self._padding_active()
-        n = len(result)
-        # padding legitimately returns bucket-many rows (>= total); without it
-        # the row count must match exactly for per-request slices to be valid
-        if (padded and n < total) or (not padded and n != total):
+        """Strict-mode split: the unpadded row count must match exactly and the
+        container must be row-major for per-request slices to be valid."""
+        if not self._row_major(result) or len(result) != total:
             return None
         try:
             return _split(result, sizes)
