@@ -103,6 +103,46 @@ def test_dataset_fast_path_matches_python_path():
     np.testing.assert_allclose(via_loader.to_numpy(), slow.to_numpy(), atol=1e-6)
 
 
+def test_dataset_fast_path_schema_cache_is_correct_and_bounded():
+    """The per-column-tuple schema cache (the serving hot-loop win, ~5x on the
+    dispatch path) must be invisible: repeated and alternating column sets give
+    the same frames as the uncached first call, missing feature columns still
+    bail to the Python path, and hostile ragged schemas cannot grow the cache
+    unboundedly."""
+    dataset, _ = _digits_like_app()
+    with_target = json.dumps([{"x1": 1.0, "x2": 2.0, "y": 1}]).encode()
+    only_features = json.dumps([{"x1": 3.0, "x2": 4.0}]).encode()
+
+    for _ in range(3):  # alternate: both schemas stay cached and correct
+        f1, _ = dataset.get_features_from_bytes(with_target)
+        assert list(f1.columns) == ["x1", "x2"] and f1.to_numpy().tolist() == [[1.0, 2.0]]
+        f2, _ = dataset.get_features_from_bytes(only_features)
+        assert list(f2.columns) == ["x1", "x2"] and f2.to_numpy().tolist() == [[3.0, 4.0]]
+    assert len(dataset._native_schema_cache) == 2
+
+    # explicit features list with a column the wire lacks: decline (cached misses
+    # must not mask the Python path's error)
+    dataset._features = ["x1", "missing"]
+    dataset._native_schema_cache.clear()
+    assert dataset.get_features_from_bytes(only_features) is None
+    dataset._features = []
+
+    # the cache is capped: 100 distinct schemas leave <= 64 entries behind
+    dataset._native_schema_cache.clear()
+    for i in range(100):
+        payload = json.dumps([{f"c{i}": 1.0, "x1": 2.0}]).encode()
+        dataset.get_features_from_bytes(payload)
+    assert len(dataset._native_schema_cache) <= 64
+
+    # oversized schemas are served but never retained (a 64 MB body can carry
+    # ~1M distinct column names; caching it would pin that memory forever)
+    dataset._native_schema_cache.clear()
+    wide = json.dumps([{f"w{i}": float(i) for i in range(5000)}]).encode()
+    out = dataset.get_features_from_bytes(wide)
+    assert out is not None and out[0].shape == (1, 5000)
+    assert len(dataset._native_schema_cache) == 0
+
+
 def test_dataset_fast_path_declines_custom_pipeline():
     dataset, _ = _digits_like_app()
 

@@ -83,6 +83,10 @@ class Dataset:
         self._feature_transformer: Callable = self._default_feature_transformer
         self._parser_feature_key: int = 0
 
+        #: native fast path: resolved (Index, numpy selection) per wire column
+        #: tuple — see get_features_from_bytes
+        self._native_schema_cache: Dict[tuple, tuple] = {}
+
         self._reader_stage_kwargs: Dict[str, Any] = {}
         self._reader_input_types: Optional[List[Parameter]] = None
         self._dataset_datatype: Optional[Dict[str, Type]] = None
@@ -291,21 +295,49 @@ class Dataset:
         if parsed is None:
             return None
         matrix, columns, consumed = parsed
-        frame = pd.DataFrame(matrix, columns=columns, copy=False)
-        feature_names = self._feature_column_names(frame)
-        if feature_names:
-            if any(name not in frame.columns for name in feature_names):
-                return None  # missing feature columns: let the Python path raise its error
-            frame = frame[feature_names]
-        return frame, consumed
+        # Serving hot loop: requests overwhelmingly repeat one column set, and
+        # re-validating + re-selecting through pandas per request (Index
+        # construction, per-name __contains__, frame[names]) measurably
+        # dominates the request. Cache the resolved schema per column tuple:
+        # a cached Index makes DataFrame construction a thin block wrap, and
+        # the selection happens on the numpy side (or not at all, the common
+        # clients-send-exactly-the-features case).
+        key = tuple(columns)
+        cached = self._native_schema_cache.get(key)
+        if cached is None:
+            feature_names = self._feature_column_names_for(columns)
+            if feature_names:
+                colset = set(columns)
+                if any(name not in colset for name in feature_names):
+                    return None  # missing feature columns: let the Python path raise its error
+                sel = [columns.index(n) for n in feature_names]
+                if sel == list(range(len(columns))):
+                    sel = None  # identity: feature_names == columns element-wise
+                cached = (pd.Index(feature_names), sel)
+            else:
+                cached = (pd.Index(columns), None)
+            # hostile clients must not grow the cache unboundedly (entry count)
+            # nor pin gigabytes of column-name strings (entry size: a 64 MB
+            # body can carry ~1M distinct names — serve it, don't retain it)
+            if len(columns) <= 4096:
+                if len(self._native_schema_cache) >= 64:
+                    self._native_schema_cache.clear()
+                self._native_schema_cache[key] = cached
+        index, sel = cached
+        if sel is not None:
+            matrix = matrix[:, sel]
+        return pd.DataFrame(matrix, columns=index, copy=False), consumed
 
     def _feature_column_names(self, frame: "pd.DataFrame") -> "Optional[List[str]]":
         """Feature columns for a frame: explicit ``features`` list, else everything
         minus the targets. Single source of truth for both the Python default
         feature loader and the native fast path."""
+        return self._feature_column_names_for(frame.columns)
+
+    def _feature_column_names_for(self, columns) -> "Optional[List[str]]":
         feature_names = self._features
         if not feature_names and self._targets is not None:
-            feature_names = [col for col in frame.columns if col not in self._targets]
+            feature_names = [col for col in columns if col not in self._targets]
         return feature_names
 
     def iterator(
