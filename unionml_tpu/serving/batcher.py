@@ -6,7 +6,10 @@ batch-1 dispatch occupies the whole MXU. The batcher coalesces concurrent reques
 
 1. each request's features enqueue with a future,
 2. a collector drains the queue until ``max_batch_size`` rows or ``max_wait_ms``
-   elapse (first-come request never waits longer than the window),
+   elapse (first-come request never waits longer than the window); the window
+   is ADAPTIVE — with an empty queue and no recent coalescing, a solo request
+   dispatches immediately, so sparse traffic pays ~zero added latency while
+   any sign of concurrency re-arms the full wait,
 3. one predictor call runs on the concatenated batch,
 4. per-request slices of the output resolve the futures.
 
@@ -200,29 +203,41 @@ class MicroBatcher:
 
     async def _run(self) -> None:
         pending: "Optional[Tuple[Any, int, asyncio.Future]]" = None
+        coalesced_last = False
         while True:
             first = pending if pending is not None else await self._queue.get()
             pending = None
             batch = [first]
             total = first[1]
-            first_sig = _signature(first[0])
-            deadline = asyncio.get_event_loop().time() + self.config.max_wait_ms / 1000.0
-            while total < self.config.max_batch_size:
-                timeout = deadline - asyncio.get_event_loop().time()
-                if timeout <= 0:
-                    break
-                try:
-                    item = await asyncio.wait_for(self._queue.get(), timeout)
-                except asyncio.TimeoutError:
-                    break
-                if _signature(item[0]) != first_sig:
-                    # concatenating mismatched column sets / row shapes would
-                    # silently produce a NaN-unioned frame; dispatch what we
-                    # have and start the next batch from the odd one out
-                    pending = item
-                    break
-                batch.append(item)
-                total += item[1]
+            # Adaptive wait: the max_wait_ms window only pays off when there is
+            # concurrency to coalesce. If the queue is empty AND the previous
+            # dispatch was solo, dispatch immediately — sparse traffic then
+            # pays zero added latency, while any sign of concurrency (queued
+            # requests now, or a coalesced previous batch whose clients are
+            # about to come back) re-arms the full window.
+            if not self._queue.empty() or coalesced_last:
+                first_sig = _signature(first[0])
+                deadline = asyncio.get_event_loop().time() + self.config.max_wait_ms / 1000.0
+                while total < self.config.max_batch_size:
+                    timeout = deadline - asyncio.get_event_loop().time()
+                    if timeout <= 0:
+                        break
+                    try:
+                        item = await asyncio.wait_for(self._queue.get(), timeout)
+                    except asyncio.TimeoutError:
+                        break
+                    if _signature(item[0]) != first_sig:
+                        # concatenating mismatched column sets / row shapes would
+                        # silently produce a NaN-unioned frame; dispatch what we
+                        # have and start the next batch from the odd one out
+                        pending = item
+                        break
+                    batch.append(item)
+                    total += item[1]
+            # a pending signature-mismatch handoff is itself direct evidence of
+            # concurrency: the odd one out must re-arm the window or steady
+            # mixed-schema traffic would pin one schema to solo dispatches
+            coalesced_last = len(batch) > 1 or pending is not None
 
             await self._dispatch(batch, total)
 
