@@ -307,10 +307,10 @@ class Dataset:
         if cached is None:
             feature_names = self._feature_column_names_for(columns)
             if feature_names:
-                colset = set(columns)
-                if any(name not in colset for name in feature_names):
+                position = {c: i for i, c in enumerate(columns)}
+                if any(name not in position for name in feature_names):
                     return None  # missing feature columns: let the Python path raise its error
-                sel = [columns.index(n) for n in feature_names]
+                sel = [position[n] for n in feature_names]
                 if sel == list(range(len(columns))):
                     sel = None  # identity: feature_names == columns element-wise
                 cached = (pd.Index(feature_names), sel)

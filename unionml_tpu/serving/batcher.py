@@ -339,7 +339,7 @@ class MicroBatcher:
         split per request — a tuple/dict/str of coincidentally-matching length
         (e.g. ``(predictions, probabilities)`` from a 2-row batch) must not be
         sliced across callers."""
-        if isinstance(result, (list,)):
+        if isinstance(result, list):
             return True
         try:
             import pandas as pd
