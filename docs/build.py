@@ -233,8 +233,11 @@ def build_site(out_dir: Path) -> List[Path]:
     for page in pages:
         source = page.read_text()
         body = render_markdown(source)
+        # NOTE: the active-class marker must stay out of the f-string expression —
+        # a backslash inside one is a SyntaxError before Python 3.12
+        active_attr = ' class="active"'
         nav = "\n".join(
-            f'<a href="{href}"{" class=\"active\"" if href == page.stem + ".html" else ""}>{html.escape(label)}</a>'
+            f'<a href="{href}"{active_attr if href == page.stem + ".html" else ""}>{html.escape(label)}</a>'
             for href, label in nav_links
         )
         target = out_dir / f"{page.stem}.html"

@@ -53,6 +53,9 @@ MODULES = [
     "unionml_tpu.serving.batcher",
     "unionml_tpu.serving.compile",
     "unionml_tpu.serving.continuous",
+    "unionml_tpu.serving.http",
+    "unionml_tpu.serving.metrics",
+    "unionml_tpu.serving.overload",
     "unionml_tpu.serving.serverless",
     "unionml_tpu.artifact",
     "unionml_tpu.remote",

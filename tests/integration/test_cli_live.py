@@ -12,6 +12,8 @@ import sys
 import time
 import urllib.request
 
+import pytest
+
 
 @contextlib.contextmanager
 def _served(args, cwd, env, log_path, startup_s):
@@ -85,6 +87,8 @@ def test_serve_workers_flag_boots_multiprocess_server(cli_project, tmp_path):
                 assert len(json.loads(resp.read())) == 1
 
 
+@pytest.mark.slow  # subprocess train + serve boot, ~19s; the same stack is
+# covered in-process by test_templates.py's text-generation end-to-end test
 def test_serve_text_generation_template_with_grammar(tmp_path):
     """The full generation stack through the CLI: render the text-generation
     template, train + save in a subprocess, boot ``unionml-tpu serve``, and

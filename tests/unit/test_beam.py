@@ -30,6 +30,7 @@ def brute_force_best(module, params, prompt, steps, vocab):
     return list(best), best_score
 
 
+@pytest.mark.slow  # brute-force V^steps oracle, ~27s — outside the tier-1 budget
 def test_full_width_beam_equals_exhaustive_search(micro_lm):
     module, params, config = micro_lm
     steps, vocab = 3, config.vocab_size

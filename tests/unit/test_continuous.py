@@ -762,3 +762,84 @@ def test_warmup_compiles_every_bucket_then_serves_exactly(tiny_gen):
         assert gen.decode_traces == decode_traces  # decode chunk pre-compiled
     finally:
         batcher.close()
+
+
+def test_overload_admission_deadline_and_disconnect(tiny_gen, sklearn_model):
+    """Engine-level overload protection, one batcher for all three properties
+    (a fresh Generator per property would triple the XLA compile bill):
+
+    1. ``max_waiting`` bounds the slot-wait queue — the excess submission sheds
+       synchronously with QueueFullError (the HTTP layer's 429).
+    2. A waiter whose deadline passes while queued is shed with
+       DeadlineExceeded at the next chunk boundary, never paying a prefill.
+    3. A streaming client that disconnects mid-decode (the /predict-stream
+       route's aclose path) frees its slot within one decode chunk — pinned
+       against ``stats()['resident']`` — and the slot admits new work.
+    """
+    import asyncio
+    import json
+    import time
+
+    from unionml_tpu.serving import DeadlineExceeded, QueueFullError, serving_app
+    from unionml_tpu.serving.overload import QueueFullError as QFE
+
+    assert QFE is QueueFullError  # one exception type across layers
+
+    module, params = tiny_gen
+    cfg = GenerationConfig(max_new_tokens=256, temperature=0.0, prompt_buckets=(16,))
+    batcher = ContinuousBatcher(
+        Generator(module, params, cfg), slots=1, decode_chunk=2, max_waiting=2
+    )
+    try:
+        # ---- 1+2: bound the waiting queue and shed the expired waiter
+        occupant = batcher.submit(PROMPTS[0])  # 256-token budget: owns the slot
+        next(occupant)  # first token: resident now
+        deadline = time.time() + 30
+        while time.time() < deadline and batcher.stats()["waiting"]:
+            time.sleep(0.01)
+        doomed = batcher.submit(PROMPTS[1], deadline=time.monotonic() + 0.02)
+        waiter = batcher.submit(PROMPTS[3], max_new_tokens=4)
+        with pytest.raises(QueueFullError, match="waiting queue full"):
+            batcher.submit(PROMPTS[4])  # 3rd waiter > max_waiting=2
+        assert batcher.stats()["shed_queue_full"] == 1
+        time.sleep(0.05)  # doomed's deadline passes while it waits
+        with pytest.raises(DeadlineExceeded):
+            _drain(doomed)
+        assert batcher.stats()["shed_deadline"] == 1
+        _drain(occupant)  # release the slot; waiter decodes next
+        assert len(_drain(waiter)) == 4
+
+        # ---- 3: route-level disconnect frees the slot within one chunk
+        sklearn_model.train(hyperparameters={"max_iter": 200})
+
+        @sklearn_model.stream_predictor
+        def stream_predictor(model_object, features):
+            for chunk in batcher.submit([3, 1, 4, 1, 5]):
+                yield chunk.tolist()
+
+        sklearn_model.generation_batcher = batcher
+        app = serving_app(sklearn_model)
+
+        async def scenario():
+            status, payload, _ = await app.dispatch(
+                "POST", "/predict-stream", json.dumps({"features": [{"x": 1.0}]}).encode()
+            )
+            assert status == 200
+            agen = payload.__aiter__()
+            await agen.__anext__()  # decode underway (256-token budget ~= forever)
+            assert batcher.stats()["resident"] == 1
+            await agen.aclose()  # in-process client disconnect
+            # the engine must free the slot at the next chunk boundary; poll on
+            # THIS loop so the route's detached iterator-close task can run
+            for _ in range(400):
+                if batcher.stats()["resident"] == 0:
+                    break
+                await asyncio.sleep(0.025)
+            assert batcher.stats()["resident"] == 0, "slot leaked after disconnect"
+
+        asyncio.run(scenario())
+        # the freed slot admits new work and decodes it to completion
+        out = _drain(batcher.submit(PROMPTS[5], max_new_tokens=4))
+        assert len(out) == 4
+    finally:
+        batcher.close()

@@ -7,6 +7,8 @@ import re
 import sys
 from pathlib import Path
 
+import pytest
+
 DOCS = Path(__file__).resolve().parents[2] / "docs"
 sys.path.insert(0, str(DOCS))
 
@@ -52,9 +54,11 @@ def test_markdown_rendering_features():
     assert "<th>a</th>" in html and "<td>2</td>" in html
 
 
+@pytest.mark.slow
 def test_tutorial_code_blocks_execute_end_to_end():
     """The quickstart tutorial's python blocks run top-to-bottom — the doc is an
-    executable artifact, not prose that can rot."""
+    executable artifact, not prose that can rot. Marked slow: it trains a real
+    model (~10s), which doesn't belong in the tier-1 time budget."""
     source = TUTORIAL.read_text()
     blocks = re.findall(r"```python\n(.*?)\n```", source, flags=re.DOTALL)
     assert len(blocks) >= 4
@@ -63,6 +67,7 @@ def test_tutorial_code_blocks_execute_end_to_end():
     assert namespace["metrics"]["train"] > 0.9
 
 
+@pytest.mark.slow
 def test_generation_tutorial_executes_end_to_end():
     source = GENERATION_TUTORIAL.read_text()
     blocks = re.findall(r"```python\n(.*?)\n```", source, flags=re.DOTALL)

@@ -188,6 +188,8 @@ def test_chunked_prefill_matches_single_dispatch(tiny):
     np.testing.assert_array_equal(chunked_s(prompts, seed=3), plain_s(prompts, seed=3))
 
 
+@pytest.mark.slow  # ~18s; MoE decode correctness stays covered in tier-1 by the
+# padding-invariance test here and the expert-parallel equality ring in emulated/
 def test_moe_greedy_matches_full_forward_oracle():
     """The MoE decoder follows the same cache contract; with ample expert capacity
     (no token drops) incremental routing equals whole-sequence routing, so greedy

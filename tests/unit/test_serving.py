@@ -270,7 +270,10 @@ def test_metrics_reports_micro_batcher_telemetry(trained_app):
     assert status == 200
     mb = payload["micro_batcher"]
     assert mb["dispatches"] >= 1 and mb["requests"] >= mb["dispatches"]
-    assert set(mb) == {"dispatches", "requests", "rows", "avg_rows_per_dispatch", "row_aligned"}
+    # coalescing telemetry plus the overload block (bounded admission)
+    assert {"dispatches", "requests", "rows", "avg_rows_per_dispatch", "row_aligned"} <= set(mb)
+    assert {"queue_depth", "max_queue", "shed_queue_full", "shed_deadline", "cancelled"} <= set(mb)
+    assert mb["shed_queue_full"] == 0 and mb["queue_depth"] == 0  # healthy, unloaded
 
 
 def test_serving_config_max_batch_size_one_disables_the_batcher(sklearn_model):

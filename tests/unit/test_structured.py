@@ -503,6 +503,7 @@ def _constrained_brute_force(module, params, cset, grammar, prompt, steps):
     return best, best_score
 
 
+@pytest.mark.slow  # brute-force V^steps oracle, ~28s — outside the tier-1 budget
 def test_constrained_full_width_beam_equals_exhaustive(micro_lm):
     module, params, _ = micro_lm
     steps = 3

@@ -1,0 +1,42 @@
+"""Fast syntax gate for the whole tree.
+
+A SyntaxError in a module that tests import (docs/build.py had one — an
+f-string expression containing a backslash, illegal before Python 3.12) breaks
+pytest COLLECTION of the importing test file: the suite reports a collection
+error and silently stops running every test in that file. This gate compiles
+every source file directly, so a syntax regression fails THIS test loudly with
+the offending file and line instead.
+
+Equivalent CLI gate (usable as a pre-commit / CI step on its own):
+``python -m compileall -q unionml_tpu docs tests``.
+"""
+
+import compileall
+import re
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[2]
+
+#: trees whose .py files must all parse; benchmarks and templates included —
+#: templates are exec'd by the framework-app tests, benchmarks by operators
+_TREES = ("unionml_tpu", "docs", "tests", "benchmarks")
+
+
+def test_every_source_file_compiles():
+    failures = []
+    for tree in _TREES:
+        root = REPO / tree
+        if not root.exists():
+            continue
+        # quiet=1 still prints per-file errors to stdout (pytest captures and
+        # shows them on failure); rx excludes nothing — the whole tree gates
+        ok = compileall.compile_dir(
+            str(root), quiet=1, force=False, rx=re.compile(r"/\.git/")
+        )
+        if not ok:
+            failures.append(tree)
+    assert not failures, (
+        f"syntax errors under {failures}; run `python -m compileall -q "
+        + " ".join(_TREES)
+        + "` for details"
+    )

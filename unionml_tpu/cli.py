@@ -195,6 +195,22 @@ def fetch_model(
     type=click.Choice(["debug", "info", "warning", "error"]),
     help="unionml-tpu logger level",
 )
+@click.option(
+    "--max-inflight", default=None, type=int,
+    help="concurrent-request admission cap; excess requests shed with 429 + Retry-After (0 = unbounded)",
+)
+@click.option(
+    "--deadline-ms", default=None, type=float,
+    help="server-default per-request deadline in ms; expired requests shed with 503 (0 = no default deadline)",
+)
+@click.option(
+    "--max-deadline-ms", default=None, type=float,
+    help="ceiling on client-requested X-Request-Deadline-Ms values",
+)
+@click.option(
+    "--drain-timeout", default=None, type=float,
+    help="seconds a SIGTERM-initiated graceful drain waits for in-flight requests/streams",
+)
 def serve(
     app_ref: str,
     model_path: Optional[Path],
@@ -206,6 +222,10 @@ def serve(
     workers: int,
     reload_: bool,
     log_level: Optional[str],
+    max_inflight: Optional[int],
+    deadline_ms: Optional[float],
+    max_deadline_ms: Optional[float],
+    drain_timeout: Optional[float],
 ) -> None:
     """Start the HTTP prediction service (reference cli.py:172-205).
 
@@ -217,6 +237,12 @@ def serve(
     should stay at 1 worker and scale through micro-batching, since the chip is
     a single shared resource. ``--reload`` watches the app module's directory
     and restarts on change.
+
+    Overload knobs (docs/serving.md "Serving under load"): ``--max-inflight``
+    caps concurrently executing requests (excess shed 429 + Retry-After),
+    ``--deadline-ms``/``--max-deadline-ms`` bound per-request deadlines
+    (expired work shed 503), and ``--drain-timeout`` bounds the SIGTERM
+    graceful drain (readiness flips, in-flight streams finish, then exit).
     """
     if log_level is not None:
         from unionml_tpu._logging import logger as package_logger
@@ -244,6 +270,12 @@ def serve(
         serving = target
     else:
         serving = target.serve(remote=remote, app_version=app_version, model_version=model_version)
+    serving.configure_overload(
+        max_inflight=max_inflight,
+        default_deadline_ms=deadline_ms,
+        max_deadline_ms=max_deadline_ms,
+        drain_timeout_s=drain_timeout,
+    )
 
     if workers > 1:
         import signal

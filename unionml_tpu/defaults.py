@@ -29,3 +29,36 @@ DEFAULT_RESOURCES = Resources()
 #: Environment variable used by ``serve``/``load_from_env`` — name kept identical to the
 #: reference so existing user scripts keep working (reference unionml/cli.py:188-201).
 MODEL_PATH_ENV_VAR = "UNIONML_MODEL_PATH"
+
+# --------------------------------------------------------------------- overload
+# Serving-stack overload protection (serving/overload.py). The reference
+# outsourced all of this to uvicorn/Flyte; a TPU-native engine owns it. Every
+# knob here is overridable per-app (ServingApp.configure_overload) and from the
+# CLI (`serve --max-inflight/--deadline-ms/--max-deadline-ms/--drain-timeout`).
+
+#: concurrent requests executing handlers before the HTTP layer sheds with 429.
+SERVE_MAX_INFLIGHT = 256
+
+#: micro-batcher admission queue bound (requests waiting to join a dispatch);
+#: a full queue sheds with 429 instead of growing without bound.
+SERVE_QUEUE_MAXSIZE = 1024
+
+#: continuous-batching engine waiting-queue bound (prompts waiting for a free
+#: decode slot) — ahead of the fixed slot pool itself.
+SERVE_MAX_WAITING = 256
+
+#: server-default per-request deadline (ms); a request still queued past it is
+#: shed with 503, one mid-handler is cancelled. ``X-Request-Deadline-Ms`` lets
+#: a client tighten (or, up to the max below, extend) it per request.
+SERVE_DEFAULT_DEADLINE_MS = 30_000.0
+
+#: ceiling on client-requested deadlines (ms): a client cannot pin server
+#: resources longer than this no matter what header it sends.
+SERVE_MAX_DEADLINE_MS = 300_000.0
+
+#: seconds a SIGTERM-initiated drain waits for in-flight requests and streams
+#: to finish before the process exits anyway.
+SERVE_DRAIN_TIMEOUT_S = 30.0
+
+#: ``Retry-After`` seconds attached to 429/503 shed responses.
+SERVE_RETRY_AFTER_S = 1

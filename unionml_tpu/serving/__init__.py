@@ -12,3 +12,8 @@ from unionml_tpu.serving.app import ServingApp, serving_app  # noqa: F401
 from unionml_tpu.serving.batcher import MicroBatcher, ServingConfig  # noqa: F401
 from unionml_tpu.serving.compile import CompiledPredictor  # noqa: F401
 from unionml_tpu.serving.continuous import ContinuousBatcher  # noqa: F401
+from unionml_tpu.serving.overload import (  # noqa: F401
+    DeadlineExceeded,
+    QueueFullError,
+    current_deadline,
+)

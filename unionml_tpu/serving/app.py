@@ -27,10 +27,15 @@ from typing import Any, Optional
 
 from unionml_tpu._logging import logger
 from unionml_tpu.artifact import ModelArtifact
-from unionml_tpu.defaults import MODEL_PATH_ENV_VAR
+from unionml_tpu.defaults import (
+    MODEL_PATH_ENV_VAR,
+    SERVE_DEFAULT_DEADLINE_MS,
+    SERVE_MAX_INFLIGHT,
+)
 from unionml_tpu.serving.batcher import MicroBatcher, ServingConfig
 from unionml_tpu.serving.http import HTTPError, HTTPServer
 from unionml_tpu.serving.metrics import ServingMetrics
+from unionml_tpu.serving.overload import DeadlineExceeded, QueueFullError, current_deadline
 
 _BANNER = """
 <html>
@@ -59,6 +64,15 @@ class ServingApp:
         self.app_version = app_version
         self.model_version = model_version
         self.server = HTTPServer()
+        # the bare HTTPServer is unbounded for back-compat; the APP is where
+        # production overload posture turns on: bounded in-flight admission
+        # (429 + Retry-After past the cap) and a default per-request deadline
+        # (503 shed for work the client has given up on). Tunable via
+        # configure_overload() / the serve CLI flags.
+        self.server.max_inflight = SERVE_MAX_INFLIGHT
+        self.server.default_deadline_ms = SERVE_DEFAULT_DEADLINE_MS
+        self.server.on_drained = self._on_drained
+        self.metrics = ServingMetrics()
         self._started = False
 
         config = getattr(model, "_predictor_config", None)
@@ -74,7 +88,9 @@ class ServingApp:
             # resumes honoring config.pad_to_bucket
             compiled = getattr(model, "_compiled_predictor", None)
             pad = None if compiled is None else (lambda: config.pad_to_bucket and compiled._eager)
-            self.batcher = MicroBatcher(self._predict_features_sync, config, pad_to_bucket=pad)
+            self.batcher = MicroBatcher(
+                self._predict_features_sync, config, pad_to_bucket=pad, metrics=self.metrics
+            )
         else:
             # DEFAULT micro-batching: predictors registered without a
             # ServingConfig still coalesce concurrent requests — a vectorized
@@ -90,10 +106,16 @@ class ServingApp:
                 self._predict_features_sync,
                 ServingConfig(max_batch_size=64, max_wait_ms=2.0, jit=False,
                               warmup=False, pad_to_bucket=False),
+                metrics=self.metrics,
             )
 
-        self.metrics = ServingMetrics()
         self.server.metrics = self.metrics
+        # live overload gauges: queue depths + in-flight count at snapshot time
+        self.metrics.register_gauge("inflight", lambda: self.server.inflight)
+        if self.batcher is not None:
+            self.metrics.register_gauge(
+                "micro_batcher_queue_depth", lambda: self.batcher.queue_depth
+            )
         self.server.route("GET", "/", self._root)
         self.server.route("GET", "/health", self._health)
         self.server.route("GET", "/metrics", self._metrics)
@@ -101,6 +123,39 @@ class ServingApp:
         self.server.route("POST", "/predict-stream", self._predict_stream)
 
     # ------------------------------------------------------------------ lifecycle
+
+    def configure_overload(
+        self,
+        *,
+        max_inflight: Optional[int] = None,
+        default_deadline_ms: Optional[float] = None,
+        max_deadline_ms: Optional[float] = None,
+        drain_timeout_s: Optional[float] = None,
+    ) -> "ServingApp":
+        """Override the overload-protection knobs (the ``serve`` CLI flags land
+        here). ``None`` leaves a knob at its current value; pass ``0`` for
+        ``max_inflight``/``default_deadline_ms`` to disable that bound."""
+        if max_inflight is not None:
+            self.server.max_inflight = max_inflight or None
+        if default_deadline_ms is not None:
+            self.server.default_deadline_ms = default_deadline_ms or None
+        if max_deadline_ms is not None:
+            self.server.max_deadline_ms = max_deadline_ms or None
+        if drain_timeout_s is not None:
+            self.server.drain_timeout_s = drain_timeout_s
+        return self
+
+    def _on_drained(self) -> None:
+        """Server drain hook: after in-flight HTTP work finishes, close the
+        model's continuous-batching engine (residents already drained — any
+        stragglers finish on the engine thread) so its decode thread and device
+        pool don't outlive the server."""
+        batcher = getattr(self.model, "generation_batcher", None)
+        if batcher is not None and hasattr(batcher, "close"):
+            try:
+                batcher.close(wait=False)
+            except Exception:  # pragma: no cover - defensive
+                logger.exception("generation batcher close failed during drain")
 
     def startup(self) -> None:
         """Load the model artifact (reference fastapi.py:22-34 startup hook)."""
@@ -183,9 +238,22 @@ class ServingApp:
         return 200, _BANNER, "text/html"
 
     async def _health(self, body: bytes):
+        """Liveness + readiness in one probe: ``ready`` is the rolling-restart
+        signal — a draining server answers 503/ready=false so the load balancer
+        stops routing to it while in-flight streams finish."""
         if self.model.artifact is None:
             raise HTTPError(500, "Model artifact not found.")
-        return 200, {"message": HTTPStatus.OK.phrase, "status": int(HTTPStatus.OK)}, "application/json"
+        if self.server.draining:
+            return (
+                503,
+                {"message": "draining", "status": 503, "ready": False},
+                "application/json",
+            )
+        return (
+            200,
+            {"message": HTTPStatus.OK.phrase, "status": int(HTTPStatus.OK), "ready": True},
+            "application/json",
+        )
 
     async def _metrics(self, body: bytes):
         """Request counters and latency percentiles per route (SURVEY.md §5.5 —
@@ -210,6 +278,19 @@ class ServingApp:
             snapshot["micro_batcher"] = self.batcher.stats()
         return 200, snapshot, "application/json"
 
+    async def _submit_batched(self, features: Any) -> Any:
+        """Batcher submit with the request deadline attached and overload
+        errors re-raised untouched — the HTTP layer maps QueueFullError to 429
+        + Retry-After and DeadlineExceeded to 503; everything else is a 500."""
+        try:
+            return await self.batcher.submit(features, deadline=current_deadline())
+        except (QueueFullError, DeadlineExceeded):
+            raise
+        except HTTPError:
+            raise
+        except Exception as exc:
+            raise HTTPError(500, f"prediction failed: {type(exc).__name__}: {exc}")
+
     async def _predict(self, body: bytes):
         # native fast path: a {"features": [flat numeric records]} envelope is parsed
         # straight from the wire bytes into a float64 DataFrame by the C++ records
@@ -224,9 +305,9 @@ class ServingApp:
                 return 200, [], "application/json"  # no rows -> no predictions
             try:
                 if self.batcher is not None:
-                    return 200, _to_jsonable(await self.batcher.submit(fast)), "application/json"
+                    return 200, _to_jsonable(await self._submit_batched(fast)), "application/json"
                 return 200, _to_jsonable(self._predict_features_sync(fast)), "application/json"
-            except HTTPError:
+            except (HTTPError, QueueFullError, DeadlineExceeded):
                 raise
             except Exception as exc:
                 raise HTTPError(500, f"prediction failed: {type(exc).__name__}: {exc}")
@@ -245,10 +326,10 @@ class ServingApp:
             if inputs is not None:
                 predictions = self.model.predict(**inputs)
             elif self.batcher is not None:
-                predictions = await self.batcher.submit(self.model._dataset.get_features(features))
+                predictions = await self._submit_batched(self.model._dataset.get_features(features))
             else:
                 predictions = self.model.predict(features=features)
-        except HTTPError:
+        except (HTTPError, QueueFullError, DeadlineExceeded):
             raise
         except Exception as exc:
             raise HTTPError(500, f"prediction failed: {type(exc).__name__}: {exc}")
@@ -292,7 +373,10 @@ class ServingApp:
             features = self.model._dataset.get_features(features)
             iterator = iter(self.model._stream_predictor(self.model.artifact.model_object, features))
             first = await loop.run_in_executor(None, next, iterator, sentinel)
-        except HTTPError:
+        except (HTTPError, QueueFullError, DeadlineExceeded):
+            # a continuous-batching engine shedding at admission (queue full /
+            # deadline) surfaces through the predictor's first next(); let the
+            # HTTP layer map it to 429/503 instead of burying it in a 500
             raise
         except Exception as exc:
             raise HTTPError(500, f"stream predictor failed: {type(exc).__name__}: {exc}")
@@ -331,10 +415,12 @@ class ServingApp:
         self.startup()
         self.server.run(host, port, reuse_port=reuse_port)
 
-    async def dispatch(self, method: str, path: str, body: bytes = b""):
-        """In-process request dispatch — the test-client surface."""
+    async def dispatch(self, method: str, path: str, body: bytes = b"", headers: Optional[dict] = None):
+        """In-process request dispatch — the test-client surface. ``headers``
+        (lower-cased names) participate in deadline propagation exactly like
+        wire requests (``x-request-deadline-ms``)."""
         self.startup()
-        return await self.server.dispatch(method, path, body)
+        return await self.server.dispatch(method, path, body, headers)
 
 
 #: strong refs to in-flight detached close tasks (the loop only holds weak ones)

@@ -22,9 +22,13 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import time
+from collections import deque
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
+from unionml_tpu.defaults import SERVE_QUEUE_MAXSIZE
 from unionml_tpu.parallel.mesh import MeshSpec
+from unionml_tpu.serving.overload import DeadlineExceeded, QueueFullError, expired
 
 
 @dataclasses.dataclass
@@ -54,6 +58,11 @@ class ServingConfig:
     #: without it, warmup is skipped and buckets compile lazily on first use
     feature_shape: Optional[Sequence[int]] = None
     feature_dtype: str = "float32"
+    #: admission bound: requests waiting to join a dispatch. A full queue sheds
+    #: new submissions with :class:`~unionml_tpu.serving.overload.QueueFullError`
+    #: (HTTP 429) instead of growing without bound under overload; ``0`` means
+    #: unbounded (not recommended outside tests).
+    max_queue: int = SERVE_QUEUE_MAXSIZE
 
     def buckets(self) -> List[int]:
         if self.bucket_sizes:
@@ -138,6 +147,7 @@ class MicroBatcher:
         predict_fn: Callable[[Any], Any],
         config: Optional[ServingConfig] = None,
         pad_to_bucket: "Optional[bool | Callable[[], bool]]" = None,
+        metrics: Any = None,
     ):
         self._predict_fn = predict_fn
         self.config = config or ServingConfig()
@@ -145,9 +155,16 @@ class MicroBatcher:
         # while a CompiledPredictor is actively padding downstream (on numpy, not
         # pandas) — but re-enables it if that predictor falls back to eager
         self._pad_to_bucket = self.config.pad_to_bucket if pad_to_bucket is None else pad_to_bucket
-        self._queue: "asyncio.Queue[Tuple[Any, int, asyncio.Future]]" = asyncio.Queue()
+        #: bounded admission: queue items are (features, rows, future, deadline,
+        #: enqueue_time); maxsize counts REQUESTS waiting to join a dispatch
+        self._queue: "asyncio.Queue[Tuple[Any, int, asyncio.Future, Optional[float], float]]" = (
+            asyncio.Queue(maxsize=self.config.max_queue)
+        )
         self._worker: Optional[asyncio.Task] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
+        #: optional :class:`~unionml_tpu.serving.metrics.ServingMetrics` sink for
+        #: shed counters and queue-wait percentiles
+        self._metrics = metrics
         #: None until the first coalesced dispatch proves the predictor's
         #: output row-aligned (splittable per request); False pins the solo
         #: path so a structured-output predictor never pays a doomed combined
@@ -158,6 +175,12 @@ class MicroBatcher:
         self.dispatches = 0
         self.batched_requests = 0
         self.batched_rows = 0
+        #: overload telemetry: queue-full sheds, deadline sheds (expired while
+        #: queued), and cancellations reaped before dispatch (client gone)
+        self.shed_queue_full = 0
+        self.shed_deadline = 0
+        self.cancelled = 0
+        self._queue_waits: "deque[float]" = deque(maxlen=2048)
 
     def _padding_active(self) -> bool:
         if callable(self._pad_to_bucket):
@@ -179,7 +202,7 @@ class MicroBatcher:
                     self._worker.cancel()  # foreign-loop task: cancel best-effort
                 except RuntimeError:  # its loop is already closed
                     pass
-            self._queue = asyncio.Queue()
+            self._queue = asyncio.Queue(maxsize=self.config.max_queue)
             self._loop = loop
         # same loop: keep the queue — a restarted worker (e.g. after stop())
         # must drain any backlog already enqueued
@@ -194,19 +217,60 @@ class MicroBatcher:
                 pass
             self._worker = None
 
-    async def submit(self, features: Any) -> Any:
-        """Enqueue features; resolves with this request's slice of the batched output."""
+    async def submit(self, features: Any, *, deadline: Optional[float] = None) -> Any:
+        """Enqueue features; resolves with this request's slice of the batched
+        output. ``deadline`` is an absolute ``time.monotonic()`` instant: a
+        request still queued past it is shed with :class:`DeadlineExceeded`
+        instead of burning a TPU dispatch on an answer nobody is waiting for.
+        A full queue sheds immediately with :class:`QueueFullError` (429)."""
         self.start()
         future: asyncio.Future = asyncio.get_event_loop().create_future()
-        await self._queue.put((features, _num_rows(features), future))
+        try:
+            self._queue.put_nowait((features, _num_rows(features), future, deadline, time.monotonic()))
+        except asyncio.QueueFull:
+            self.shed_queue_full += 1
+            if self._metrics is not None:
+                self._metrics.inc("shed_queue_full")
+            raise QueueFullError(
+                f"micro-batcher admission queue full ({self.config.max_queue} requests waiting)"
+            )
         return await future
 
+    def _admit(self, item: "Tuple[Any, int, asyncio.Future, Optional[float], float]") -> bool:
+        """Dequeue-side shedding: a request whose future is already done (its
+        handler was cancelled — client disconnect or deadline at the HTTP
+        layer) or whose own deadline passed while it was queued is dropped
+        BEFORE it joins a batch, so overload never spends predictor dispatches
+        on answers nobody will read."""
+        _, _, future, deadline, enqueued = item
+        if future.done():
+            self.cancelled += 1
+            if self._metrics is not None:
+                self._metrics.inc("cancelled_before_dispatch")
+            return False
+        if expired(deadline):
+            self.shed_deadline += 1
+            if self._metrics is not None:
+                self._metrics.inc("shed_deadline")
+            future.set_exception(
+                DeadlineExceeded("deadline exceeded while queued in the micro-batcher")
+            )
+            return False
+        wait = time.monotonic() - enqueued
+        self._queue_waits.append(wait)
+        if self._metrics is not None:
+            self._metrics.observe_queue_wait("micro_batcher", wait)
+        return True
+
     async def _run(self) -> None:
-        pending: "Optional[Tuple[Any, int, asyncio.Future]]" = None
+        pending: "Optional[Tuple[Any, int, asyncio.Future, Optional[float], float]]" = None
         coalesced_last = False
         while True:
+            preadmitted = pending is not None  # a mismatch handoff already passed _admit
             first = pending if pending is not None else await self._queue.get()
             pending = None
+            if not preadmitted and not self._admit(first):
+                continue
             batch = [first]
             total = first[1]
             # Adaptive wait: the max_wait_ms window only pays off when there is
@@ -226,6 +290,8 @@ class MicroBatcher:
                         item = await asyncio.wait_for(self._queue.get(), timeout)
                     except asyncio.TimeoutError:
                         break
+                    if not self._admit(item):
+                        continue
                     if _signature(item[0]) != first_sig:
                         # concatenating mismatched column sets / row shapes would
                         # silently produce a NaN-unioned frame; dispatch what we
@@ -241,12 +307,19 @@ class MicroBatcher:
 
             await self._dispatch(batch, total)
 
+    @property
+    def queue_depth(self) -> int:
+        """Requests currently waiting for a dispatch (bounded by ``max_queue``)."""
+        return self._queue.qsize()
+
     def stats(self) -> dict:
-        """Coalescing telemetry for ``GET /metrics``. ``dispatches`` counts
-        PREDICTOR INVOCATIONS (solo reruns included), so
+        """Coalescing + overload telemetry for ``GET /metrics``. ``dispatches``
+        counts PREDICTOR INVOCATIONS (solo reruns included), so
         ``avg_rows_per_dispatch`` is the realized vectorization win — an app
-        pinned to the solo path honestly reads ~1.0, not its batch size."""
-        return {
+        pinned to the solo path honestly reads ~1.0, not its batch size. The
+        overload block says why errors moved under load: queue-full sheds (429),
+        deadline sheds (503), and cancellations reaped before dispatch."""
+        out = {
             "dispatches": self.dispatches,
             "requests": self.batched_requests,
             "rows": self.batched_rows,
@@ -254,19 +327,34 @@ class MicroBatcher:
             if self.dispatches
             else 0.0,
             "row_aligned": self._row_aligned,
+            "queue_depth": self.queue_depth,
+            "max_queue": self.config.max_queue,
+            "shed_queue_full": self.shed_queue_full,
+            "shed_deadline": self.shed_deadline,
+            "cancelled": self.cancelled,
         }
+        if self._queue_waits:
+            ordered = sorted(self._queue_waits)
+            out["queue_wait_p50_ms"] = round(ordered[len(ordered) // 2] * 1e3, 3)
+            out["queue_wait_p99_ms"] = round(
+                ordered[min(len(ordered) - 1, round(0.99 * (len(ordered) - 1)))] * 1e3, 3
+            )
+        return out
 
     async def _call_predictor(self, features: Any) -> Any:
         self.dispatches += 1
         return await asyncio.get_event_loop().run_in_executor(None, self._predict_fn, features)
 
-    async def _solo_all(self, batch: List[Tuple[Any, int, asyncio.Future]]) -> None:
-        for (features, _, fut) in batch:
+    async def _solo_all(self, batch: List[Tuple]) -> None:
+        for item in batch:
+            features, fut = item[0], item[2]
+            if fut.done():  # cancelled while earlier solo reruns were in flight
+                continue
             solo = await self._call_predictor(features)
             if not fut.done():
                 fut.set_result(solo)
 
-    async def _dispatch(self, batch: List[Tuple[Any, int, asyncio.Future]], total: int) -> None:
+    async def _dispatch(self, batch: List[Tuple], total: int) -> None:
         parts = [b[0] for b in batch]
         sizes = [b[1] for b in batch]
         futures = [b[2] for b in batch]

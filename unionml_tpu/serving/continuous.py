@@ -55,6 +55,8 @@ import jax
 import jax.numpy as jnp
 
 from unionml_tpu._logging import logger
+from unionml_tpu.defaults import SERVE_MAX_WAITING
+from unionml_tpu.serving.overload import DeadlineExceeded, QueueFullError, expired
 from unionml_tpu.models.generate import (
     Generator,
     PrefixCache,
@@ -97,6 +99,10 @@ class _Session:
     #: DFA state is a pure function of (grammar, echo), so preemption resume
     #: recovers it by a host-side walk over the emitted tokens
     grammar: int = 0
+    #: absolute ``time.monotonic()`` deadline; a session still WAITING past it
+    #: is shed (DeadlineExceeded) instead of occupying the FIFO — work a client
+    #: has given up on must never cost a prefill
+    deadline: Optional[float] = None
 
 
 class _TokenStream:
@@ -174,6 +180,7 @@ class ContinuousBatcher:
         prefix: Optional[PrefixCache] = None,
         block_size: Optional[int] = None,
         pool_blocks: Optional[int] = None,
+        max_waiting: Optional[int] = None,
     ):
         if slots < 1:
             raise ValueError("slots must be >= 1")
@@ -181,6 +188,12 @@ class ContinuousBatcher:
             raise ValueError("decode_chunk must be >= 1")
         if block_size is not None and block_size < 1:
             raise ValueError("block_size must be >= 1")
+        if max_waiting is not None and max_waiting < 1:
+            raise ValueError("max_waiting must be >= 1")
+        #: admission bound AHEAD of the slot pool: prompts waiting for a free
+        #: slot beyond this are shed at submit() with QueueFullError (HTTP 429)
+        #: instead of growing _pending without bound under overload
+        self.max_waiting = SERVE_MAX_WAITING if max_waiting is None else max_waiting
         cfg = generator.config
         self.gen = generator
         #: speculative mode: with ``config.draft`` set, resident rows advance by
@@ -294,6 +307,9 @@ class ContinuousBatcher:
         self.decode_dispatches = 0
         self.decoded_rows = 0
         self.preemptions = 0
+        #: overload counters: waiting-queue-full sheds and deadline sheds
+        self.shed_queue_full = 0
+        self.shed_deadline = 0
         self._admit_counter = 0
         #: submissions per grammar id (constrained engines): /metrics telemetry
         self._grammar_counts: Dict[int, int] = {}
@@ -610,7 +626,7 @@ class ContinuousBatcher:
 
     def submit(
         self, prompt: Sequence[int], *, max_new_tokens: Optional[int] = None,
-        constraint: Optional[int] = None,
+        constraint: Optional[int] = None, deadline: Optional[float] = None,
     ) -> Iterator[np.ndarray]:
         """Enqueue a prompt; returns an iterator of 1-D int32 arrays of new
         tokens (first item is the prompt-sampled token). Blocks-free: the
@@ -620,9 +636,16 @@ class ContinuousBatcher:
         ``constraint`` selects THIS request's grammar from the generator's
         ``config.constraints`` (0 = FREE) — per-request structured output with
         zero extra compiles, since a grammar is just a start state in the
-        set's shared table (models/structured.py)."""
+        set's shared table (models/structured.py). ``deadline`` (absolute
+        ``time.monotonic()``) sheds the request if it is still WAITING for a
+        slot past that instant; when the waiting queue already holds
+        ``max_waiting`` live requests, submit sheds immediately with
+        :class:`QueueFullError` (HTTP 429) instead of queueing unboundedly."""
         if len(prompt) == 0:
             raise ValueError("prompt must be non-empty")
+        if expired(deadline):
+            self.shed_deadline += 1
+            raise DeadlineExceeded("deadline expired before the prompt was enqueued")
         budget = self.gen.config.max_new_tokens
         if max_new_tokens is not None:
             if not (1 <= max_new_tokens <= budget):
@@ -637,13 +660,22 @@ class ContinuousBatcher:
             self.gen._cs.start_states([constraint])  # range check
             grammar = int(constraint)
         session = _Session(
-            slot=-1, out=queue.Queue(), max_new=budget, grammar=grammar,
+            slot=-1, out=queue.Queue(), max_new=budget, grammar=grammar, deadline=deadline,
             # the original prompt is retained only where preemption can resume it
             prompt=list(prompt) if self.block_size is not None else [],
         )
         with self._lock:
             if self._closed:
                 raise RuntimeError("ContinuousBatcher is closed")
+            # admission control: count LIVE waiters (cancelled heads awaiting
+            # reap don't hold capacity against new arrivals)
+            waiting = sum(1 for _, s in self._pending if not s.finished)
+            if waiting >= self.max_waiting:
+                self.shed_queue_full += 1
+                raise QueueFullError(
+                    f"continuous-batching waiting queue full ({self.max_waiting} prompts queued "
+                    f"ahead of {self.slots} slots)"
+                )
             if self.gen._cs is not None:
                 self._grammar_counts[grammar] = self._grammar_counts.get(grammar, 0) + 1
             self._pending.append((list(prompt), session))
@@ -739,6 +771,10 @@ class ContinuousBatcher:
                 "slots": self.slots,
                 "resident": len(self._sessions),
                 "waiting": len(self._pending),
+                "max_waiting": self.max_waiting,
+                "shed_queue_full": self.shed_queue_full,
+                "shed_deadline": self.shed_deadline,
+                "draining": self._closed,
                 "decode_dispatches": self.decode_dispatches,
                 "rows_per_dispatch": round(
                     self.decoded_rows / self.decode_dispatches, 3
@@ -764,16 +800,17 @@ class ContinuousBatcher:
                 snapshot["grammar_submissions"] = dict(sorted(self._grammar_counts.items()))
             return snapshot
 
-    def close(self, wait: bool = True) -> None:
+    def close(self, wait: bool = True, timeout: float = 120.0) -> None:
         """Stop admitting new requests, DRAIN resident streams to completion,
         then stop the engine. Never-admitted pending requests get a clean
         end-of-stream. ``wait=False`` returns immediately while the drain
-        finishes on the engine thread."""
+        finishes on the engine thread; ``timeout`` bounds the wait (the
+        SIGTERM drain path passes its remaining drain budget here)."""
         with self._lock:
             self._closed = True
             self._lock.notify_all()
         if wait and self._thread is not None:
-            self._thread.join(timeout=120)
+            self._thread.join(timeout=timeout)
 
     # ------------------------------------------------------------------ engine
 
@@ -821,10 +858,25 @@ class ContinuousBatcher:
         cfg = self.gen.config
         while True:
             with self._lock:
-                # drop dead heads before paying allocation/prefill for them
-                # (cancelled while pending; their consumers hold the sentinel)
-                while self._pending and self._pending[0][1].finished:
-                    self._pending.pop(0)
+                # drop dead and expired waiters before paying allocation/prefill
+                # for them: cancelled sessions' consumers already hold the
+                # sentinel; a session past its deadline is shed with
+                # DeadlineExceeded — its client has given up, so a prefill +
+                # full decode would be pure waste (the whole list is swept, not
+                # just the head: max_waiting bounds it, so this stays cheap)
+                live = []
+                for prompt_s, s in self._pending:
+                    if s.finished:
+                        continue
+                    if expired(s.deadline):
+                        s.finished = True
+                        self.shed_deadline += 1
+                        s.out.put(DeadlineExceeded(
+                            "deadline exceeded while waiting for a decode slot"
+                        ))
+                        continue
+                    live.append((prompt_s, s))
+                self._pending = live
                 if self._closed or not self._pending or not self._free:
                     return
                 blocks_row = None

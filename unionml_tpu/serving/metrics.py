@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import threading
 from collections import deque
-from typing import Any, Dict
+from typing import Any, Callable, Dict
 
 _WINDOW = 10_000  # most recent samples per route
 
@@ -26,6 +26,17 @@ class ServingMetrics:
         self._requests: Dict[str, int] = {}
         self._errors: Dict[str, int] = {}
         self._latencies: "Dict[str, deque]" = {}
+        #: overload accounting (serving/overload.py): sheds, deadline timeouts,
+        #: and mid-flight cancellations — the counters that say WHY error totals
+        #: moved under load, not just that they did
+        self._overload: Dict[str, int] = {}
+        #: live gauges (queue depths, in-flight count): registered callables
+        #: evaluated at snapshot time, so /metrics reads current state without
+        #: the producers pushing samples on their hot paths
+        self._gauges: Dict[str, Callable[[], Any]] = {}
+        #: queue-wait reservoirs per queue (admission -> dispatch latency):
+        #: the leading indicator of overload — waits climb before sheds start
+        self._queue_waits: "Dict[str, deque]" = {}
 
     def record(self, route: str, status: int, latency_s: float) -> None:
         with self._lock:
@@ -35,6 +46,23 @@ class ServingMetrics:
             bucket = self._latencies.setdefault(route, deque(maxlen=self._window))
             bucket.append(latency_s)
 
+    def inc(self, counter: str, n: int = 1) -> None:
+        """Bump an overload counter (``shed_inflight``, ``shed_queue_full``,
+        ``shed_draining``, ``deadline_timeouts``, ``cancelled``...)."""
+        with self._lock:
+            self._overload[counter] = self._overload.get(counter, 0) + n
+
+    def observe_queue_wait(self, queue: str, wait_s: float) -> None:
+        """Record one request's admission-queue wait for ``queue``."""
+        with self._lock:
+            bucket = self._queue_waits.setdefault(queue, deque(maxlen=self._window))
+            bucket.append(wait_s)
+
+    def register_gauge(self, name: str, fn: Callable[[], Any]) -> None:
+        """Expose a live value (queue depth, in-flight count) in snapshots."""
+        with self._lock:
+            self._gauges[name] = fn
+
     @staticmethod
     def _percentile(ordered: "list[float]", q: float) -> float:
         # nearest-rank on the sorted window; ordered is non-empty
@@ -42,16 +70,41 @@ class ServingMetrics:
         return ordered[rank]
 
     def snapshot(self) -> Dict[str, Any]:
-        """Counts + latency percentiles (milliseconds) per route."""
+        """Counts + latency percentiles (milliseconds) per route, plus overload
+        counters, live gauges, and queue-wait percentiles."""
         with self._lock:
             routes = {r: list(lat) for r, lat in self._latencies.items()}
             requests = dict(self._requests)
             errors = dict(self._errors)
+            overload = dict(self._overload)
+            gauges = dict(self._gauges)
+            queue_waits = {q: list(w) for q, w in self._queue_waits.items()}
         out: Dict[str, Any] = {
             "requests_total": sum(requests.values()),
             "errors_total": sum(errors.values()),
+            "overload": overload,
             "routes": {},
         }
+        # gauges run unlocked: a provider that itself takes a lock (queue sizes)
+        # must not nest inside ours; a failing provider reports its error string
+        # instead of breaking the whole snapshot
+        gauge_out: Dict[str, Any] = {}
+        for name, fn in gauges.items():
+            try:
+                gauge_out[name] = fn()
+            except Exception as exc:  # pragma: no cover - defensive
+                gauge_out[name] = f"<error: {type(exc).__name__}>"
+        if gauge_out:
+            out["gauges"] = gauge_out
+        if queue_waits:
+            out["queues"] = {}
+            for queue, waits in queue_waits.items():
+                ordered = sorted(waits)
+                out["queues"][queue] = {
+                    "window": len(ordered),
+                    "wait_p50_ms": round(self._percentile(ordered, 0.50) * 1e3, 3),
+                    "wait_p99_ms": round(self._percentile(ordered, 0.99) * 1e3, 3),
+                } if ordered else {"window": 0}
         for route, latencies in routes.items():
             ordered = sorted(latencies)
             entry: Dict[str, Any] = {

@@ -31,7 +31,9 @@ from unionml_tpu.serving.app import ServingApp, _to_jsonable
 
 
 def _event_request(event: Dict[str, Any]) -> tuple:
-    """Extract (method, path, body) from an API Gateway event (v1 or v2 payload)."""
+    """Extract (method, path, body, headers) from an API Gateway event (v1 or v2
+    payload). Headers are lower-cased so deadline propagation
+    (``X-Request-Deadline-Ms``) works identically to the socket server."""
     if "requestContext" in event and "http" in event.get("requestContext", {}):  # HTTP API v2
         method = event["requestContext"]["http"]["method"]
         path = event.get("rawPath") or event["requestContext"]["http"].get("path", "/")
@@ -43,7 +45,12 @@ def _event_request(event: Dict[str, Any]) -> tuple:
         raw = base64.b64decode(body)
     else:
         raw = body.encode() if isinstance(body, str) else body
-    return method, path, raw
+    headers = {
+        str(name).lower(): str(value)
+        for name, value in (event.get("headers") or {}).items()
+        if value is not None
+    }
+    return method, path, raw, headers
 
 
 def lambda_handler(serving: ServingApp) -> Callable[[Dict[str, Any], Any], Dict[str, Any]]:
@@ -56,8 +63,8 @@ def lambda_handler(serving: ServingApp) -> Callable[[Dict[str, Any], Any], Dict[
     """
 
     def handler(event: Dict[str, Any], context: Any = None) -> Dict[str, Any]:
-        method, path, body = _event_request(event)
-        status, payload, content_type = asyncio.run(serving.dispatch(method, path, body))
+        method, path, body, headers = _event_request(event)
+        status, payload, content_type = asyncio.run(serving.dispatch(method, path, body, headers))
         body_out = payload if isinstance(payload, str) else json.dumps(payload, default=str)
         return {
             "statusCode": status,

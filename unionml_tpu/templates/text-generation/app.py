@@ -225,8 +225,12 @@ def _continuous_for(state: train_state.TrainState):
             # slots x worst-case (the default) so HBM actually tracks tokens
             # decoded — typical short prompts fit concurrently, a worst-case
             # mix rides lazy growth + preemption; /metrics reports occupancy
+            # max_waiting bounds the slot-wait queue: under a traffic spike the
+            # 33rd concurrent stream is shed with 429 (overload.QueueFullError)
+            # instead of queueing unboundedly behind 4 decode slots
             batcher = ContinuousBatcher(
-                _generator_for(state), slots=4, decode_chunk=8, block_size=16, pool_blocks=16
+                _generator_for(state), slots=4, decode_chunk=8, block_size=16, pool_blocks=16,
+                max_waiting=32,
             )
             _continuous[id(state)] = (state, batcher)
             model.generation_batcher = batcher  # surfaces utilization on /metrics
