@@ -38,6 +38,7 @@ SCRIPTS = {
     "structured": "bench_structured.py",
     "speculative": "bench_speculative.py",
     "continuous": "bench_continuous.py",
+    "replica_serving": "bench_replica_serving.py",
     "int8_matmul": "bench_int8_matmul.py",
     "kv_cache": "bench_kv_cache.py",
     "flash_attention": "bench_flash_attention.py",
@@ -55,7 +56,10 @@ if _cpu_extra - set(SCRIPTS):
     # a typo'd name would silently skip the CPU pin and launch the bench
     # against the wedged tunnel — the exact hang the operator set this to avoid
     raise SystemExit(f"RUNALL_CPU_ONLY names not in SCRIPTS: {sorted(_cpu_extra - set(SCRIPTS))}")
-CPU_ONLY = {"digits", "serving"} | _cpu_extra
+#: replica_serving is CPU-substrate by design: it measures the replica layer's
+#: dispatch overlap against a synthetic dispatch-bound engine on the emulated
+#: 8-device host mesh, not chip throughput
+CPU_ONLY = {"digits", "serving", "replica_serving"} | _cpu_extra
 
 PROBE_RETRY_S = 600.0
 #: per-script cap: a healthy run of the longest script (generate, ~15 min with
@@ -91,6 +95,34 @@ def wait_for_backend(deadline: float) -> bool:
             f"({remaining / 60:.0f} min left)"
         )
         time.sleep(PROBE_RETRY_S)
+
+
+def _as_finite(value) -> "float | None":
+    """float(value) if it is a real, finite number, else None — NaN/inf/str
+    payload values must never win a keep-best comparison (or crash one)."""
+    import math
+
+    try:
+        out = float(value)
+    except (TypeError, ValueError):
+        return None
+    return out if math.isfinite(out) else None
+
+
+def _keeps_previous_best(prev, payload) -> bool:
+    """CPU-lane accretion: the TPU headline's keep-best-with-provenance policy
+    (see ``_mirror_headline_capture``), applied to the suite's own entries —
+    a successful rerun that regressed (noisy neighbor on a shared host) or
+    produced a non-finite value refreshes provenance on the retained best
+    instead of replacing it. Same-metric only: a renamed/reshaped metric is a
+    new lane and always lands."""
+    if not _is_success(prev) or prev.get("metric") != payload.get("metric"):
+        return False
+    old = _as_finite(prev.get("value"))
+    if old is None:
+        return False
+    new = _as_finite(payload.get("value"))
+    return new is None or new <= old
 
 
 def _is_success(entry) -> bool:
@@ -235,6 +267,16 @@ def main() -> None:
             # *_cpu_fallback class
             _log(f"{name}: keeping the existing non-cpu capture over a cpu-platform run")
             continue
+        if name in CPU_ONLY and _keeps_previous_best(results.get(name), payload):
+            prev = results[name]
+            _log(
+                f"{name}: keeping previous best {prev.get('value')} over this run's "
+                f"{payload.get('value')} {payload.get('unit', '')}".rstrip()
+            )
+            prev["last_run_value"] = payload.get("value")
+            prev["runs_kept_over"] = int(prev.get("runs_kept_over") or 0) + 1
+            _flush(results, out)
+            continue
         results[name] = payload
         _log(lines[-1])
         _flush(results, out)
@@ -251,16 +293,25 @@ def _mirror_headline_capture(payload: dict) -> None:
     if payload.get("metric") != "mlp_train_throughput":
         return
     cap = Path(os.environ["BENCH_CAPTURE_DIR"]) / "bench_mlp_train.json"
+    old = None
     try:
-        old = float(json.loads(cap.read_text())["value"])
+        old = _as_finite(json.loads(cap.read_text())["value"])
     except (OSError, ValueError, KeyError, TypeError):
-        old = 0.0
-    if float(payload["value"]) > old:
+        pass
+    new = _as_finite(payload.get("value"))
+    if new is None and old is None:
+        return  # nothing comparable on either side; leave the capture alone
+    if old is None or (new is not None and new > old):
         tmp = cap.with_suffix(".json.tmp")
         tmp.write_text(json.dumps(payload))
         os.replace(tmp, cap)
     else:
-        os.utime(cap)  # refresh the freshness window on the retained capture
+        try:
+            os.utime(cap)  # refresh the freshness window on the retained capture
+        except OSError:
+            # the capture vanished between read and touch (concurrent watcher,
+            # cleared dir): a freshness miss must not crash the suite loop
+            pass
 
 
 if __name__ == "__main__":

@@ -149,8 +149,20 @@ def test_continuous_batching_over_tp_mesh():
     finally:
         batcher.close()
 
-    # batch-axis sharding cannot serve batch-1 admissions: clear error, not a crash
+    # batch-axis sharding cannot run through ONE engine (batch-1 admissions
+    # don't split a batch axis) — construction now delegates to the replica
+    # layer instead of rejecting; tests/emulated/test_replicas.py pins its
+    # token-exactness. A SUBCLASS built directly still gets the clear error.
+    from unionml_tpu.serving import ReplicaSet
+
     data_mesh = MeshSpec(data=2, model=2).build(jax.devices()[:4])
     data_gen = Generator(module, params, cfg, mesh=data_mesh, partition_rules=llama_partition_rules())
+    delegated = ContinuousBatcher(data_gen, slots=2)
+    assert isinstance(delegated, ReplicaSet) and delegated.replicas == 2
+    delegated.close()
+
+    class _DirectEngine(ContinuousBatcher):
+        pass
+
     with pytest.raises(ValueError, match="model/TP"):
-        ContinuousBatcher(data_gen, slots=2)
+        _DirectEngine(data_gen, slots=2)

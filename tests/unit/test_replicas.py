@@ -1,0 +1,166 @@
+"""Replica scheduler + fleet-level overload behavior (host logic only).
+
+The device-facing half (token-identical dp=2 x tp=2 serving, mesh slicing,
+delegation) lives in tests/emulated/test_replicas.py; here stub engines pin the
+pure routing/shedding logic: least-loaded order, tie-breaks, prefix affinity
+(hit, hotspot fallback, LRU bound), full-fleet 429, pre-routing deadline 503,
+and stats aggregation.
+"""
+
+import time
+
+import pytest
+
+from unionml_tpu.serving.overload import DeadlineExceeded, QueueFullError
+from unionml_tpu.serving.replicas import ReplicaScheduler, ReplicaSet
+
+
+class _StubEngine:
+    """Duck-typed ContinuousBatcher: enough surface for the ReplicaSet."""
+
+    def __init__(self, load=0, full=False):
+        self._load = load
+        self.full = full
+        self.submitted = []
+        self.slots = 4
+        self.shed_queue_full = 0
+        self.shed_deadline = 0
+
+    def load(self):
+        return self._load
+
+    def occupancy(self):
+        return min(self._load, self.slots), max(self._load - self.slots, 0)
+
+    def submit(self, prompt, **kwargs):
+        if self.full:
+            self.shed_queue_full += 1
+            raise QueueFullError("stub queue full")
+        self.submitted.append(list(prompt))
+        self._load += 1
+        return iter(())
+
+    def stats(self):
+        resident, waiting = self.occupancy()
+        return {
+            "slots": self.slots,
+            "resident": resident,
+            "waiting": waiting,
+            "shed_queue_full": self.shed_queue_full,
+            "shed_deadline": self.shed_deadline,
+            "decode_dispatches": 7,
+            "decoded_rows": 21,
+        }
+
+    def warmup(self):
+        pass
+
+    def close(self, wait=True, timeout=None):
+        pass
+
+
+def _set(engines, **kwargs):
+    return ReplicaSet(engines=engines, **kwargs)
+
+
+# ------------------------------------------------------------------ scheduler
+
+
+def test_least_loaded_order_with_tie_break():
+    sched = ReplicaScheduler(3)
+    order, affinity = sched.order([2, 0, 1])
+    assert order == [1, 2, 0] and affinity is False
+    order, _ = sched.order([1, 1, 1])
+    assert order == [0, 1, 2]  # ties break toward the lowest index
+
+
+def test_affinity_prefers_remembered_replica_within_margin():
+    sched = ReplicaScheduler(2, affinity_tokens=3, affinity_margin=2)
+    prompt = [5, 6, 7, 8]
+    sched.note(1, prompt)
+    order, affinity = sched.order([0, 2], prompt)  # replica 1 busier, within margin
+    assert order[0] == 1 and affinity is True
+    # a DIFFERENT prefix has no affinity entry: plain least-loaded
+    order, affinity = sched.order([0, 2], [9, 9, 9, 8])
+    assert order[0] == 0 and affinity is False
+    # prompts shorter than the affinity window share nothing to exploit
+    assert sched.order([0, 2], [5, 6])[0][0] == 0
+
+
+def test_affinity_abandons_hotspots_beyond_the_margin():
+    sched = ReplicaScheduler(2, affinity_tokens=2, affinity_margin=1)
+    prompt = [1, 2, 3]
+    sched.note(0, prompt)
+    order, affinity = sched.order([5, 0], prompt)  # 5 > 0 + margin: hotspot
+    assert order[0] == 1 and affinity is False
+
+
+def test_affinity_map_is_lru_bounded():
+    sched = ReplicaScheduler(2, affinity_tokens=1, affinity_capacity=2)
+    for token in range(5):
+        sched.note(token % 2, [token, 99])
+    assert sched.stats()["affinity_entries"] == 2
+
+
+# ------------------------------------------------------------------ replica set
+
+
+def test_submit_routes_least_loaded_and_walks_past_full_replicas():
+    engines = [_StubEngine(load=3), _StubEngine(load=1), _StubEngine(load=2)]
+    replica_set = _set(engines)
+    replica_set.submit([1, 2])
+    assert engines[1].submitted == [[1, 2]]  # least loaded took it
+    engines[1].full = True
+    replica_set.submit([3, 4])
+    assert engines[2].submitted == [[3, 4]]  # full replica fell through
+    assert replica_set.stats()["scheduler"]["submitted"] == [0, 1, 1]
+
+
+def test_full_fleet_sheds_queue_full():
+    engines = [_StubEngine(full=True), _StubEngine(full=True)]
+    replica_set = _set(engines)
+    with pytest.raises(QueueFullError):
+        replica_set.submit([1])
+    stats = replica_set.stats()
+    # one fleet-level shed on top of each engine's own attempt counter
+    assert stats["shed_queue_full"] == 1 + 2
+
+
+def test_expired_deadline_sheds_before_routing():
+    engines = [_StubEngine()]
+    replica_set = _set(engines)
+    with pytest.raises(DeadlineExceeded):
+        replica_set.submit([1], deadline=time.monotonic() - 0.1)
+    assert engines[0].submitted == []  # never routed, no engine work spent
+    assert replica_set.stats()["shed_deadline"] == 1
+
+
+def test_affinity_routes_shared_prefixes_to_the_same_replica():
+    engines = [_StubEngine(), _StubEngine()]
+    replica_set = _set(engines, affinity_tokens=3)
+    replica_set.submit([7, 8, 9, 1])  # -> replica 0 (idle tie-break)
+    replica_set.submit([1, 2, 3, 4])  # -> replica 1 (least loaded)
+    replica_set.submit([7, 8, 9, 2])  # shared prefix -> replica 0 despite equal load
+    assert [len(e.submitted) for e in engines] == [2, 1]
+    assert replica_set.stats()["scheduler"]["affinity_hits"] == 1
+
+
+def test_stats_aggregates_across_replicas():
+    replica_set = _set([_StubEngine(load=2), _StubEngine(load=5)])
+    stats = replica_set.stats()
+    assert stats["replicas"] == 2
+    assert stats["slots"] == 8 and stats["resident"] == 2 + 4 and stats["waiting"] == 1
+    assert stats["decode_dispatches"] == 14 and stats["decoded_rows"] == 42
+    assert len(stats["per_replica"]) == 2
+    loads = replica_set.replica_loads()
+    assert loads[1] == {
+        "replica": 1, "resident": 4, "waiting": 1, "free_slots": 0,
+        "shed_queue_full": 0, "shed_deadline": 0,
+    }
+
+
+def test_replica_set_needs_exactly_one_source():
+    with pytest.raises(ValueError):
+        ReplicaSet()
+    with pytest.raises(ValueError):
+        ReplicaSet([object()], engines=[_StubEngine()])

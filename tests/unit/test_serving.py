@@ -247,6 +247,56 @@ def test_micro_batcher_stats_count_solo_reruns_as_dispatches():
     assert stats["avg_rows_per_dispatch"] <= 1.0
 
 
+def test_micro_batcher_scalar_array_output_falls_back_to_solo():
+    """A 0-d (unsized) predictor output — e.g. np.sum over the batch — passes
+    the row-major type check but raises TypeError from len(); that used to
+    escape the not-row-aligned guard and 500 EVERY coalesced batch, forever.
+    It must instead pin the solo path like any other aggregate output."""
+    import numpy as np
+
+    calls = []
+
+    def predict(batch):
+        calls.append(len(batch))
+        return np.sum(np.asarray(batch, dtype=np.float64))  # 0-d ndarray
+
+    async def scenario():
+        batcher = MicroBatcher(predict, ServingConfig(max_batch_size=8, max_wait_ms=50, pad_to_bucket=False))
+        first = await asyncio.gather(batcher.submit([1, 2]), batcher.submit([10]))
+        second = await asyncio.gather(batcher.submit([5]), batcher.submit([6, 7]))
+        return first, second, batcher._row_aligned
+
+    (r1, r2), (r3, r4), aligned = asyncio.run(scenario())
+    assert (float(r1), float(r2)) == (3.0, 10.0)  # each request saw ITS OWN sum
+    assert (float(r3), float(r4)) == (5.0, 13.0)
+    assert aligned is False  # pinned: later rounds dispatch solo, not doomed-combined
+    assert calls.count(3) <= 1  # at most the one detection dispatch was combined
+
+
+def test_micro_batcher_solo_rerun_isolates_bad_requests():
+    """On the pinned solo path, one request whose predictor rerun raises must
+    fail ONLY its own future — the valid siblings queued behind it in the same
+    batch keep their results."""
+    def predict(batch):
+        if any(x < 0 for x in batch):
+            raise ValueError("negative feature")
+        return float(sum(batch))  # scalar aggregate: pins the solo path
+
+    async def scenario():
+        batcher = MicroBatcher(predict, ServingConfig(max_batch_size=8, max_wait_ms=50, pad_to_bucket=False))
+        await asyncio.gather(batcher.submit([1]), batcher.submit([2]))  # pins solo
+        assert batcher._row_aligned is False
+        return await asyncio.gather(
+            batcher.submit([3]), batcher.submit([-5]), batcher.submit([4]),
+            return_exceptions=True,
+        )
+
+    good_before, bad, good_after = asyncio.run(scenario())
+    assert good_before == 3.0
+    assert isinstance(bad, ValueError)
+    assert good_after == 4.0  # the sibling AFTER the failure still resolved
+
+
 def test_serving_app_batches_by_default(sklearn_model):
     """Predictors registered without a ServingConfig still get a MicroBatcher
     (measured ~2x on the digits quickstart under 16-way concurrency); a
@@ -274,6 +324,37 @@ def test_metrics_reports_micro_batcher_telemetry(trained_app):
     assert {"dispatches", "requests", "rows", "avg_rows_per_dispatch", "row_aligned"} <= set(mb)
     assert {"queue_depth", "max_queue", "shed_queue_full", "shed_deadline", "cancelled"} <= set(mb)
     assert mb["shed_queue_full"] == 0 and mb["queue_depth"] == 0  # healthy, unloaded
+
+
+def test_metrics_surfaces_replica_generation_engine(trained_app):
+    """An app whose generation engine is a ReplicaSet gets per-replica
+    occupancy on /metrics twice over: the engine's stats() under "generation"
+    and the live "generation_replicas" gauge — absent (not null) while the
+    engine is a single ContinuousBatcher or not built yet."""
+    status, payload, _ = _dispatch(trained_app, "GET", "/metrics")
+    assert status == 200
+    assert "generation_replicas" not in payload.get("gauges", {})  # inactive gauge stays out
+
+    class _FakeReplicaEngine:
+        def stats(self):
+            return {"replicas": 2, "per_replica": [{"slots": 2}, {"slots": 2}]}
+
+        def replica_loads(self):
+            return [
+                {"replica": 0, "resident": 1, "waiting": 0, "free_slots": 1},
+                {"replica": 1, "resident": 2, "waiting": 3, "free_slots": 0},
+            ]
+
+    trained_app.model.generation_batcher = _FakeReplicaEngine()
+    try:
+        status, payload, _ = _dispatch(trained_app, "GET", "/metrics")
+        assert status == 200
+        assert payload["generation"]["replicas"] == 2
+        assert len(payload["generation"]["per_replica"]) == 2
+        gauge = payload["gauges"]["generation_replicas"]
+        assert gauge[1]["waiting"] == 3 and gauge[0]["resident"] == 1
+    finally:
+        trained_app.model.generation_batcher = None
 
 
 def test_serving_config_max_batch_size_one_disables_the_batcher(sklearn_model):

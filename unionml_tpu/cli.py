@@ -211,6 +211,12 @@ def fetch_model(
     "--drain-timeout", default=None, type=float,
     help="seconds a SIGTERM-initiated graceful drain waits for in-flight requests/streams",
 )
+@click.option(
+    "--dp-replicas", default=None, type=int,
+    help="data-parallel replica engines for generation serving: each replica owns a TP submesh "
+    "(or its own device) and requests route least-loaded-first (0 = derive from the mesh's "
+    "data/fsdp axes)",
+)
 def serve(
     app_ref: str,
     model_path: Optional[Path],
@@ -226,6 +232,7 @@ def serve(
     deadline_ms: Optional[float],
     max_deadline_ms: Optional[float],
     drain_timeout: Optional[float],
+    dp_replicas: Optional[int],
 ) -> None:
     """Start the HTTP prediction service (reference cli.py:172-205).
 
@@ -243,7 +250,20 @@ def serve(
     ``--deadline-ms``/``--max-deadline-ms`` bound per-request deadlines
     (expired work shed 503), and ``--drain-timeout`` bounds the SIGTERM
     graceful drain (readiness flips, in-flight streams finish, then exit).
+
+    ``--dp-replicas N`` (docs/serving.md "Data-parallel serving") replicates
+    the app's continuous generation engine N ways — one TP submesh (or device)
+    per replica, least-loaded routing, per-replica occupancy on ``/metrics``.
+    Exported as an env var BEFORE the app module imports, so engines built at
+    import time replicate too.
     """
+    if dp_replicas is not None:
+        if dp_replicas < 0:
+            raise click.ClickException("--dp-replicas must be >= 0 (0 = derive from the mesh)")
+        # before _locate_model: app modules often build their engines at import
+        from unionml_tpu.defaults import SERVE_DP_REPLICAS_ENV_VAR
+
+        os.environ[SERVE_DP_REPLICAS_ENV_VAR] = str(dp_replicas)
     if log_level is not None:
         from unionml_tpu._logging import logger as package_logger
 
@@ -275,7 +295,7 @@ def serve(
         default_deadline_ms=deadline_ms,
         max_deadline_ms=max_deadline_ms,
         drain_timeout_s=drain_timeout,
-    )
+    ).configure_replicas(dp_replicas)
 
     if workers > 1:
         import signal

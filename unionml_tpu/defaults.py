@@ -8,6 +8,7 @@ a stage asks the scheduler for.
 from __future__ import annotations
 
 import dataclasses
+import os
 
 
 @dataclasses.dataclass(frozen=True)
@@ -62,3 +63,19 @@ SERVE_DRAIN_TIMEOUT_S = 30.0
 
 #: ``Retry-After`` seconds attached to 429/503 shed responses.
 SERVE_RETRY_AFTER_S = 1
+
+#: env var carrying the ``serve --dp-replicas`` override: the CLI exports it
+#: BEFORE the app module imports, so engines built at import time (or lazily at
+#: first request) see it without any app code changes.
+SERVE_DP_REPLICAS_ENV_VAR = "UNIONML_TPU_DP_REPLICAS"
+
+
+def serve_dp_replicas() -> int:
+    """The serve-time data-parallel replica override; 0 = unset (derive the
+    replica count from the mesh's data/fsdp axes). Read at call time, not
+    import time — engine construction usually happens long after this module
+    imports, and the CLI sets the env var in between."""
+    try:
+        return max(int(os.environ.get(SERVE_DP_REPLICAS_ENV_VAR, "0")), 0)
+    except ValueError:
+        return 0

@@ -315,6 +315,11 @@ class Generator:
         self.module = module
         self.config = config
         self.mesh = mesh
+        #: retained so engines re-hosting these weights (the serving replica
+        #: layer re-placing params onto per-replica submeshes) can rebuild a
+        #: Generator with identical sharding/quantization choices
+        self.partition_rules = partition_rules
+        self.quantize = quantize
         self.prefill_traces = 0
         self.decode_traces = 0
         compute_dtype = getattr(getattr(module, "config", None), "dtype", jnp.bfloat16)

@@ -30,6 +30,7 @@ from unionml_tpu.artifact import ModelArtifact
 from unionml_tpu.defaults import (
     MODEL_PATH_ENV_VAR,
     SERVE_DEFAULT_DEADLINE_MS,
+    SERVE_DP_REPLICAS_ENV_VAR,
     SERVE_MAX_INFLIGHT,
 )
 from unionml_tpu.serving.batcher import MicroBatcher, ServingConfig
@@ -73,6 +74,8 @@ class ServingApp:
         self.server.default_deadline_ms = SERVE_DEFAULT_DEADLINE_MS
         self.server.on_drained = self._on_drained
         self.metrics = ServingMetrics()
+        #: serve-time --dp-replicas override (None until configure_replicas)
+        self.dp_replicas: Optional[int] = None
         self._started = False
 
         config = getattr(model, "_predictor_config", None)
@@ -112,6 +115,11 @@ class ServingApp:
         self.server.metrics = self.metrics
         # live overload gauges: queue depths + in-flight count at snapshot time
         self.metrics.register_gauge("inflight", lambda: self.server.inflight)
+        # per-replica occupancy when the generation engine is a ReplicaSet;
+        # evaluated lazily at snapshot time (the engine is usually built at
+        # warmup or first request, after this constructor) and None — hence
+        # absent from /metrics — on single-engine apps
+        self.metrics.register_gauge("generation_replicas", self._replica_gauge)
         if self.batcher is not None:
             self.metrics.register_gauge(
                 "micro_batcher_queue_depth", lambda: self.batcher.queue_depth
@@ -144,6 +152,24 @@ class ServingApp:
         if drain_timeout_s is not None:
             self.server.drain_timeout_s = drain_timeout_s
         return self
+
+    def configure_replicas(self, dp_replicas: Optional[int] = None) -> "ServingApp":
+        """Record the serve-time ``--dp-replicas`` override and export it so
+        generation engines built after startup (warmup hooks, first-request
+        construction) replicate: ``ContinuousBatcher(...)`` consults the env
+        var and transparently builds a
+        :class:`~unionml_tpu.serving.replicas.ReplicaSet`."""
+        if dp_replicas is not None:
+            if dp_replicas < 0:
+                raise ValueError("dp_replicas must be >= 0 (0 = derive from the mesh)")
+            self.dp_replicas = dp_replicas
+            os.environ[SERVE_DP_REPLICAS_ENV_VAR] = str(dp_replicas)
+        return self
+
+    def _replica_gauge(self) -> Optional[Any]:
+        batcher = getattr(self.model, "generation_batcher", None)
+        loads = getattr(batcher, "replica_loads", None)
+        return loads() if callable(loads) else None
 
     def _on_drained(self) -> None:
         """Server drain hook: after in-flight HTTP work finishes, close the

@@ -350,7 +350,15 @@ class MicroBatcher:
             features, fut = item[0], item[2]
             if fut.done():  # cancelled while earlier solo reruns were in flight
                 continue
-            solo = await self._call_predictor(features)
+            # each rerun fails alone: one request's predictor error (bad
+            # features) must not poison the valid siblings queued behind it —
+            # solo semantics means solo failures
+            try:
+                solo = await self._call_predictor(features)
+            except Exception as exc:
+                if not fut.done():
+                    fut.set_exception(exc)
+                continue
             if not fut.done():
                 fut.set_result(solo)
 
@@ -452,7 +460,16 @@ class MicroBatcher:
     def _try_split(self, result: Any, sizes: List[int], total: int) -> Optional[List[Any]]:
         """Strict-mode split: the unpadded row count must match exactly and the
         container must be row-major for per-request slices to be valid."""
-        if not self._row_major(result) or len(result) != total:
+        if not self._row_major(result):
+            return None
+        try:
+            rows = len(result)
+        except TypeError:
+            # a 0-d array (e.g. np.sum over the batch) passes the row-major
+            # type check but is unsized — not row-aligned, so the solo
+            # fallback engages instead of 500ing every coalesced batch
+            return None
+        if rows != total:
             return None
         try:
             return _split(result, sizes)

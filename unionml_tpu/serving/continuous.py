@@ -55,7 +55,7 @@ import jax
 import jax.numpy as jnp
 
 from unionml_tpu._logging import logger
-from unionml_tpu.defaults import SERVE_MAX_WAITING
+from unionml_tpu.defaults import SERVE_MAX_WAITING, serve_dp_replicas
 from unionml_tpu.serving.overload import DeadlineExceeded, QueueFullError, expired
 from unionml_tpu.models.generate import (
     Generator,
@@ -171,6 +171,39 @@ class ContinuousBatcher:
     paged == contiguous == sequential).
     """
 
+    def __new__(cls, generator: Optional[Generator] = None, **engine_kwargs: Any):
+        """Replica delegation: constructing the engine over a mesh with a >1
+        batch axis (``data``/``fsdp``/``dcn_data``), or with the serve CLI's
+        ``--dp-replicas`` exported, transparently returns a
+        :class:`~unionml_tpu.serving.replicas.ReplicaSet` — N per-submesh
+        engines behind a least-loaded scheduler with the same public surface
+        (every ``__init__`` knob applies per replica). A batch-1 admission row
+        cannot split a batch axis, so the batch extent IS the replica count;
+        apps opt into replica serving by mesh shape or CLI flag with no code
+        changes."""
+        if cls is ContinuousBatcher and generator is not None:
+            mesh = getattr(generator, "mesh", None)
+            dp = 1
+            if mesh is not None:
+                for axis in ("dcn_data", "data", "fsdp"):
+                    dp *= int(mesh.shape.get(axis, 1))
+            env = serve_dp_replicas()
+            if dp > 1 or env > 1:
+                from unionml_tpu.serving.replicas import ReplicaSet
+
+                return ReplicaSet.from_generator(generator, replicas=env or None, **engine_kwargs)
+        return super().__new__(cls)
+
+    @classmethod
+    def _single(cls, generator: Generator, **kwargs: Any) -> "ContinuousBatcher":
+        """Build one plain engine, bypassing the ``__new__`` replica
+        delegation — the replica layer constructs its per-submesh engines
+        through this (each submesh has batch extent 1, but the ``--dp-replicas``
+        env check must not recurse)."""
+        self = object.__new__(cls)
+        self.__init__(generator, **kwargs)
+        return self
+
     def __init__(
         self,
         generator: Generator,
@@ -251,12 +284,15 @@ class ContinuousBatcher:
             # TP (model-axis) serving is supported: params and KV heads shard,
             # XLA inserts the collectives, and admission's batch-1 row prefill
             # replicates trivially. Batch-axis sharding is not: a [1, ...] row
-            # cache cannot split over a >1 data/fsdp axis
-            for axis in ("data", "fsdp"):
+            # cache cannot split over a >1 data/fsdp axis — normal construction
+            # delegates such meshes to the replica layer in __new__; this
+            # backstop catches subclasses built directly over a dp mesh
+            for axis in ("dcn_data", "data", "fsdp"):
                 if int(generator.mesh.shape.get(axis, 1)) > 1:
                     raise ValueError(
-                        f"continuous batching shards over model/TP axes only; mesh has {axis}="
-                        f"{int(generator.mesh.shape[axis])} (batch-1 admission prefills cannot split a batch axis)"
+                        f"a single continuous engine shards over model/TP axes only; mesh has {axis}="
+                        f"{int(generator.mesh.shape[axis])} (batch-1 admission prefills cannot split a "
+                        "batch axis) — serve a dp mesh through serving.ReplicaSet"
                     )
         self.block_size = block_size
         if block_size is not None:
@@ -762,6 +798,18 @@ class ContinuousBatcher:
                 # accumulate onto the zeroed telemetry correctly
                 self._spec.rounds = 0
                 self._spec.accepted_tokens = 0
+
+    def occupancy(self) -> "tuple[int, int]":
+        """``(resident, live waiting)`` — the cheap gauge pair the replica
+        layer polls per routing decision and per ``/metrics`` snapshot."""
+        with self._lock:
+            return len(self._sessions), sum(1 for _, s in self._pending if not s.finished)
+
+    def load(self) -> int:
+        """Scheduling load: live residents plus live waiters. The replica
+        scheduler routes least-loaded-first on this."""
+        resident, waiting = self.occupancy()
+        return resident + waiting
 
     def stats(self) -> Dict[str, Any]:
         """Utilization snapshot for ``/metrics``: resident/waiting streams,

@@ -87,13 +87,19 @@ class ServingMetrics:
         }
         # gauges run unlocked: a provider that itself takes a lock (queue sizes)
         # must not nest inside ours; a failing provider reports its error string
-        # instead of breaking the whole snapshot
+        # instead of breaking the whole snapshot. A provider returning None is
+        # registered-but-inactive (e.g. per-replica occupancy on an app whose
+        # generation engine is a single ContinuousBatcher) and stays out of the
+        # snapshot entirely.
         gauge_out: Dict[str, Any] = {}
         for name, fn in gauges.items():
             try:
-                gauge_out[name] = fn()
+                value = fn()
             except Exception as exc:  # pragma: no cover - defensive
                 gauge_out[name] = f"<error: {type(exc).__name__}>"
+                continue
+            if value is not None:
+                gauge_out[name] = value
         if gauge_out:
             out["gauges"] = gauge_out
         if queue_waits:
