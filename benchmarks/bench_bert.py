@@ -26,11 +26,14 @@ from benchmarks.common import V5E_PEAK_BF16_FLOPS, emit, log
 
 import os
 
+from unionml_tpu.defaults import env_int
+
 SEQ_LEN = 128
 # sweepable via env for MFU tuning runs; the canonical config is the default
-BATCH_PER_CHIP = int(os.environ.get("BENCH_BERT_BATCH", "64"))
-STEPS = int(os.environ.get("BENCH_BERT_STEPS", "30"))
-STEPS_PER_CALL = int(os.environ.get("BENCH_BERT_STEPS_PER_CALL", "10"))
+# (env_int: a typo'd sweep value degrades to the canonical config, not a crash)
+BATCH_PER_CHIP = env_int("BENCH_BERT_BATCH", 64, minimum=1)
+STEPS = env_int("BENCH_BERT_STEPS", 30, minimum=1)
+STEPS_PER_CALL = env_int("BENCH_BERT_STEPS_PER_CALL", 10, minimum=1)
 METRIC = os.environ.get("BENCH_BERT_METRIC", "bert_base_sst2_train_throughput")
 A100_REFERENCE_SPS = 400.0
 

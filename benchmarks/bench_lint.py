@@ -1,0 +1,69 @@
+"""tpu-lint lane: time a full-repo analyzer run and record the finding counts.
+
+CPU-substrate by design (pure-Python AST work; never touches the accelerator).
+Two things are tracked across rounds:
+
+- ``value`` = files analyzed per second — the analyzer must stay cheap enough
+  to live inside the tier-1 gate (test_syntax.py asserts an absolute 5 s
+  budget on the package; this lane watches the trend on the WHOLE tree);
+- ``suppressed_findings`` — every ``# tpu-lint: disable=`` carries a written
+  justification, and the count should only go down round over round (a rising
+  count means suppressions are becoming the path of least resistance);
+  ``active_findings`` must stay 0 on ``unionml_tpu`` (the gated tree) and is
+  reported per-tree here for the rest.
+
+Emits the standard one-JSON-line contract, with the ``--format json`` schema's
+counts embedded so BENCH_ALL.json carries per-rule totals.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+from benchmarks.common import emit, log  # noqa: E402
+
+#: every tree the repo lints; unionml_tpu is the tier-1-gated one
+TREES = ("unionml_tpu", "tests", "docs", "benchmarks")
+REPEATS = 3
+
+
+def main() -> None:
+    from unionml_tpu.analysis import run_lint
+
+    paths = [ROOT / tree for tree in TREES if (ROOT / tree).exists()]
+    # warm parse caches (first run pays import + os.scandir cold costs)
+    run_lint(paths)
+    best = float("inf")
+    result = None
+    for _ in range(REPEATS):
+        start = time.perf_counter()
+        result = run_lint(paths)
+        best = min(best, time.perf_counter() - start)
+    gated = run_lint([ROOT / "unionml_tpu"])
+    log(
+        f"lint: {result.files} files in {best:.3f}s, {len(result.findings)} active / "
+        f"{len(result.suppressed)} suppressed findings ({len(gated.findings)} active in the gated tree)"
+    )
+    emit(
+        "lint_files_per_sec",
+        result.files / best if best > 0 else 0.0,
+        "files/s",
+        1.0,  # no reference analog: this repo is its own baseline
+        platform="cpu",
+        lint_wall_s=round(best, 4),
+        files=result.files,
+        active_findings=len(result.findings),
+        suppressed_findings=len(result.suppressed),
+        gated_tree_active_findings=len(gated.findings),
+        per_rule_counts=result.counts(),
+        parse_errors=len(result.errors),
+    )
+
+
+if __name__ == "__main__":
+    main()

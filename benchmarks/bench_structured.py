@@ -32,11 +32,14 @@ from benchmarks.common import Timer, emit, log
 
 import os
 
-# env-overridable for CPU smoke runs (the canonical TPU config is the default)
-PROXY_LAYERS = int(os.environ.get("BENCH_STRUCTURED_LAYERS", "8"))
-BATCH = int(os.environ.get("BENCH_STRUCTURED_BATCH", "8"))
-PROMPT_LEN = int(os.environ.get("BENCH_STRUCTURED_PROMPT", "128"))
-NEW_TOKENS = int(os.environ.get("BENCH_STRUCTURED_NEW", "128"))
+from unionml_tpu.defaults import env_int
+
+# env-overridable for CPU smoke runs (the canonical TPU config is the default;
+# env_int degrades a typo'd override to it instead of crashing the suite)
+PROXY_LAYERS = env_int("BENCH_STRUCTURED_LAYERS", 8, minimum=1)
+BATCH = env_int("BENCH_STRUCTURED_BATCH", 8, minimum=1)
+PROMPT_LEN = env_int("BENCH_STRUCTURED_PROMPT", 128, minimum=1)
+NEW_TOKENS = env_int("BENCH_STRUCTURED_NEW", 128, minimum=1)
 
 
 def main() -> None:

@@ -21,11 +21,14 @@ import numpy as np
 
 from benchmarks.common import V5E_PEAK_BF16_FLOPS, emit, log
 
+from unionml_tpu.defaults import env_int
+
 IMAGE = 224
 # sweepable via env for MFU tuning runs; the canonical config is the default
-BATCH_PER_CHIP = int(os.environ.get("BENCH_VIT_BATCH", "64"))
-STEPS = int(os.environ.get("BENCH_VIT_STEPS", "20"))
-CEILING_STEPS_PER_CALL = int(os.environ.get("BENCH_VIT_STEPS_PER_CALL", "5"))
+# (env_int: a typo'd sweep value degrades to the canonical config, not a crash)
+BATCH_PER_CHIP = env_int("BENCH_VIT_BATCH", 64, minimum=1)
+STEPS = env_int("BENCH_VIT_STEPS", 20, minimum=1)
+CEILING_STEPS_PER_CALL = env_int("BENCH_VIT_STEPS_PER_CALL", 5, minimum=1)
 METRIC = os.environ.get("BENCH_VIT_METRIC", "vit_prefetch_train_throughput")
 MODEL = os.environ.get("BENCH_VIT_MODEL", "B")
 

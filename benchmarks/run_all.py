@@ -39,6 +39,7 @@ SCRIPTS = {
     "speculative": "bench_speculative.py",
     "continuous": "bench_continuous.py",
     "replica_serving": "bench_replica_serving.py",
+    "lint": "bench_lint.py",
     "int8_matmul": "bench_int8_matmul.py",
     "kv_cache": "bench_kv_cache.py",
     "flash_attention": "bench_flash_attention.py",
@@ -58,17 +59,22 @@ if _cpu_extra - set(SCRIPTS):
     raise SystemExit(f"RUNALL_CPU_ONLY names not in SCRIPTS: {sorted(_cpu_extra - set(SCRIPTS))}")
 #: replica_serving is CPU-substrate by design: it measures the replica layer's
 #: dispatch overlap against a synthetic dispatch-bound engine on the emulated
-#: 8-device host mesh, not chip throughput
-CPU_ONLY = {"digits", "serving", "replica_serving"} | _cpu_extra
+#: 8-device host mesh, not chip throughput; lint is pure-Python AST analysis
+#: (tracks tpu-lint's full-repo cost and the suppressed-finding count)
+CPU_ONLY = {"digits", "serving", "replica_serving", "lint"} | _cpu_extra
+
+sys.path.insert(0, str(ROOT))
+
+from unionml_tpu.defaults import env_float  # noqa: E402
 
 PROBE_RETRY_S = 600.0
 #: per-script cap: a healthy run of the longest script (generate, ~15 min with
 #: tunnel compiles) fits comfortably; a wedged run must not cost the old 60 min —
-#: the probe gate makes mid-run wedges the only way to hit this
-SCRIPT_TIMEOUT_S = float(os.environ.get("RUNALL_SCRIPT_TIMEOUT_S", "1800"))
-DEADLINE_S = float(os.environ.get("BENCH_SUITE_DEADLINE_S", str(8 * 3600)))
-
-sys.path.insert(0, str(ROOT))
+#: the probe gate makes mid-run wedges the only way to hit this. env_float: a
+#: typo'd override degrades to the default instead of killing an 8-hour suite
+#: at startup
+SCRIPT_TIMEOUT_S = env_float("RUNALL_SCRIPT_TIMEOUT_S", 1800.0, minimum=1.0)
+DEADLINE_S = env_float("BENCH_SUITE_DEADLINE_S", float(8 * 3600), minimum=1.0)
 
 
 def _log(msg: str) -> None:

@@ -58,6 +58,8 @@ MODULES = [
     "unionml_tpu.serving.overload",
     "unionml_tpu.serving.replicas",
     "unionml_tpu.serving.serverless",
+    "unionml_tpu.analysis",
+    "unionml_tpu.analysis.engine",
     "unionml_tpu.artifact",
     "unionml_tpu.remote",
     "unionml_tpu.launcher",

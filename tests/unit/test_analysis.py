@@ -1,0 +1,513 @@
+"""tpu-lint unit ring: every rule has a must-flag and a near-miss-must-not-flag
+fixture, plus suppression-comment, reporter round-trip, CLI, and env-hardening
+regression coverage. The companion repo-wide gate (the tree itself must be
+lint-clean, under a time budget) lives in test_syntax.py next to the
+``compileall`` gate it extends.
+"""
+
+import json
+import textwrap
+from pathlib import Path
+
+import pytest
+from click.testing import CliRunner
+
+from unionml_tpu.analysis import render_json, render_text, run_lint
+from unionml_tpu.analysis.engine import main as lint_main
+
+REPO = Path(__file__).resolve().parents[2]
+
+
+def lint_source(tmp_path, source, **kwargs):
+    snippet = tmp_path / "snippet.py"
+    snippet.write_text(textwrap.dedent(source))
+    return run_lint([snippet], **kwargs)
+
+
+def rule_ids(result):
+    return [finding.rule for finding in result.findings]
+
+
+# --------------------------------------------------------------------- TPU001
+
+
+def test_tpu001_flags_host_sync_in_jitted_function(tmp_path):
+    result = lint_source(
+        tmp_path,
+        """
+        import jax
+
+        @jax.jit
+        def step(x):
+            print("debugging", x)
+            return float(x) + 1.0
+        """,
+    )
+    assert rule_ids(result) == ["TPU001", "TPU001"]
+    assert "print()" in result.findings[0].message
+    assert "float()" in result.findings[1].message
+
+
+def test_tpu001_follows_intra_module_call_graph(tmp_path):
+    # the sync hides one call away from the jitted entry point — and the same
+    # helper NOT reachable from any jit is left alone
+    result = lint_source(
+        tmp_path,
+        """
+        import numpy as np
+        import jax
+
+        def helper(y):
+            return np.asarray(y)
+
+        @jax.jit
+        def entry(y):
+            return helper(y)
+        """,
+    )
+    assert rule_ids(result) == ["TPU001"]
+    assert "np.asarray" in result.findings[0].message
+
+
+def test_tpu001_near_miss_unjitted_and_static_shape(tmp_path):
+    # host syncs OUTSIDE jit are normal host code; int() on .shape is static
+    # under jit and must not flag
+    result = lint_source(
+        tmp_path,
+        """
+        import numpy as np
+        import jax
+
+        def host_side(y):
+            print("fine here")
+            return np.asarray(y)
+
+        @jax.jit
+        def entry(y):
+            width = int(y.shape[0])
+            return y * width
+        """,
+    )
+    assert result.findings == []
+
+
+def test_tpu001_jit_wrapped_method(tmp_path):
+    # the engine idiom: self._fn = jax.jit(self._impl) marks the method jitted
+    result = lint_source(
+        tmp_path,
+        """
+        import jax
+
+        class Engine:
+            def __init__(self):
+                self._fn = jax.jit(self._impl)
+
+            def _impl(self, x):
+                return x.item()
+        """,
+    )
+    assert rule_ids(result) == ["TPU001"]
+    assert ".item()" in result.findings[0].message
+
+
+# --------------------------------------------------------------------- TPU002
+
+
+def test_tpu002_flags_use_after_donate(tmp_path):
+    result = lint_source(
+        tmp_path,
+        """
+        import jax
+
+        def train(state, batches, step_fn):
+            compiled = jax.jit(step_fn, donate_argnums=0)
+            for batch in batches:
+                out = compiled(state, batch)
+            return state
+        """,
+    )
+    assert rule_ids(result) == ["TPU002"]
+    assert "'state'" in result.findings[0].message
+
+
+def test_tpu002_near_miss_rebound_and_variable_argnums(tmp_path):
+    # rebinding from the result is THE donation idiom; a non-literal
+    # donate_argnums (the debug_disable_donation gate) is not analyzable and
+    # must not be guessed at
+    result = lint_source(
+        tmp_path,
+        """
+        import jax
+
+        def train(state, batches, step_fn, debug_disable_donation=False):
+            donate = () if debug_disable_donation else (0,)
+            compiled = jax.jit(step_fn, donate_argnums=donate)
+            for batch in batches:
+                state, metrics = compiled(state, batch)
+            return state
+
+        def train_literal(state, batches, step_fn):
+            compiled = jax.jit(step_fn, donate_argnums=0)
+            for batch in batches:
+                state, metrics = compiled(state, batch)
+            return state
+        """,
+    )
+    assert result.findings == []
+
+
+def test_tpu002_attribute_jit_and_decorator(tmp_path):
+    result = lint_source(
+        tmp_path,
+        """
+        from functools import partial
+
+        import jax
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def update(carry, x):
+            return carry + x
+
+        class Engine:
+            def __init__(self):
+                self._admit = jax.jit(self._admit_impl, donate_argnums=(0,))
+
+            def _admit_impl(self, cache, row):
+                return cache
+
+            def good(self, cache, row):
+                cache = self._admit(cache, row)
+                return cache
+
+            def bad(self, cache, row):
+                out = self._admit(cache, row)
+                return cache.shape
+
+        def module_level(carry, xs):
+            for x in xs:
+                carry2 = update(carry, x)
+            return carry
+        """,
+    )
+    assert rule_ids(result) == ["TPU002", "TPU002"]
+    lines = sorted(finding.line for finding in result.findings)
+    assert len(lines) == 2  # Engine.bad's `cache.shape` + module_level's `carry`
+
+
+# --------------------------------------------------------------------- TPU003
+
+
+def test_tpu003_flags_unlocked_mutation(tmp_path):
+    result = lint_source(
+        tmp_path,
+        """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.total = 0
+                self.items = []
+
+            def bump(self):
+                self.total += 1
+                self.items.append(1)
+
+            def snapshot(self):
+                with self._lock:
+                    return self.total, list(self.items)
+        """,
+    )
+    assert rule_ids(result) == ["TPU003", "TPU003"]
+
+
+def test_tpu003_near_miss_locked_init_and_locked_suffix(tmp_path):
+    # mutations under the lock, in __init__, or in a *_locked helper (the
+    # caller-holds-the-lock convention) are all clean; so is a class with no
+    # lock at all
+    result = lint_source(
+        tmp_path,
+        """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Condition()
+                self.total = 0
+
+            def bump(self):
+                with self._lock:
+                    self.total += 1
+
+            def _drain_locked(self):
+                self.total = 0
+
+            def snapshot(self):
+                with self._lock:
+                    return self.total
+
+        class NoLock:
+            def __init__(self):
+                self.total = 0
+
+            def bump(self):
+                self.total += 1
+        """,
+    )
+    assert result.findings == []
+
+
+def test_tpu003_unguarded_attribute_not_flagged(tmp_path):
+    # an attribute NEVER touched under the lock (engine-thread-only state like
+    # the decode carry) is outside the discipline and must not flag
+    result = lint_source(
+        tmp_path,
+        """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._carry = None
+                self.guarded = 0
+
+            def _decode(self):
+                self._carry = (1, 2)
+
+            def stats(self):
+                with self._lock:
+                    return self.guarded
+        """,
+    )
+    assert result.findings == []
+
+
+# --------------------------------------------------------------------- TPU004
+
+
+def test_tpu004_flags_blocking_in_loops_and_async(tmp_path):
+    result = lint_source(
+        tmp_path,
+        """
+        import subprocess
+        import time
+
+        class Engine:
+            def _engine_loop(self):
+                while True:
+                    time.sleep(0.1)
+
+            async def handle_predict(self, request):
+                subprocess.run(["echo", "hi"])
+                return request
+        """,
+    )
+    assert rule_ids(result) == ["TPU004", "TPU004"]
+
+
+def test_tpu004_near_miss_plain_method(tmp_path):
+    # a throttle in a plain watcher method (not a handler, not a *_loop, not
+    # async) is ordinary host code
+    result = lint_source(
+        tmp_path,
+        """
+        import time
+
+        class Watcher:
+            def poll(self):
+                time.sleep(0.5)
+
+        def wait_for_backend():
+            time.sleep(1.0)
+        """,
+    )
+    assert result.findings == []
+
+
+# --------------------------------------------------------------------- TPU005
+
+
+def test_tpu005_flags_bare_env_parse(tmp_path):
+    result = lint_source(
+        tmp_path,
+        """
+        import os
+
+        REPLICAS = int(os.environ.get("REPLICAS", "0"))
+
+        def heartbeat():
+            raw = os.getenv("HEARTBEAT_S")
+            return float(raw)
+        """,
+    )
+    assert rule_ids(result) == ["TPU005", "TPU005"]
+
+
+def test_tpu005_near_miss_guarded_parse(tmp_path):
+    # the hardened pattern: try/except ValueError with a fallback — and
+    # int() on non-env values is out of scope entirely
+    result = lint_source(
+        tmp_path,
+        """
+        import os
+
+        def replicas():
+            try:
+                return max(int(os.environ.get("REPLICAS", "0")), 0)
+            except ValueError:
+                return 0
+
+        def plain(value):
+            return int(value)
+        """,
+    )
+    assert result.findings == []
+
+
+# --------------------------------------------- suppressions, reporters, CLI
+
+
+def test_suppression_comment_silences_named_rule(tmp_path):
+    result = lint_source(
+        tmp_path,
+        """
+        import os
+
+        A = int(os.environ.get("A", "0"))  # tpu-lint: disable=TPU005
+        B = int(os.environ.get("B", "0"))  # tpu-lint: disable=TPU001
+        C = int(os.environ.get("C", "0"))  # tpu-lint: disable=all
+        """,
+    )
+    # A and C suppressed; B's comment names the wrong rule so the finding stands
+    assert rule_ids(result) == ["TPU005"]
+    assert result.findings[0].line == 5
+    assert [finding.line for finding in result.suppressed] == [4, 6]
+    assert result.exit_code() == 1
+
+
+def test_suppressed_only_tree_is_clean_exit(tmp_path):
+    result = lint_source(
+        tmp_path,
+        """
+        import os
+
+        A = int(os.environ.get("A", "0"))  # tpu-lint: disable=TPU005
+        """,
+    )
+    assert result.clean and result.exit_code() == 0
+    assert len(result.suppressed) == 1
+
+
+def test_json_reporter_round_trip(tmp_path):
+    result = lint_source(
+        tmp_path,
+        """
+        import os
+
+        A = int(os.environ.get("A", "0"))
+        B = int(os.environ.get("B", "0"))  # tpu-lint: disable=TPU005
+        """,
+    )
+    payload = json.loads(render_json(result))
+    assert payload["version"] == 1
+    assert payload["counts"] == {"TPU005": 1}
+    assert payload["exit_code"] == 1
+    assert len(payload["findings"]) == 1 and len(payload["suppressed"]) == 1
+    finding = payload["findings"][0]
+    assert finding["rule"] == "TPU005" and finding["line"] == 4
+    assert finding["path"].endswith("snippet.py")
+    # text reporter carries the same location and a summary line
+    text = render_text(result, show_suppressed=True)
+    assert "snippet.py:4" in text and "[suppressed]" in text
+    assert "1 finding(s), 1 suppressed" in text
+
+
+def test_select_and_ignore(tmp_path):
+    source = """
+        import os
+        import time
+
+        A = int(os.environ.get("A", "0"))
+
+        class Engine:
+            def _engine_loop(self):
+                time.sleep(1)
+    """
+    only_env = lint_source(tmp_path, source, select=["TPU005"])
+    assert rule_ids(only_env) == ["TPU005"]
+    no_env = lint_source(tmp_path, source, ignore=["TPU005"])
+    assert rule_ids(no_env) == ["TPU004"]
+    with pytest.raises(ValueError, match="unknown rule"):
+        lint_source(tmp_path, source, select=["TPU999"])
+
+
+def test_engine_main_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import os\nA = int(os.environ['A'])\n")
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    assert lint_main([str(clean)]) == 0
+    assert lint_main([str(bad)]) == 1
+    capsys.readouterr()
+    assert lint_main([str(bad), "--format", "json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["counts"] == {"TPU005": 1}
+    assert lint_main([str(tmp_path / "missing.py")]) == 2
+    assert lint_main([str(bad), "--select", "NOPE"]) == 2
+    syntax_error = tmp_path / "broken.py"
+    syntax_error.write_text("def f(:\n")
+    assert lint_main([str(syntax_error)]) == 2
+
+
+def test_cli_lint_command(tmp_path):
+    from unionml_tpu.cli import app
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("import os\nA = int(os.environ['A'])\n")
+    runner = CliRunner()
+    result = runner.invoke(app, ["lint", str(bad)])
+    assert result.exit_code == 1
+    assert "TPU005" in result.output
+    result = runner.invoke(app, ["lint", str(bad), "--format", "json"])
+    assert result.exit_code == 1
+    assert json.loads(result.output)["counts"] == {"TPU005": 1}
+    result = runner.invoke(app, ["lint", str(bad), "--ignore", "TPU005"])
+    assert result.exit_code == 0
+
+
+# ------------------------------------------------- env-hardening regression
+
+
+def test_serve_dp_replicas_tolerates_garbage(monkeypatch, caplog):
+    from unionml_tpu._logging import logger
+    from unionml_tpu.defaults import SERVE_DP_REPLICAS_ENV_VAR, serve_dp_replicas
+
+    monkeypatch.setattr(logger, "propagate", True)  # let caplog's root handler see records
+    monkeypatch.delenv(SERVE_DP_REPLICAS_ENV_VAR, raising=False)
+    assert serve_dp_replicas() == 0
+    monkeypatch.setenv(SERVE_DP_REPLICAS_ENV_VAR, "3")
+    assert serve_dp_replicas() == 3
+    monkeypatch.setenv(SERVE_DP_REPLICAS_ENV_VAR, "-2")
+    assert serve_dp_replicas() == 0  # clamped, not crashed
+    with caplog.at_level("WARNING", logger="unionml_tpu"):
+        monkeypatch.setenv(SERVE_DP_REPLICAS_ENV_VAR, "abc")
+        assert serve_dp_replicas() == 0
+    assert any("abc" in record.message for record in caplog.records)
+
+
+def test_env_helpers_warn_and_fall_back(monkeypatch, caplog):
+    from unionml_tpu._logging import logger
+    from unionml_tpu.defaults import env_float, env_int
+
+    monkeypatch.setattr(logger, "propagate", True)  # let caplog's root handler see records
+    monkeypatch.setenv("UNIONML_TPU_TEST_KNOB", "not-a-number")
+    with caplog.at_level("WARNING", logger="unionml_tpu"):
+        assert env_int("UNIONML_TPU_TEST_KNOB", 7) == 7
+        assert env_float("UNIONML_TPU_TEST_KNOB", 2.5) == 2.5
+    assert sum("not-a-number" in record.message for record in caplog.records) == 2
+    monkeypatch.setenv("UNIONML_TPU_TEST_KNOB", "  42 ")
+    assert env_int("UNIONML_TPU_TEST_KNOB", 7) == 42
+    monkeypatch.setenv("UNIONML_TPU_TEST_KNOB", "0.05")
+    assert env_float("UNIONML_TPU_TEST_KNOB", 5.0, minimum=0.1) == 0.1
+    monkeypatch.setenv("UNIONML_TPU_TEST_KNOB", "")
+    assert env_int("UNIONML_TPU_TEST_KNOB", 7) == 7

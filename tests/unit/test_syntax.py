@@ -1,4 +1,4 @@
-"""Fast syntax gate for the whole tree.
+"""Fast static-analysis gate for the whole tree: syntax + tpu-lint.
 
 A SyntaxError in a module that tests import (docs/build.py had one — an
 f-string expression containing a backslash, illegal before Python 3.12) breaks
@@ -7,12 +7,20 @@ error and silently stops running every test in that file. This gate compiles
 every source file directly, so a syntax regression fails THIS test loudly with
 the offending file and line instead.
 
-Equivalent CLI gate (usable as a pre-commit / CI step on its own):
-``python -m compileall -q unionml_tpu docs tests``.
+The second gate runs tpu-lint (:mod:`unionml_tpu.analysis`) over the package:
+the tree must stay clean — real findings get fixed, justified exceptions carry
+an inline ``# tpu-lint: disable=RULE`` with a why-comment — so the analyzer is
+a permanent CI gate, not a demo. A time-budget assertion keeps the whole gate
+inside the tier-1 envelope.
+
+Equivalent CLI gates (usable as pre-commit / CI steps on their own):
+``python -m compileall -q unionml_tpu docs tests`` and
+``unionml-tpu lint unionml_tpu``.
 """
 
 import compileall
 import re
+import time
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parents[2]
@@ -40,3 +48,32 @@ def test_every_source_file_compiles():
         + " ".join(_TREES)
         + "` for details"
     )
+
+
+def test_tree_is_lint_clean():
+    """The package passes tpu-lint with zero active findings (fixed, or
+    suppressed inline with a justification) — and fast enough to stay a
+    tier-1 gate."""
+    from unionml_tpu.analysis import render_text, run_lint
+
+    start = time.perf_counter()
+    result = run_lint([REPO / "unionml_tpu"])
+    elapsed = time.perf_counter() - start
+    assert result.clean, "tpu-lint findings (fix, or suppress with justification):\n" + render_text(result)
+    assert result.files > 50, "lint walked suspiciously few files — path wiring broke"
+    # perf budget: the gate must not eat the tier-1 envelope. ~0.5s today on
+    # this host; 5s leaves headroom for tree growth without masking an
+    # accidentally quadratic rule
+    assert elapsed < 5.0, f"lint run took {elapsed:.1f}s (> 5s budget)"
+
+
+def test_lint_gate_fails_on_seeded_violation(tmp_path):
+    """The gate actually gates: a seeded violation exits non-zero through the
+    same entry points the CI/CLI use."""
+    from unionml_tpu.analysis import run_lint
+    from unionml_tpu.analysis.engine import main as lint_main
+
+    seeded = tmp_path / "seeded.py"
+    seeded.write_text("import os\nWORKERS = int(os.environ['WORKERS'])\n")
+    assert not run_lint([seeded]).clean
+    assert lint_main([str(seeded)]) == 1

@@ -1,0 +1,35 @@
+"""tpu-lint: a TPU/concurrency-aware static analyzer for this codebase.
+
+Five AST rules target the hazard classes the serving/training stack actually
+has (host syncs under jit, use-after-donate, unlocked cross-thread mutation,
+blocking calls in engine loops, bare env-var numeric parses); the engine walks
+files, applies per-line ``# tpu-lint: disable=RULE`` suppressions, and renders
+text or JSON. Run it as ``unionml-tpu lint [paths]`` or
+``python -m unionml_tpu.analysis``; the tier-1 gate
+(tests/unit/test_syntax.py) asserts ``run_lint(["unionml_tpu"])`` stays clean.
+See docs/static-analysis.md for the rule catalog.
+"""
+
+from __future__ import annotations
+
+from unionml_tpu.analysis.engine import (
+    Finding,
+    LintResult,
+    Rule,
+    all_rules,
+    main,
+    render_json,
+    render_text,
+    run_lint,
+)
+
+__all__ = [
+    "Finding",
+    "LintResult",
+    "Rule",
+    "all_rules",
+    "main",
+    "render_json",
+    "render_text",
+    "run_lint",
+]

@@ -1,0 +1,8 @@
+"""``python -m unionml_tpu.analysis [paths] [--format json] [--select ...]``."""
+
+import sys
+
+from unionml_tpu.analysis.engine import main
+
+if __name__ == "__main__":
+    sys.exit(main())

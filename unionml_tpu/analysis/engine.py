@@ -1,0 +1,264 @@
+"""tpu-lint rule engine: walk files, dispatch rules, report findings.
+
+The repo's only static gate used to be the ``compileall`` syntax check
+(tests/unit/test_syntax.py) — which exists because a trivially lintable
+f-string bug once broke docs collection. The hazards that actually cost TPU
+time are semantic, not syntactic: a host sync inside a jitted function turns
+an async dispatch into a device round-trip, a donated buffer read after the
+call is a use-after-free, an unlocked cross-thread attribute mutation is a
+race that only fires under production load. Each is mechanically visible in
+the AST; this engine makes them review-time failures instead of TPU-time
+mysteries (the same layering JAX's own lint/pytype gates give the upstream
+stack).
+
+Architecture: one :func:`ast.parse` per file, every selected rule visits the
+same tree (rules are stateless classes with a ``check(tree, path)`` method),
+findings funnel through per-line ``# tpu-lint: disable=RULE`` suppressions
+into a :class:`LintResult`. Reporters render text (``path:line: RULE id:
+message``) or a stable JSON schema (``{"findings": [...], "counts": ...}``)
+that the benchmark lane tracks across rounds. Exit codes: 0 clean (justified
+suppressions included), 1 findings, 2 usage/parse errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import json
+import re
+import sys
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Finding",
+    "LintResult",
+    "Rule",
+    "all_rules",
+    "main",
+    "render_json",
+    "render_text",
+    "run_lint",
+]
+
+#: ``# tpu-lint: disable=TPU001`` or ``disable=TPU001,TPU003`` or ``disable=all``,
+#: anywhere on the offending line (typically a trailing comment)
+_SUPPRESS_RE = re.compile(r"#\s*tpu-lint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation, addressable as ``path:line``."""
+
+    rule: str  #: rule id, e.g. "TPU003"
+    path: str  #: file path as given to the walker
+    line: int  #: 1-indexed source line
+    col: int  #: 0-indexed column
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+class Rule:
+    """Base class for tpu-lint rules.
+
+    Subclasses set ``id``/``title`` and implement :meth:`check`. Rules are
+    stateless across files — the engine instantiates each once per run and
+    calls ``check`` per file, so a rule must not carry per-file state between
+    calls (everything it needs is derivable from the tree).
+    """
+
+    id: str = ""
+    title: str = ""
+
+    def check(self, tree: ast.Module, path: str) -> "List[Finding]":
+        raise NotImplementedError
+
+    def finding(self, path: str, node: ast.AST, message: str) -> Finding:
+        return Finding(
+            rule=self.id,
+            path=path,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0),
+            message=message,
+        )
+
+
+def all_rules() -> "List[Rule]":
+    """Fresh instances of every registered rule, in id order."""
+    from unionml_tpu.analysis.rules import RULES
+
+    return [cls() for _, cls in sorted(RULES.items())]
+
+
+@dataclasses.dataclass
+class LintResult:
+    """What a lint run produced: active findings, suppressed findings, errors."""
+
+    findings: "List[Finding]" = dataclasses.field(default_factory=list)
+    suppressed: "List[Finding]" = dataclasses.field(default_factory=list)
+    #: files that failed to parse (path, message) — reported and exit-coded 2,
+    #: since an unparseable file is a gate failure of its own
+    errors: "List[Tuple[str, str]]" = dataclasses.field(default_factory=list)
+    files: int = 0
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings and not self.errors
+
+    def counts(self) -> "Dict[str, int]":
+        out: "Dict[str, int]" = {}
+        for finding in self.findings:
+            out[finding.rule] = out.get(finding.rule, 0) + 1
+        return out
+
+    def exit_code(self) -> int:
+        if self.errors:
+            return 2
+        return 0 if not self.findings else 1
+
+
+def iter_py_files(paths: "Sequence[str | Path]") -> "List[Path]":
+    """Expand files/directories into a sorted, deduplicated .py file list."""
+    seen: "Dict[Path, None]" = {}
+    for raw in paths:
+        path = Path(raw)
+        if path.is_dir():
+            for sub in sorted(path.rglob("*.py")):
+                if "__pycache__" not in sub.parts and ".git" not in sub.parts:
+                    seen.setdefault(sub, None)
+        elif path.suffix == ".py":
+            seen.setdefault(path, None)
+        elif not path.exists():
+            raise FileNotFoundError(f"lint path does not exist: {path}")
+    return sorted(seen)
+
+
+def _suppressions(source: str) -> "Dict[int, set]":
+    """Map of 1-indexed line -> rule ids (or {"ALL"}) disabled on that line."""
+    out: "Dict[int, set]" = {}
+    for lineno, line in enumerate(source.splitlines(), start=1):
+        match = _SUPPRESS_RE.search(line)
+        if match is None:
+            continue
+        ids = {part.strip().upper() for part in match.group(1).split(",") if part.strip()}
+        out[lineno] = ids
+    return out
+
+
+def _select_rules(
+    select: "Optional[Iterable[str]]" = None, ignore: "Optional[Iterable[str]]" = None
+) -> "List[Rule]":
+    rules = all_rules()
+    known = {rule.id for rule in rules}
+    for group, ids in (("select", select), ("ignore", ignore)):
+        unknown = {i.upper() for i in ids or ()} - known
+        if unknown:
+            raise ValueError(f"unknown rule id(s) in --{group}: {', '.join(sorted(unknown))}")
+    if select:
+        wanted = {i.upper() for i in select}
+        rules = [rule for rule in rules if rule.id in wanted]
+    if ignore:
+        dropped = {i.upper() for i in ignore}
+        rules = [rule for rule in rules if rule.id not in dropped]
+    return rules
+
+
+def run_lint(
+    paths: "Sequence[str | Path]",
+    *,
+    select: "Optional[Iterable[str]]" = None,
+    ignore: "Optional[Iterable[str]]" = None,
+) -> LintResult:
+    """Lint ``paths`` (files and/or directory trees) with the selected rules.
+
+    This is the library surface the tier-1 gate calls (``run_lint(["unionml_tpu"])``
+    must be clean); the CLI in :func:`main` is a thin reporter over it.
+    """
+    rules = _select_rules(select, ignore)
+    result = LintResult()
+    for path in iter_py_files(paths):
+        try:
+            source = path.read_text()
+            tree = ast.parse(source, filename=str(path))
+        except (OSError, SyntaxError, ValueError) as exc:
+            result.errors.append((str(path), str(exc)))
+            continue
+        result.files += 1
+        disabled = _suppressions(source)
+        for rule in rules:
+            for finding in rule.check(tree, str(path)):
+                ids = disabled.get(finding.line, ())
+                if finding.rule in ids or "ALL" in ids:
+                    result.suppressed.append(finding)
+                else:
+                    result.findings.append(finding)
+    result.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    result.suppressed.sort(key=lambda f: (f.path, f.line, f.rule))
+    return result
+
+
+def render_text(result: LintResult, *, show_suppressed: bool = False) -> str:
+    lines = [finding.render() for finding in result.findings]
+    if show_suppressed:
+        lines += [f"{finding.render()} [suppressed]" for finding in result.suppressed]
+    for path, message in result.errors:
+        lines.append(f"{path}: PARSE-ERROR {message}")
+    summary = (
+        f"{len(result.findings)} finding(s), {len(result.suppressed)} suppressed, "
+        f"{result.files} file(s) checked"
+    )
+    lines.append(summary)
+    return "\n".join(lines)
+
+
+def render_json(result: LintResult) -> str:
+    """Stable JSON schema (version 1) — the benchmark lane and external CI
+    consume this, so field names are a contract."""
+    payload = {
+        "version": 1,
+        "files": result.files,
+        "findings": [dataclasses.asdict(finding) for finding in result.findings],
+        "suppressed": [dataclasses.asdict(finding) for finding in result.suppressed],
+        "errors": [{"path": path, "message": message} for path, message in result.errors],
+        "counts": result.counts(),
+        "exit_code": result.exit_code(),
+    }
+    return json.dumps(payload, indent=2)
+
+
+def main(argv: "Optional[Sequence[str]]" = None) -> int:
+    """``python -m unionml_tpu.analysis [paths]`` entry point (also backs the
+    ``unionml-tpu lint`` CLI command)."""
+    parser = argparse.ArgumentParser(
+        prog="tpu-lint",
+        description="TPU/concurrency-aware static analyzer (rules TPU001-TPU005)",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help="files or directories (default: the installed unionml_tpu package tree)",
+    )
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--select", default=None, help="comma-separated rule ids to run")
+    parser.add_argument("--ignore", default=None, help="comma-separated rule ids to skip")
+    parser.add_argument(
+        "--show-suppressed", action="store_true", help="list suppressed findings in text output"
+    )
+    args = parser.parse_args(argv)
+    # no paths: lint the package itself, wherever it is installed — so
+    # `python -m unionml_tpu.analysis` works from any working directory
+    paths = args.paths or [Path(__file__).resolve().parents[1]]
+    split = lambda raw: [part.strip() for part in raw.split(",") if part.strip()] if raw else None
+    try:
+        result = run_lint(paths, select=split(args.select), ignore=split(args.ignore))
+    except (FileNotFoundError, ValueError) as exc:
+        print(f"tpu-lint: {exc}", file=sys.stderr)
+        return 2
+    if args.format == "json":
+        print(render_json(result))
+    else:
+        print(render_text(result, show_suppressed=args.show_suppressed))
+    return result.exit_code()

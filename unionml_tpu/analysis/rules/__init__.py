@@ -1,0 +1,29 @@
+"""tpu-lint rule registry.
+
+Each rule lives in its own module; adding a rule is: write the module, import
+its class here, add it to :data:`RULES`. The engine instantiates from this
+mapping (:func:`unionml_tpu.analysis.engine.all_rules`), so the registry is
+the single source of truth for ``--select``/``--ignore`` validation and the
+docs rule catalog.
+"""
+
+from __future__ import annotations
+
+from unionml_tpu.analysis.rules.tpu001_host_sync import HostSyncInJit
+from unionml_tpu.analysis.rules.tpu002_donate import UseAfterDonate
+from unionml_tpu.analysis.rules.tpu003_locks import UnlockedSharedMutation
+from unionml_tpu.analysis.rules.tpu004_blocking import BlockingCallInServingLoop
+from unionml_tpu.analysis.rules.tpu005_env import BareEnvNumericParse
+
+__all__ = ["RULES"]
+
+RULES = {
+    cls.id: cls
+    for cls in (
+        HostSyncInJit,
+        UseAfterDonate,
+        UnlockedSharedMutation,
+        BlockingCallInServingLoop,
+        BareEnvNumericParse,
+    )
+}

@@ -1,0 +1,197 @@
+"""TPU002 — use of a buffer after passing it at a donated position.
+
+``jax.jit(fn, donate_argnums=...)`` hands the argument's device buffer to XLA
+for in-place reuse: after the call the Python object still exists but its
+buffer is deleted, and touching it raises (or, through stale references on
+some backends, silently reads garbage). The correct idiom rebinds the name
+from the call's result — ``state = step(state, batch)`` — which this rule
+recognizes as safe. Only literal ``donate_argnums`` are analyzed: a variable
+value (e.g. gated on ``debug_disable_donation``) cannot be resolved
+statically and is never guessed.
+
+Scope: same-file dataflow. Donating callables are collected from local
+``f = jax.jit(g, donate_argnums=...)`` bindings, class-wide
+``self._f = jax.jit(...)`` attributes, ``@partial(jax.jit, donate_argnums=...)``
+decorators, and immediate ``jax.jit(g, ...)(args)`` invocations; every call
+site is then checked for later loads of the donated argument names.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from unionml_tpu.analysis.engine import Finding, Rule
+from unionml_tpu.analysis.rules._common import (
+    assign_target_names,
+    call_target,
+    dotted,
+    is_jit_decorator,
+    iter_scope,
+    jit_wrap_call,
+    literal_argnums,
+)
+
+
+def _donated_positions(call: ast.Call) -> "Optional[Tuple[int, ...]]":
+    for keyword in call.keywords:
+        if keyword.arg == "donate_argnums":
+            return literal_argnums(keyword.value)
+    return None
+
+
+class UseAfterDonate(Rule):
+    id = "TPU002"
+    title = "buffer used after being passed at a donated position"
+
+    def check(self, tree: ast.Module, path: str) -> "List[Finding]":
+        findings: "List[Finding]" = []
+        attr_donors = self._class_attribute_donors(tree)
+        module_donors = self._decorated_donors(tree)
+        # module level counts as a scope too (module-scope jit wrap + call)
+        scopes: "List[ast.AST]" = [tree]
+        scopes += [n for n in ast.walk(tree) if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for scope in scopes:
+            findings.extend(self._check_scope(scope, path, attr_donors, module_donors))
+        return findings
+
+    # ------------------------------------------------------------ donor discovery
+
+    @staticmethod
+    def _class_attribute_donors(tree: ast.Module) -> "Dict[str, Tuple[int, ...]]":
+        """``self._f = jax.jit(..., donate_argnums=<literal>)`` anywhere in a
+        class -> ``{"self._f": positions}`` (methods of the same class call
+        through the attribute)."""
+        donors: "Dict[str, Tuple[int, ...]]" = {}
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                continue
+            target = dotted(node.targets[0])
+            wrap = jit_wrap_call(node.value)
+            if target is None or wrap is None or not target.startswith(("self.", "cls.")):
+                continue
+            positions = _donated_positions(wrap)
+            if positions:
+                donors["self." + target.split(".", 1)[1]] = positions
+        return donors
+
+    @staticmethod
+    def _decorated_donors(tree: ast.Module) -> "Dict[str, Tuple[int, ...]]":
+        """``@partial(jax.jit, donate_argnums=<literal>)`` functions, callable
+        by bare name within the module."""
+        donors: "Dict[str, Tuple[int, ...]]" = {}
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) and is_jit_decorator(dec):
+                    positions = _donated_positions(dec)
+                    if positions:
+                        donors[node.name] = positions
+        return donors
+
+    # ------------------------------------------------------------ per-scope check
+
+    def _check_scope(
+        self,
+        scope: ast.AST,
+        path: str,
+        attr_donors: "Dict[str, Tuple[int, ...]]",
+        module_donors: "Dict[str, Tuple[int, ...]]",
+    ) -> "List[Finding]":
+        donors = dict(module_donors)
+        donors.update(attr_donors)
+        statements = list(iter_scope(scope))
+        # pass 1: local `f = jax.jit(g, donate_argnums=...)` bindings
+        for node in statements:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = dotted(node.targets[0])
+                wrap = jit_wrap_call(node.value)
+                if target and wrap:
+                    positions = _donated_positions(wrap)
+                    if positions:
+                        donors[target] = positions
+
+        # pass 2: call sites -> (donated name, call line, rebound?)
+        donations: "List[Tuple[str, int]]" = []
+        for call in statements:
+            if not isinstance(call, ast.Call):
+                continue
+            positions = self._call_donated_positions(call, donors)
+            if positions is None:
+                continue
+            if any(isinstance(arg, ast.Starred) for arg in call.args):
+                continue  # positions unknowable through *args
+            rebound = self._rebound_names(statements, call)
+            for pos in positions:
+                if pos >= len(call.args):
+                    continue
+                arg = call.args[pos]
+                if isinstance(arg, ast.Name) and arg.id not in rebound:
+                    donations.append((arg.id, call.lineno))
+
+        # pass 3: later loads of donated names (until the name is re-bound)
+        findings: "List[Finding]" = []
+        flagged: "Set[Tuple[str, int]]" = set()
+        for name, donated_at in donations:
+            rebind_line = self._first_store_after(scope, name, donated_at)
+            for node in iter_scope(scope):
+                if (
+                    isinstance(node, ast.Name)
+                    and node.id == name
+                    and isinstance(node.ctx, ast.Load)
+                    and node.lineno > donated_at
+                    and (rebind_line is None or node.lineno < rebind_line)
+                    and (name, node.lineno) not in flagged
+                ):
+                    flagged.add((name, node.lineno))
+                    findings.append(
+                        self.finding(
+                            path, node,
+                            f"'{name}' was donated to a jit-compiled call on line {donated_at} "
+                            "(donate_argnums) — its buffer is deleted after the call; rebind the "
+                            "name from the call's result instead",
+                        )
+                    )
+        return findings
+
+    @staticmethod
+    def _call_donated_positions(call: ast.Call, donors: "Dict[str, Tuple[int, ...]]"):
+        target = call_target(call)
+        if target is not None:
+            if target.startswith(("self.", "cls.")):
+                target = "self." + target.split(".", 1)[1]
+            if target in donors:
+                return donors[target]
+        # immediate invocation: jax.jit(g, donate_argnums=...)(args)
+        wrap = jit_wrap_call(call.func)
+        if wrap is not None:
+            return _donated_positions(wrap)
+        return None
+
+    @staticmethod
+    def _rebound_names(statements, call: ast.Call) -> "Set[str]":
+        """Names the call's own result assignment rebinds (``a, b = f(a, x)``
+        consumes and replaces ``a`` — the donation-safe idiom)."""
+        for node in statements:
+            if isinstance(node, ast.Assign) and node.value is call:
+                out: "Set[str]" = set()
+                for target in node.targets:
+                    out.update(assign_target_names(target))
+                return out
+            if isinstance(node, ast.AugAssign) and node.value is call:
+                name = dotted(node.target)
+                return {name} if name else set()
+        return set()
+
+    @staticmethod
+    def _first_store_after(scope: ast.AST, name: str, line: int) -> "Optional[int]":
+        stores = [
+            node.lineno
+            for node in iter_scope(scope)
+            if isinstance(node, ast.Name)
+            and node.id == name
+            and isinstance(node.ctx, (ast.Store, ast.Del))
+            and node.lineno > line
+        ]
+        return min(stores) if stores else None

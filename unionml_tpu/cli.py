@@ -74,6 +74,47 @@ def templates() -> None:
         click.echo(name)
 
 
+@app.command("lint")
+@click.argument("paths", nargs=-1, metavar="[PATHS]...")
+@click.option(
+    "--format",
+    "format_",
+    type=click.Choice(["text", "json"]),
+    default="text",
+    show_default=True,
+    help="report format (json follows the stable schema docs/static-analysis.md describes)",
+)
+@click.option("--select", default=None, help="comma-separated rule ids to run (default: all)")
+@click.option("--ignore", default=None, help="comma-separated rule ids to skip")
+@click.option(
+    "--show-suppressed",
+    is_flag=True,
+    default=False,
+    help="also list findings silenced by `# tpu-lint: disable=RULE` comments",
+)
+def lint(
+    paths: "tuple[str, ...]", format_: str, select: Optional[str], ignore: Optional[str], show_suppressed: bool
+) -> None:
+    """Run tpu-lint, the TPU/concurrency-aware static analyzer (TPU001-TPU005).
+
+    Checks for host syncs inside jit-compiled functions, use-after-donate,
+    unlocked mutation of lock-guarded state, blocking calls in serving
+    handlers/engine loops, and bare env-var numeric parses. PATHS defaults to
+    ``unionml_tpu``; exits 0 when clean, 1 on findings, 2 on usage/parse
+    errors. Also runnable as ``python -m unionml_tpu.analysis``.
+    """
+    from unionml_tpu.analysis.engine import main as lint_main
+
+    argv = list(paths) + ["--format", format_]
+    if select:
+        argv += ["--select", select]
+    if ignore:
+        argv += ["--ignore", ignore]
+    if show_suppressed:
+        argv.append("--show-suppressed")
+    sys.exit(lint_main(argv))
+
+
 @app.command("deploy")
 @click.argument("app_ref", metavar="APP")
 @click.option("--app-version", default=None, help="app version; defaults to the git HEAD sha")

@@ -10,6 +10,8 @@ from __future__ import annotations
 import dataclasses
 import os
 
+from unionml_tpu._logging import logger
+
 
 @dataclasses.dataclass(frozen=True)
 class Resources:
@@ -70,12 +72,49 @@ SERVE_RETRY_AFTER_S = 1
 SERVE_DP_REPLICAS_ENV_VAR = "UNIONML_TPU_DP_REPLICAS"
 
 
+def env_int(name: str, default: int, *, minimum: "int | None" = None) -> int:
+    """Parse an integer env var, tolerating garbage: unset/empty -> ``default``,
+    a non-integer value warns and falls back to ``default`` instead of raising
+    ``ValueError`` at whatever moment the knob happens to be read (for serve
+    knobs that is import/export time in ``cli.py serve`` — a typo'd deployment
+    env must degrade to the default, not take the service down). ``minimum``
+    clamps the parsed value (e.g. a negative replica count means 0)."""
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        value = int(raw.strip())
+    except ValueError:
+        logger.warning(f"ignoring non-integer {name}={raw!r}; falling back to {default}")
+        return default
+    if minimum is not None and value < minimum:
+        logger.warning(f"clamping {name}={value} to the minimum {minimum}")
+        return minimum
+    return value
+
+
+def env_float(name: str, default: float, *, minimum: "float | None" = None) -> float:
+    """:func:`env_int` for float-valued knobs (same warn-and-fall-back
+    contract; a garbage value must never crash the reader)."""
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        value = float(raw.strip())
+    except ValueError:
+        logger.warning(f"ignoring non-numeric {name}={raw!r}; falling back to {default}")
+        return default
+    if minimum is not None and value < minimum:
+        logger.warning(f"clamping {name}={value} to the minimum {minimum}")
+        return minimum
+    return value
+
+
 def serve_dp_replicas() -> int:
     """The serve-time data-parallel replica override; 0 = unset (derive the
     replica count from the mesh's data/fsdp axes). Read at call time, not
     import time — engine construction usually happens long after this module
-    imports, and the CLI sets the env var in between."""
-    try:
-        return max(int(os.environ.get(SERVE_DP_REPLICAS_ENV_VAR, "0")), 0)
-    except ValueError:
-        return 0
+    imports, and the CLI sets the env var in between. Garbage values
+    (``UNIONML_TPU_DP_REPLICAS=abc``) warn and fall back to 0 rather than
+    crashing ``serve`` at app-import time."""
+    return env_int(SERVE_DP_REPLICAS_ENV_VAR, 0, minimum=0)

@@ -30,6 +30,7 @@ import traceback
 from pathlib import Path
 
 from unionml_tpu._logging import logger
+from unionml_tpu.defaults import env_float, env_int
 
 
 def _start_heartbeat(exec_path: Path, my_attempt: int) -> threading.Event:
@@ -39,7 +40,7 @@ def _start_heartbeat(exec_path: Path, my_attempt: int) -> threading.Event:
     declared this worker lost and resubmitted — a stalled-but-alive worker waking
     back up must not race the new attempt for the outputs dir, so it kills itself.
     """
-    interval = float(os.environ.get("UNIONML_TPU_HEARTBEAT_S", "5"))
+    interval = env_float("UNIONML_TPU_HEARTBEAT_S", 5.0, minimum=0.1)
     stop = threading.Event()
     heartbeat = exec_path / "heartbeat"
 
@@ -73,7 +74,7 @@ def _maybe_inject_fault(exec_path: Path) -> None:
     lost-single-host scenario on a multi-worker slice (its peers block in the
     first collective until the watchdog reaps them).
     """
-    inject_below = int(os.environ.get("UNIONML_TPU_FAULT_INJECT", "0"))
+    inject_below = env_int("UNIONML_TPU_FAULT_INJECT", 0)
     if _current_attempt(exec_path) >= inject_below:
         return
     target = os.environ.get("UNIONML_TPU_FAULT_INJECT_PROCESS")
@@ -92,8 +93,8 @@ def _maybe_init_distributed() -> None:
         # emulated multi-host lane: a TPU plugin on the path would win over the env
         # var, so pin the platform before the backend initializes
         jax.config.update("jax_platforms", "cpu")
-    num_processes = int(os.environ.get("UNIONML_TPU_NUM_PROCESSES", "1"))
-    process_id = int(os.environ.get("UNIONML_TPU_PROCESS_ID", "0"))
+    num_processes = env_int("UNIONML_TPU_NUM_PROCESSES", 1, minimum=1)
+    process_id = env_int("UNIONML_TPU_PROCESS_ID", 0, minimum=0)
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=num_processes,

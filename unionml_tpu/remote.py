@@ -431,7 +431,9 @@ class Backend:
         consume a retry; with ``checkpoint_dir`` configured the retried run resumes
         from the last step checkpoint.
         """
-        interval = float(os.environ.get("UNIONML_TPU_HEARTBEAT_S", "5"))
+        from unionml_tpu.defaults import env_float
+
+        interval = env_float("UNIONML_TPU_HEARTBEAT_S", 5.0, minimum=0.1)
         if heartbeat_timeout is None:
             heartbeat_timeout = 6 * interval
         # a timeout below the beat interval would kill healthy workers between stamps

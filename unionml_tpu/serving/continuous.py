@@ -680,7 +680,10 @@ class ContinuousBatcher:
         if len(prompt) == 0:
             raise ValueError("prompt must be non-empty")
         if expired(deadline):
-            self.shed_deadline += 1
+            # under the lock: submit runs on arbitrary executor threads, and the
+            # engine thread bumps this same counter (lost update otherwise)
+            with self._lock:
+                self.shed_deadline += 1
             raise DeadlineExceeded("deadline expired before the prompt was enqueued")
         budget = self.gen.config.max_new_tokens
         if max_new_tokens is not None:
