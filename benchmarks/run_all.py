@@ -38,6 +38,7 @@ SCRIPTS = {
     "structured": "bench_structured.py",
     "speculative": "bench_speculative.py",
     "continuous": "bench_continuous.py",
+    "continuous_stall": "bench_continuous.py",
     "replica_serving": "bench_replica_serving.py",
     "lint": "bench_lint.py",
     "int8_matmul": "bench_int8_matmul.py",
@@ -60,8 +61,13 @@ if _cpu_extra - set(SCRIPTS):
 #: replica_serving is CPU-substrate by design: it measures the replica layer's
 #: dispatch overlap against a synthetic dispatch-bound engine on the emulated
 #: 8-device host mesh, not chip throughput; lint is pure-Python AST analysis
-#: (tracks tpu-lint's full-repo cost and the suppressed-finding count)
-CPU_ONLY = {"digits", "serving", "replica_serving", "lint"} | _cpu_extra
+#: (tracks tpu-lint's full-repo cost and the suppressed-finding count);
+#: continuous_stall measures the chunked-admission stall REDUCTION — a ratio
+#: of two same-substrate runs, meaningful on the host CPU
+CPU_ONLY = {"digits", "serving", "replica_serving", "continuous_stall", "lint"} | _cpu_extra
+
+#: per-lane env overrides: lanes that reuse a script in a different mode
+LANE_ENV = {"continuous_stall": {"BENCH_STALL_ONLY": "1"}}
 
 sys.path.insert(0, str(ROOT))
 
@@ -208,6 +214,7 @@ def main() -> None:
         _log(f"=== {name} ({path.name}) ===")
         start = time.perf_counter()
         child_env = os.environ.copy()
+        child_env.update(LANE_ENV.get(name, {}))
         if name in CPU_ONLY:
             # CPU-substrate children must never init the tunneled plugin (the
             # ambient env pins JAX_PLATFORMS to axon, and a wedged tunnel would

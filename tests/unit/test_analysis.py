@@ -91,6 +91,47 @@ def test_tpu001_near_miss_unjitted_and_static_shape(tmp_path):
     assert result.findings == []
 
 
+def test_tpu001_flags_module_level_block_until_ready(tmp_path):
+    # both spellings of the fence: the method form x.block_until_ready() was
+    # always flagged; the module-level jax.block_until_ready(x) form is the
+    # same sync and must flag too
+    result = lint_source(
+        tmp_path,
+        """
+        import jax
+
+        @jax.jit
+        def step(x):
+            jax.block_until_ready(x)
+            return x + 1
+        """,
+    )
+    assert rule_ids(result) == ["TPU001"]
+    assert "jax.block_until_ready" in result.findings[0].message
+
+
+def test_tpu001_near_miss_non_jax_block_until_ready(tmp_path):
+    # a same-named helper from ANOTHER module is not jax's fence — only the
+    # dotted jax.block_until_ready form (and the zero-arg method) sync; and
+    # jax.block_until_ready OUTSIDE jit is ordinary host code
+    result = lint_source(
+        tmp_path,
+        """
+        import jax
+        import myfence
+
+        @jax.jit
+        def step(x):
+            myfence.block_until_ready(x)  # someone else's API, takes an arg
+            return x + 1
+
+        def host_side(x):
+            return jax.block_until_ready(x)
+        """,
+    )
+    assert result.findings == []
+
+
 def test_tpu001_jit_wrapped_method(tmp_path):
     # the engine idiom: self._fn = jax.jit(self._impl) marks the method jitted
     result = lint_source(

@@ -645,6 +645,299 @@ def test_speculative_continuous_with_shared_prefix(tiny_gen):
         batcher.close()
 
 
+def test_chunked_admission_streams_match_sequential(tiny_gen):
+    """Stall-free admission: prefill sliced into admit_chunk-token chunks
+    interleaved with decode must be invisible in the output — every stream
+    equals its monolithic/sequential run (the chunked-prefill equality
+    contract), and the chunk counters show the slicing actually happened."""
+    module, params = tiny_gen
+    cfg = GenerationConfig(max_new_tokens=12, temperature=0.0, prompt_buckets=(16,))
+    expected = _sequential_expected(module, params, cfg, PROMPTS)
+
+    batcher = ContinuousBatcher(
+        Generator(module, params, cfg), slots=len(PROMPTS), decode_chunk=4, admit_chunk=4
+    )
+    try:
+        results = [None] * len(PROMPTS)
+
+        def worker(i):
+            results[i] = _drain(batcher.submit(PROMPTS[i]))
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(len(PROMPTS))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert results == expected
+        stats = batcher.stats()
+        assert stats["prefill"]["mode"] == "chunked"
+        assert stats["prefill"]["chunks"] >= len(PROMPTS)  # every admission chunked
+        assert stats["prefill"]["monolithic_admissions"] == 0
+        # TTFT/TBT reservoirs filled (the /metrics surface)
+        assert stats["ttft_ms"]["window"] == len(PROMPTS)
+        assert stats["tbt_ms"]["window"] > 0
+    finally:
+        batcher.close()
+
+
+def test_chunked_admission_interleaves_decode_with_prefill(tiny_gen):
+    """The stall fix itself: while a multi-chunk admission is in flight, the
+    resident stream keeps receiving tokens — decode dispatches land BETWEEN
+    prefill chunks (budget = one chunk per engine iteration), instead of the
+    whole prompt prefilling in one stall."""
+    module, params = tiny_gen
+    cfg = GenerationConfig(max_new_tokens=48, temperature=0.0, prompt_buckets=(4, 16))
+    gen = Generator(module, params, cfg)
+    expected = _sequential_expected(module, params, cfg, [[5, 5, 5], [9] * 12])
+    batcher = ContinuousBatcher(gen, slots=2, decode_chunk=2, admit_chunk=4, prefill_budget=4)
+    try:
+        occupant = batcher.submit([5, 5, 5])
+        first = next(occupant)  # resident and decoding (48-token budget)
+        dispatches_at_chunk = []
+        orig = gen._prefill_chunk
+
+        def spy(*args, **kwargs):
+            dispatches_at_chunk.append(batcher.decode_dispatches)
+            return orig(*args, **kwargs)
+
+        gen._prefill_chunk = spy
+        try:
+            long_out = _drain(batcher.submit([9] * 12))  # bucket 16 -> 4 chunks
+        finally:
+            gen._prefill_chunk = orig
+        occ_out = [int(t) for t in np.asarray(first).ravel()] + _drain(occupant)
+        assert [occ_out, long_out] == expected
+        assert len(dispatches_at_chunk) == 4  # 16 aligned columns / 4-token chunks
+        # decode ran between every pair of chunks: the dispatch counter
+        # strictly increases across the admission instead of freezing
+        assert all(
+            b > a for a, b in zip(dispatches_at_chunk, dispatches_at_chunk[1:])
+        ), dispatches_at_chunk
+    finally:
+        batcher.close()
+
+
+def test_prefill_budget_groups_chunks_per_iteration(tiny_gen):
+    """prefill_budget tokens of prefill run per engine iteration: with a
+    budget of two chunks, chunks land in pairs between decode dispatches."""
+    module, params = tiny_gen
+    cfg = GenerationConfig(max_new_tokens=48, temperature=0.0, prompt_buckets=(4, 32))
+    gen = Generator(module, params, cfg)
+    batcher = ContinuousBatcher(gen, slots=2, decode_chunk=2, admit_chunk=4, prefill_budget=8)
+    try:
+        occupant = batcher.submit([5, 5, 5])
+        next(occupant)
+        dispatches_at_chunk = []
+        orig = gen._prefill_chunk
+
+        def spy(*args, **kwargs):
+            dispatches_at_chunk.append(batcher.decode_dispatches)
+            return orig(*args, **kwargs)
+
+        gen._prefill_chunk = spy
+        try:
+            _drain(batcher.submit([9] * 20, max_new_tokens=2))  # bucket 32 -> 8 chunks
+        finally:
+            gen._prefill_chunk = orig
+        _drain(occupant)
+        assert len(dispatches_at_chunk) == 8
+        # chunks arrive in pairs: both members of a pair see the same decode
+        # count, and decode advances between pairs
+        pairs = list(zip(dispatches_at_chunk[0::2], dispatches_at_chunk[1::2]))
+        assert all(a == b for a, b in pairs), dispatches_at_chunk
+        assert all(n[0] > p[0] for p, n in zip(pairs, pairs[1:])), dispatches_at_chunk
+    finally:
+        batcher.close()
+
+
+def test_cancel_mid_chunked_prefill_frees_slot(tiny_gen):
+    """A consumer disconnect landing between prefill chunks abandons the
+    admission at the next chunk boundary: the slot comes back (no device
+    masking needed — the row was never pasted) and later requests are exact."""
+    module, params = tiny_gen
+    cfg = GenerationConfig(max_new_tokens=8, temperature=0.0, prompt_buckets=(16,))
+    gen = Generator(module, params, cfg)
+    expected = _sequential_expected(module, params, cfg, PROMPTS[:2])
+    batcher = ContinuousBatcher(gen, slots=1, decode_chunk=2, admit_chunk=8)
+    try:
+        entered, gate = threading.Event(), threading.Event()
+        orig = gen._prefill_chunk
+
+        def gated(*args, **kwargs):
+            entered.set()
+            gate.wait(timeout=30)
+            return orig(*args, **kwargs)
+
+        gen._prefill_chunk = gated
+        doomed = batcher.submit(PROMPTS[2])  # bucket 16 -> 2 chunks
+        assert entered.wait(timeout=30)  # engine inside chunk 1 of 2
+        doomed.close()  # cancel lands mid-prefill
+        gate.set()
+        gen._prefill_chunk = orig
+        assert _drain(doomed) == []
+        out = [_drain(batcher.submit(p)) for p in PROMPTS[:2]]
+        assert out == expected
+        stats = batcher.stats()
+        assert stats["resident"] == 0 and stats["waiting"] == 0 and stats["admitting"] == 0
+    finally:
+        batcher.close()
+
+
+def test_deadline_shed_mid_chunked_prefill(tiny_gen):
+    """A deadline expiring between prefill chunks sheds the admission with
+    DeadlineExceeded at the next chunk boundary — the client gave up, so the
+    remaining chunks and the whole decode are never paid — and the freed slot
+    serves the next request exactly."""
+    import time as _time
+
+    module, params = tiny_gen
+    cfg = GenerationConfig(max_new_tokens=8, temperature=0.0, prompt_buckets=(16,))
+    gen = Generator(module, params, cfg)
+    expected = _sequential_expected(module, params, cfg, PROMPTS[:1])
+    batcher = ContinuousBatcher(gen, slots=1, decode_chunk=2, admit_chunk=8)
+    try:
+        from unionml_tpu.serving import DeadlineExceeded
+
+        entered, gate = threading.Event(), threading.Event()
+        orig = gen._prefill_chunk
+
+        def gated(*args, **kwargs):
+            entered.set()
+            gate.wait(timeout=30)
+            return orig(*args, **kwargs)
+
+        gen._prefill_chunk = gated
+        doomed = batcher.submit(PROMPTS[2], deadline=_time.monotonic() + 0.2)
+        assert entered.wait(timeout=30)  # admission started before the deadline
+        _time.sleep(0.3)  # deadline passes while chunk 1 is in flight
+        gate.set()
+        gen._prefill_chunk = orig
+        with pytest.raises(DeadlineExceeded, match="mid-prefill"):
+            _drain(doomed)
+        assert batcher.stats()["shed_deadline"] == 1
+        assert _drain(batcher.submit(PROMPTS[0])) == expected[0]
+    finally:
+        batcher.close()
+
+
+def test_chunked_admission_with_shared_prefix_and_speculative(tiny_gen):
+    """Chunked admission composes with the production trifecta: the draft's
+    row chunks in LOCKSTEP with the target's after both models' prefix rows
+    paste, and every greedy stream equals the sequential plain run."""
+    import dataclasses
+
+    from unionml_tpu.models import DraftSpec
+
+    module, params = tiny_gen
+    base = GenerationConfig(max_new_tokens=8, temperature=0.0, prompt_buckets=(8, 16))
+    prefix = [7, 7, 3, 9, 1, 2]
+    suffixes = [[3, 1, 4], [9, 2, 6, 5], [8], [2, 2]]
+    expected = _sequential_expected(module, params, base, [prefix + s for s in suffixes])
+
+    draft, dp = _draft_for(97)
+    cfg = dataclasses.replace(base, draft=DraftSpec(module=draft, params=dp, gamma=3))
+    gen = Generator(module, params, cfg)
+    batcher = ContinuousBatcher(
+        gen, slots=2, decode_chunk=3, prefix=gen.cache_prefix(prefix), admit_chunk=4
+    )
+    try:
+        results = [_drain(batcher.submit(s)) for s in suffixes]
+        assert results == expected
+        assert batcher.stats()["prefill"]["chunks"] > 0
+    finally:
+        batcher.close()
+
+
+@pytest.mark.slow  # ~4s; the same preempt-resume-under-chunking path stays in
+# tier-1 via tests/emulated/test_continuous_chunked.py's paged leg
+def test_chunked_admission_paged_preemption_resume(tiny_gen):
+    """Chunked admission preserves paged-KV pressure semantics: a preempted
+    stream's resume (original + emitted tokens, outgrowing every bucket)
+    still lands token-exact — the exact-width resume falls back to a
+    monolithic prefill when its chunk-aligned width would overflow the
+    cache, instead of failing the stream."""
+    module, params = tiny_gen
+    cfg = GenerationConfig(max_new_tokens=16, temperature=0.0, prompt_buckets=(16,))
+    long_prompts = [[3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8, 9, 7], [2, 7, 1, 8, 2, 8, 1, 8, 2, 8, 4, 5, 9, 4]]
+    expected = _sequential_expected(module, params, cfg, long_prompts)
+
+    gen = Generator(module, params, cfg)
+    probe = ContinuousBatcher(gen, slots=2, decode_chunk=8, block_size=8, admit_chunk=8)
+    pool = 2 * probe._blocks_initial(long_prompts[0], cfg.max_new_tokens)
+    assert pool < 2 * probe._blocks_lifetime(long_prompts[0], cfg.max_new_tokens)
+    probe.close()
+    batcher = ContinuousBatcher(
+        gen, slots=2, decode_chunk=8, block_size=8, pool_blocks=pool, admit_chunk=8
+    )
+    try:
+        results = [None] * 2
+
+        def worker(i):
+            results[i] = _drain(batcher.submit(long_prompts[i]))
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert results == expected
+        stats = batcher.stats()
+        assert stats["kv_blocks"]["preemptions"] > 0  # pressure actually fired
+        assert stats["prefill"]["chunks"] > 0  # fresh admissions chunked
+    finally:
+        batcher.close()
+
+
+def test_metrics_surface_ttft_tbt_and_prefill_counters(tiny_gen, sklearn_model):
+    """/metrics regression for the stall-fix surface: the generation section
+    carries ttft_ms/tbt_ms percentile blocks and the prefill counter block,
+    and NO gauge anywhere in the snapshot is None-valued (an empty reservoir
+    reports {"window": 0}, a missing engine omits its gauge entirely)."""
+    import asyncio
+    import json
+
+    from unionml_tpu.serving import serving_app
+
+    module, params = tiny_gen
+    cfg = GenerationConfig(max_new_tokens=6, temperature=0.0, prompt_buckets=(16,))
+    batcher = ContinuousBatcher(Generator(module, params, cfg), slots=2, decode_chunk=2, admit_chunk=4)
+    try:
+        _drain(batcher.submit(PROMPTS[0]))  # populate the reservoirs
+        sklearn_model.train(hyperparameters={"max_iter": 200})
+        sklearn_model.generation_batcher = batcher
+        app = serving_app(sklearn_model)
+
+        async def scenario():
+            status, payload, _ = await app.dispatch("GET", "/metrics", b"")
+            assert status == 200
+            return json.loads(payload) if isinstance(payload, (bytes, str)) else payload
+
+        payload = asyncio.run(scenario())
+        generation = payload["generation"]
+        assert {"ttft_ms", "tbt_ms", "prefill", "admitting"} <= set(generation)
+        assert generation["ttft_ms"]["window"] >= 1
+        assert {"chunks", "chunk_tokens", "monolithic_admissions", "backlog_tokens"} <= set(
+            generation["prefill"]
+        )
+
+        def no_nones(node, path="snapshot"):
+            if isinstance(node, dict):
+                for k, v in node.items():
+                    assert v is not None, f"None-valued gauge at {path}.{k}"
+                    no_nones(v, f"{path}.{k}")
+            elif isinstance(node, list):
+                for i, v in enumerate(node):
+                    no_nones(v, f"{path}[{i}]")
+
+        no_nones(payload.get("gauges", {}), "gauges")
+        no_nones(generation["ttft_ms"], "ttft_ms")
+        no_nones(generation["tbt_ms"], "tbt_ms")
+        no_nones(generation["prefill"], "prefill")
+    finally:
+        sklearn_model.generation_batcher = None
+        batcher.close()
+
+
 def test_cancelled_stream_frees_slot_for_waiters(tiny_gen):
     """Closing a stream's iterator (the client-disconnect path) releases its
     slot at the next chunk boundary; a queued request takes it and the

@@ -18,8 +18,9 @@ from unionml_tpu.serving.replicas import ReplicaScheduler, ReplicaSet
 class _StubEngine:
     """Duck-typed ContinuousBatcher: enough surface for the ReplicaSet."""
 
-    def __init__(self, load=0, full=False):
+    def __init__(self, load=0, full=False, backlog_tokens=0):
         self._load = load
+        self._backlog = backlog_tokens
         self.full = full
         self.submitted = []
         self.slots = 4
@@ -27,7 +28,11 @@ class _StubEngine:
         self.shed_deadline = 0
 
     def load(self):
-        return self._load
+        # token-weighted, like the real engine: requests + normalized backlog
+        return self._load + self._backlog / 512
+
+    def queued_prefill_tokens(self):
+        return self._backlog
 
     def occupancy(self):
         return min(self._load, self.slots), max(self._load - self.slots, 0)
@@ -50,6 +55,7 @@ class _StubEngine:
             "shed_deadline": self.shed_deadline,
             "decode_dispatches": 7,
             "decoded_rows": 21,
+            "prefill": {"chunks": 0, "backlog_tokens": self._backlog},
         }
 
     def warmup(self):
@@ -155,8 +161,38 @@ def test_stats_aggregates_across_replicas():
     loads = replica_set.replica_loads()
     assert loads[1] == {
         "replica": 1, "resident": 4, "waiting": 1, "free_slots": 0,
-        "shed_queue_full": 0, "shed_deadline": 0,
+        "prefill_backlog_tokens": 0, "shed_queue_full": 0, "shed_deadline": 0,
     }
+
+
+def test_token_weighted_load_breaks_waiter_count_ties():
+    """Two replicas with EQUAL waiter counts but very different prefill
+    backlogs must not tie: the token-weighted load() ranks the shallow
+    backlog first (mixed prompt lengths route sensibly)."""
+    engines = [_StubEngine(load=1, backlog_tokens=8192), _StubEngine(load=1, backlog_tokens=16)]
+    replica_set = _set(engines)
+    replica_set.submit([1, 2])
+    assert engines[1].submitted == [[1, 2]]  # deep-backlog replica avoided
+    assert replica_set.queued_prefill_tokens() == 8192 + 16
+    stats = replica_set.stats()
+    assert stats["prefill_backlog_tokens"] == 8192 + 16
+
+
+def test_affinity_hotspot_fallback_ranks_on_token_weighted_load():
+    """The affinity-fallback path uses the SAME token-weighted loads as the
+    primary ranking: when the remembered replica is a hotspot, the fallback
+    must pick the replica with the shallow prefill backlog even though waiter
+    counts tie — a count-based fallback would tie-break to index 0 and land
+    on the deep backlog."""
+    sched = ReplicaScheduler(3, affinity_tokens=2, affinity_margin=1)
+    prompt = [4, 5, 6]
+    sched.note(2, prompt)  # affinity remembers replica 2
+    # replica 2 is now a hotspot (load 5 > min + margin); replicas 0 and 1
+    # tie on request count but 0 has a deep token backlog (load 1 + 8192/512)
+    loads = [1 + 8192 / 512, 1 + 16 / 512, 5]
+    order, affinity = sched.order(loads, prompt)
+    assert affinity is False  # hotspot abandoned
+    assert order[0] == 1  # shallow backlog wins, not index order
 
 
 def test_replica_set_needs_exactly_one_source():

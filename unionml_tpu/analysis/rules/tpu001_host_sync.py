@@ -2,7 +2,8 @@
 
 A jitted function runs as one async XLA dispatch; anything that pulls a traced
 value back to the host (``.item()``, ``float()``/``int()`` on a tracer,
-``np.asarray``, ``jax.device_get``, ``.block_until_ready()``) either fails at
+``np.asarray``, ``jax.device_get``, ``.block_until_ready()`` or its
+module-level twin ``jax.block_until_ready(x)``) either fails at
 trace time or — worse, via implicit conversion paths — silently fences the
 device queue, turning an overlap-everything pipeline into a round-trip per
 step. ``print`` runs at trace time only (usually a debugging leftover; use
@@ -30,6 +31,8 @@ from unionml_tpu.analysis.rules._common import (
 #: calls that are a host sync no matter what their argument is
 _SYNC_CALLS = {
     "jax.device_get": "jax.device_get() pulls values to the host",
+    # both spellings of the fence: x.block_until_ready() is _SYNC_METHODS
+    "jax.block_until_ready": "jax.block_until_ready() fences the device queue",
     "np.asarray": "np.asarray() on a tracer forces a host transfer",
     "np.array": "np.array() on a tracer forces a host transfer",
     "numpy.asarray": "numpy.asarray() on a tracer forces a host transfer",

@@ -258,6 +258,21 @@ def fetch_model(
     "(or its own device) and requests route least-loaded-first (0 = derive from the mesh's "
     "data/fsdp axes)",
 )
+@click.option(
+    "--admit-chunk", default=None, type=int,
+    help="stall-free admission: slice each generation admission's prefill into this many "
+    "tokens per chunk, interleaved with decode dispatches so long prompts never freeze "
+    "resident streams (0 = monolithic admission unless the model config sets prefill_chunk)",
+)
+@click.option(
+    "--prefill-budget", default=None, type=int,
+    help="prefill tokens the continuous engine may run per iteration between decode "
+    "dispatches (0 = one admission chunk)",
+)
+@click.option(
+    "--max-admissions", default=None, type=int,
+    help="concurrent partially-prefilled admissions in the continuous engine (0 = 1)",
+)
 def serve(
     app_ref: str,
     model_path: Optional[Path],
@@ -274,6 +289,9 @@ def serve(
     max_deadline_ms: Optional[float],
     drain_timeout: Optional[float],
     dp_replicas: Optional[int],
+    admit_chunk: Optional[int],
+    prefill_budget: Optional[int],
+    max_admissions: Optional[int],
 ) -> None:
     """Start the HTTP prediction service (reference cli.py:172-205).
 
@@ -297,6 +315,12 @@ def serve(
     per replica, least-loaded routing, per-replica occupancy on ``/metrics``.
     Exported as an env var BEFORE the app module imports, so engines built at
     import time replicate too.
+
+    ``--admit-chunk`` / ``--prefill-budget`` / ``--max-admissions``
+    (docs/serving.md "Stall-free admission") chunk the continuous engine's
+    admission prefill and interleave it with decode, bounding resident
+    streams' time-between-tokens at ~one chunk while a long prompt admits;
+    same early-export contract as ``--dp-replicas``.
     """
     if dp_replicas is not None:
         if dp_replicas < 0:
@@ -305,6 +329,22 @@ def serve(
         from unionml_tpu.defaults import SERVE_DP_REPLICAS_ENV_VAR
 
         os.environ[SERVE_DP_REPLICAS_ENV_VAR] = str(dp_replicas)
+    admission_knobs = (
+        ("--admit-chunk", admit_chunk, "SERVE_ADMIT_CHUNK_ENV_VAR"),
+        ("--prefill-budget", prefill_budget, "SERVE_PREFILL_BUDGET_ENV_VAR"),
+        ("--max-admissions", max_admissions, "SERVE_MAX_ADMISSIONS_ENV_VAR"),
+    )
+    if any(value is not None for _, value, _ in admission_knobs):
+        from unionml_tpu import defaults as _defaults
+
+        for flag, value, env_name in admission_knobs:
+            if value is None:
+                continue
+            if value < 0:
+                raise click.ClickException(f"{flag} must be >= 0 (0 = default)")
+            # same early-export contract as --dp-replicas: engines built at
+            # app-module import time must see the knobs
+            os.environ[getattr(_defaults, env_name)] = str(value)
     if log_level is not None:
         from unionml_tpu._logging import logger as package_logger
 

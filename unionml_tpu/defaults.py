@@ -71,6 +71,26 @@ SERVE_RETRY_AFTER_S = 1
 #: first request) see it without any app code changes.
 SERVE_DP_REPLICAS_ENV_VAR = "UNIONML_TPU_DP_REPLICAS"
 
+# ------------------------------------------------------------ stall-free admission
+# Chunked-admission knobs for the continuous-batching engine
+# (serving/continuous.py): an arriving prompt's prefill is sliced into
+# fixed-size chunks interleaved with decode dispatches (Sarathi-style
+# chunked-prefill scheduling), so a long prompt no longer freezes every
+# resident stream for its whole prefill. Same export pattern as
+# SERVE_DP_REPLICAS_ENV_VAR: the serve CLI sets these before the app module
+# imports, and the engine reads them at construction.
+
+#: admission prefill slice width in tokens; 0 = unset (fall back to
+#: ``GenerationConfig.prefill_chunk``, else monolithic admission).
+SERVE_ADMIT_CHUNK_ENV_VAR = "UNIONML_TPU_ADMIT_CHUNK"
+
+#: prefill tokens the engine may run per iteration between decode dispatches;
+#: 0 = unset (one admission chunk per iteration).
+SERVE_PREFILL_BUDGET_ENV_VAR = "UNIONML_TPU_PREFILL_BUDGET"
+
+#: concurrent partially-prefilled admissions; 0 = unset (one at a time).
+SERVE_MAX_ADMISSIONS_ENV_VAR = "UNIONML_TPU_MAX_ADMISSIONS"
+
 
 def env_int(name: str, default: int, *, minimum: "int | None" = None) -> int:
     """Parse an integer env var, tolerating garbage: unset/empty -> ``default``,
@@ -118,3 +138,20 @@ def serve_dp_replicas() -> int:
     (``UNIONML_TPU_DP_REPLICAS=abc``) warn and fall back to 0 rather than
     crashing ``serve`` at app-import time."""
     return env_int(SERVE_DP_REPLICAS_ENV_VAR, 0, minimum=0)
+
+
+def serve_admit_chunk() -> int:
+    """Serve-time admission prefill chunk width; 0 = unset. Read at engine
+    construction (after the CLI export), same contract as
+    :func:`serve_dp_replicas`."""
+    return env_int(SERVE_ADMIT_CHUNK_ENV_VAR, 0, minimum=0)
+
+
+def serve_prefill_budget() -> int:
+    """Serve-time per-iteration prefill-token budget; 0 = unset (one chunk)."""
+    return env_int(SERVE_PREFILL_BUDGET_ENV_VAR, 0, minimum=0)
+
+
+def serve_max_admissions() -> int:
+    """Serve-time cap on concurrent partially-prefilled admissions; 0 = unset."""
+    return env_int(SERVE_MAX_ADMISSIONS_ENV_VAR, 0, minimum=0)

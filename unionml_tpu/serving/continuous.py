@@ -17,6 +17,12 @@ Design (classic continuous batching, expressed in fixed XLA shapes):
   jitted prefill at batch 1 (same numerics, same bucket set) into a fresh
   ``[1, cache_len]`` cache, which a jitted scatter pastes into a free slot row
   between decode chunks;
+- **stall-free admission**: with ``admit_chunk`` set the admission prefill is
+  sliced into fixed-size chunks through the Generator's chunked-prefill program
+  and the engine alternates chunks with decode dispatches under a
+  per-iteration ``prefill_budget`` (Sarathi-Serve's chunked-prefill scheduling,
+  OSDI '24) — a long prompt no longer freezes resident streams for its whole
+  prefill; their time-between-tokens is bounded by ~one chunk's dispatch;
 - **shared decode**: a background engine thread repeatedly runs the Generator's
   one-compile ``lax.scan`` decode for ``decode_chunk`` steps over ALL slots and
   routes each row's new tokens to its request's queue — S concurrent streams,
@@ -47,6 +53,7 @@ from __future__ import annotations
 import dataclasses
 import queue
 import threading
+import time
 from typing import Any, Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
@@ -55,7 +62,14 @@ import jax
 import jax.numpy as jnp
 
 from unionml_tpu._logging import logger
-from unionml_tpu.defaults import SERVE_MAX_WAITING, serve_dp_replicas
+from unionml_tpu.defaults import (
+    SERVE_MAX_WAITING,
+    serve_admit_chunk,
+    serve_dp_replicas,
+    serve_max_admissions,
+    serve_prefill_budget,
+)
+from unionml_tpu.serving.metrics import LatencyWindow
 from unionml_tpu.serving.overload import DeadlineExceeded, QueueFullError, expired
 from unionml_tpu.models.generate import (
     Generator,
@@ -103,6 +117,47 @@ class _Session:
     #: is shed (DeadlineExceeded) instead of occupying the FIFO — work a client
     #: has given up on must never cost a prefill
     deadline: Optional[float] = None
+    #: ``time.monotonic()`` at submit(); TTFT = first-token enqueue minus this
+    created_at: float = 0.0
+    #: ``time.monotonic()`` of the last token emission to this stream; the gap
+    #: between consecutive emissions is the TBT series — the stall a streaming
+    #: client feels while another prompt's prefill occupies the engine
+    last_emit: Optional[float] = None
+
+
+@dataclasses.dataclass(eq=False)  # identity semantics: fields hold device arrays
+class _Admission:
+    """One in-flight admission: a slot-holding prompt whose prefill may be
+    partially complete. With ``admit_chunk`` set, the engine steps these one
+    chunk at a time between decode dispatches; without it (or on the
+    sequence-parallel / exact-width-overflow paths) the whole prefill runs as
+    a single step and the admission never persists across iterations."""
+
+    session: _Session
+    prompt: "List[int]"
+    slot: int
+    seed: int
+    budget: int  # this request's remaining generation budget
+    blocks_row: Optional[np.ndarray]  # paged-mode block table row (None = dense)
+    started_at: float
+    # chunked-prefill progress (populated by _admission_begin)
+    chunk: int = 0  # 0 = monolithic (single-step) admission
+    width: int = 0  # chunk-aligned prefill width
+    pos: int = 0  # next column to prefill
+    start: int = 0  # absolute offset of column 0 (the shared prefix length)
+    tokens: Optional[np.ndarray] = None  # [1, width] padded prompt
+    lengths: Any = None  # device [1] absolute sequence length
+    key: Any = None
+    row_valid: Any = None
+    cstate: tuple = ()
+    dfa_state: Optional[int] = None
+    row_cache: Any = None  # target model's [1, cache_len] row (filling up)
+    last: Any = None  # accumulated last-real-token hidden state
+    d_row_cache: Any = None  # draft model's row, chunked in lockstep
+    # completion products consumed by _finalize_admission
+    tok0: Any = None
+    row_len: Any = None
+    done: bool = False
 
 
 class _TokenStream:
@@ -153,7 +208,19 @@ class ContinuousBatcher:
     (FIFO). ``decode_chunk`` is the scan length per shared dispatch — smaller
     chunks mean lower time-to-next-token and more frequent admission points,
     larger chunks amortize per-dispatch overhead (which dominates through a
-    remote-TPU tunnel). ``prefix`` (a :class:`~unionml_tpu.models.generate.PrefixCache`
+    remote-TPU tunnel).
+
+    ``admit_chunk`` enables **stall-free admission**: the admission prefill is
+    sliced into ``admit_chunk``-token chunks and the engine alternates chunks
+    with decode dispatches, running at most ``prefill_budget`` prefill tokens
+    per iteration (default: one chunk) with up to ``max_admissions``
+    partially-prefilled prompts in flight — resident streams' time-between-
+    tokens is bounded by ~one chunk's dispatch instead of one whole prompt,
+    and the chunked first token is bit-identical to the monolithic one (the
+    chunked-prefill equality contract ``models/generate.py`` already pins).
+    Defaults resolve constructor kwarg → ``serve`` CLI/env export →
+    ``GenerationConfig.prefill_chunk`` → monolithic admission. ``stats()``
+    reports TTFT/TBT percentiles and prefill-chunk counters for ``/metrics``. ``prefix`` (a :class:`~unionml_tpu.models.generate.PrefixCache`
     from ``generator.cache_prefix``) is a server-wide shared prompt prefix — a
     system prompt — whose K/V rows are pasted into every admission, so its
     prefill cost is paid once at ``cache_prefix`` time, not per request; every
@@ -214,6 +281,9 @@ class ContinuousBatcher:
         block_size: Optional[int] = None,
         pool_blocks: Optional[int] = None,
         max_waiting: Optional[int] = None,
+        admit_chunk: Optional[int] = None,
+        prefill_budget: Optional[int] = None,
+        max_admissions: Optional[int] = None,
     ):
         if slots < 1:
             raise ValueError("slots must be >= 1")
@@ -223,12 +293,39 @@ class ContinuousBatcher:
             raise ValueError("block_size must be >= 1")
         if max_waiting is not None and max_waiting < 1:
             raise ValueError("max_waiting must be >= 1")
+        if admit_chunk is not None and admit_chunk < 0:
+            raise ValueError("admit_chunk must be >= 0 (0 = monolithic admission)")
+        if prefill_budget is not None and prefill_budget < 0:
+            raise ValueError("prefill_budget must be >= 0 (0 = one chunk per iteration)")
+        if max_admissions is not None and max_admissions < 0:
+            raise ValueError("max_admissions must be >= 0 (0 = default of 1)")
         #: admission bound AHEAD of the slot pool: prompts waiting for a free
         #: slot beyond this are shed at submit() with QueueFullError (HTTP 429)
         #: instead of growing _pending without bound under overload
         self.max_waiting = SERVE_MAX_WAITING if max_waiting is None else max_waiting
         cfg = generator.config
         self.gen = generator
+        #: stall-free admission (chunked prefill interleaved with decode).
+        #: Resolution mirrors the --dp-replicas pattern: constructor kwarg,
+        #: then the serve CLI's env export, then the model's own
+        #: ``prefill_chunk`` (a config that already chunks long-context
+        #: prefill wants its admissions chunked too); None disables chunking
+        #: (monolithic admission, the pre-chunking behavior).
+        if admit_chunk is None:
+            admit_chunk = serve_admit_chunk() or (cfg.prefill_chunk or 0)
+        self.admit_chunk: Optional[int] = int(admit_chunk) or None
+        #: prefill tokens per engine iteration between decode dispatches; the
+        #: default of one chunk bounds resident TBT at ~one chunk's dispatch
+        if prefill_budget is None:
+            prefill_budget = serve_prefill_budget()
+        self.prefill_budget: Optional[int] = (
+            int(prefill_budget) or self.admit_chunk or None
+        )
+        #: concurrent partially-prefilled admissions; monolithic admissions
+        #: complete within one step, so the cap only matters in chunked mode
+        if max_admissions is None:
+            max_admissions = serve_max_admissions()
+        self.max_admissions = max(int(max_admissions), 1) if max_admissions else 1
         #: speculative mode: with ``config.draft`` set, resident rows advance by
         #: draft-and-verify ROUNDS instead of single decode steps — the engine
         #: drives the SpeculativeGenerator's batch round loop (per-row floors
@@ -274,6 +371,15 @@ class ContinuousBatcher:
             # Generator._start_with_prefix applies to its own cache_len)
             aligned = max(
                 chunk_aligned(b, cfg.prefill_chunk) for b in (cfg.prompt_buckets or (widest,))
+            )
+            self.cache_len = max(self.cache_len, p0 + aligned)
+        if self.admit_chunk:
+            # chunked admission pads each bucket to an admit_chunk multiple and
+            # writes the full aligned width at [p0, p0 + aligned) — size the
+            # row cache for the widest aligned bucket, the same rule the
+            # prefix/prefill_chunk paths apply above
+            aligned = max(
+                chunk_aligned(b, self.admit_chunk) for b in (cfg.prompt_buckets or (widest,))
             )
             self.cache_len = max(self.cache_len, p0 + aligned)
         #: paged-KV mode (block_size set): a host-side allocator hands pool
@@ -324,6 +430,7 @@ class ContinuousBatcher:
             raise ValueError("pool_blocks requires block_size (paged mode)")
         self._lock = threading.Condition()
         self._pending: "List[tuple]" = []  # (prompt, session) awaiting a free slot
+        self._admissions: "List[_Admission]" = []  # slot-holding, prefill in flight
         self._sessions: Dict[int, _Session] = {}
         self._free = list(range(slots))
         self._cancelled: "List[_Session]" = []  # resident sessions whose consumer went away
@@ -343,6 +450,18 @@ class ContinuousBatcher:
         self.decode_dispatches = 0
         self.decoded_rows = 0
         self.preemptions = 0
+        #: stall-free-admission telemetry: chunked prefill dispatches, tokens
+        #: prefilled through them, and admissions that ran as one dispatch
+        self.prefill_chunks = 0
+        self.prefill_chunk_tokens = 0
+        self.prefill_monolithic = 0
+        #: latency reservoirs for /metrics: TTFT (submit -> first token) and
+        #: TBT (gap between consecutive emissions to one resident stream)
+        self._ttft = LatencyWindow()
+        self._tbt = LatencyWindow()
+        #: token-weighted load normalizer: one admit chunk (or one widest
+        #: bucket) of queued prefill counts as one unit of scheduling load
+        self._load_norm = float(self.admit_chunk or widest)
         #: overload counters: waiting-queue-full sheds and deadline sheds
         self.shed_queue_full = 0
         self.shed_deadline = 0
@@ -575,7 +694,10 @@ class ContinuousBatcher:
         row_cache = gen._place_cache(
             init_cache(gen.module.config, 1, self.cache_len, kv_dtype=cfg.kv_cache_dtype)
         )
-        key = jax.random.fold_in(jax.random.PRNGKey(self._seed), seed)
+        # keyed on the admission's own seed (identical to the historical
+        # fold_in(PRNGKey(self._seed), seed): the two were always equal at
+        # dispatch time) so overlapping chunked admissions stay deterministic
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), seed)
         row_valid = jnp.ones((1,), bool)
         # the request's current DFA state masks the prompt-sampled token, same
         # as Generator._start's cstate tail (batch-1 row here)
@@ -700,6 +822,7 @@ class ContinuousBatcher:
             grammar = int(constraint)
         session = _Session(
             slot=-1, out=queue.Queue(), max_new=budget, grammar=grammar, deadline=deadline,
+            created_at=time.monotonic(),
             # the original prompt is retained only where preemption can resume it
             prompt=list(prompt) if self.block_size is not None else [],
         )
@@ -794,6 +917,11 @@ class ContinuousBatcher:
         with self._lock:
             self.decode_dispatches = 0
             self.decoded_rows = 0
+            self.prefill_chunks = 0
+            self.prefill_chunk_tokens = 0
+            self.prefill_monolithic = 0
+            self._ttft.clear()  # warmup probes must not skew the percentiles
+            self._tbt.clear()
             self._grammar_counts.clear()  # warmup probes all ride FREE (id 0)
             if self._spec is not None:
                 # the carry's device-side ride-along counters are NOT reset;
@@ -804,24 +932,57 @@ class ContinuousBatcher:
 
     def occupancy(self) -> "tuple[int, int]":
         """``(resident, live waiting)`` — the cheap gauge pair the replica
-        layer polls per routing decision and per ``/metrics`` snapshot."""
+        layer polls per routing decision and per ``/metrics`` snapshot.
+        In-flight (partially prefilled) admissions count as waiting: they hold
+        a slot but have not produced a token yet."""
         with self._lock:
-            return len(self._sessions), sum(1 for _, s in self._pending if not s.finished)
+            waiting = sum(1 for _, s in self._pending if not s.finished)
+            waiting += sum(1 for a in self._admissions if not a.session.finished)
+            return len(self._sessions), waiting
 
-    def load(self) -> int:
-        """Scheduling load: live residents plus live waiters. The replica
-        scheduler routes least-loaded-first on this."""
+    def queued_prefill_tokens(self) -> int:
+        """Prompt tokens standing between arrivals and their first token: live
+        waiting prompts plus the un-prefilled remainder of in-flight
+        admissions. The token-weighted signal :meth:`load` (and the replica
+        scheduler through it) routes on — two replicas with equal waiter
+        counts but a 10k-token vs a 10-token backlog are NOT equally loaded."""
+        with self._lock:
+            backlog = sum(len(p) for p, s in self._pending if not s.finished)
+            for adm in self._admissions:
+                if adm.session.finished:
+                    continue
+                if adm.tokens is not None:
+                    backlog += max(adm.width - adm.pos, 0)
+                else:
+                    backlog += max(len(adm.prompt), 1)
+            return backlog
+
+    def load(self) -> float:
+        """Scheduling load: live residents + live waiters (including in-flight
+        admissions), plus the prefill backlog in tokens normalized by the
+        admission chunk (or the widest prompt bucket) — the dispatches of work
+        queued ahead of a new arrival. The replica scheduler routes
+        least-loaded-first on this, so mixed prompt lengths route sensibly."""
         resident, waiting = self.occupancy()
-        return resident + waiting
+        return resident + waiting + self.queued_prefill_tokens() / self._load_norm
 
     def stats(self) -> Dict[str, Any]:
         """Utilization snapshot for ``/metrics``: resident/waiting streams,
         shared-dispatch counters, and (speculative mode) realized acceptance."""
         with self._lock:
+            backlog = sum(len(p) for p, s in self._pending if not s.finished)
+            for adm in self._admissions:
+                if not adm.session.finished:
+                    backlog += (
+                        max(adm.width - adm.pos, 0)
+                        if adm.tokens is not None
+                        else max(len(adm.prompt), 1)
+                    )
             snapshot: Dict[str, Any] = {
                 "slots": self.slots,
                 "resident": len(self._sessions),
                 "waiting": len(self._pending),
+                "admitting": len(self._admissions),
                 "max_waiting": self.max_waiting,
                 "shed_queue_full": self.shed_queue_full,
                 "shed_deadline": self.shed_deadline,
@@ -831,6 +992,22 @@ class ContinuousBatcher:
                     self.decoded_rows / self.decode_dispatches, 3
                 ) if self.decode_dispatches else None,
                 "speculative": self._spec is not None,
+                # stall-free admission: knob echo + chunk counters + the
+                # prefill backlog the token-weighted load() routes on
+                "prefill": {
+                    "mode": "chunked" if self.admit_chunk else "monolithic",
+                    "admit_chunk": self.admit_chunk or 0,
+                    "budget": self.prefill_budget or 0,
+                    "max_admissions": self.max_admissions,
+                    "chunks": self.prefill_chunks,
+                    "chunk_tokens": self.prefill_chunk_tokens,
+                    "monolithic_admissions": self.prefill_monolithic,
+                    "backlog_tokens": backlog,
+                },
+                # first-token and between-token latency percentiles (ms); an
+                # empty window reports {"window": 0}, never a None gauge
+                "ttft_ms": self._ttft.snapshot(),
+                "tbt_ms": self._tbt.snapshot(),
             }
             if self.block_size is not None:
                 # "used" includes the permanently resident shared-prefix pages
@@ -852,9 +1029,10 @@ class ContinuousBatcher:
             return snapshot
 
     def close(self, wait: bool = True, timeout: float = 120.0) -> None:
-        """Stop admitting new requests, DRAIN resident streams to completion,
-        then stop the engine. Never-admitted pending requests get a clean
-        end-of-stream. ``wait=False`` returns immediately while the drain
+        """Stop admitting new requests, DRAIN resident streams — and
+        partially-prefilled admissions, which already hold a slot and paid
+        prefill work — to completion, then stop the engine. Never-admitted
+        pending requests get a clean end-of-stream. ``wait=False`` returns immediately while the drain
         finishes on the engine thread; ``timeout`` bounds the wait (the
         SIGTERM drain path passes its remaining drain budget here)."""
         with self._lock:
@@ -869,15 +1047,22 @@ class ContinuousBatcher:
         try:
             while True:
                 with self._lock:
-                    while not self._closed and not self._pending and not self._sessions:
+                    while (
+                        not self._closed
+                        and not self._pending
+                        and not self._admissions
+                        and not self._sessions
+                    ):
                         self._lock.wait()
                     self._apply_cancellations_locked()
                     if self._closed:
-                        # no new admissions; residents drain to completion
+                        # no new admissions; residents — and partially
+                        # prefilled admissions, which already hold a slot and
+                        # paid prefill work — drain to completion
                         for _, session in self._pending:
                             session.out.put(_SENTINEL)
                         self._pending.clear()
-                        if not self._sessions:
+                        if not self._sessions and not self._admissions:
                             break
                 self._admit_pending()
                 if self._sessions:
@@ -888,55 +1073,112 @@ class ContinuousBatcher:
                 self._closed = True
                 for _, session in self._pending:
                     session.out.put(exc)
+                for adm in self._admissions:
+                    if not adm.session.finished:
+                        adm.session.out.put(exc)
                 for session in self._sessions.values():
                     session.out.put(exc)
                 self._pending.clear()
+                self._admissions.clear()
                 self._sessions.clear()
         finally:
             with self._lock:
                 for _, session in self._pending:
                     session.out.put(_SENTINEL)
+                for adm in self._admissions:
+                    adm.session.out.put(_SENTINEL)
                 for session in self._sessions.values():
                     session.out.put(_SENTINEL)
 
     def _admit_pending(self) -> None:
-        """Move waiting prompts into free slots. The lock is held ONLY for queue
-        and slot bookkeeping — the device-side prefill (seconds of work, tens of
-        seconds on first compile through a tunneled TPU backend) runs unlocked
-        so concurrent ``submit``/``close`` callers never stack behind it; the
-        engine thread is the sole device-state owner, so the unlocked section
-        touches the carry safely."""
-        cfg = self.gen.config
+        """Move waiting prompts toward residency. The lock is held ONLY for
+        queue/slot/block bookkeeping — device-side prefill (seconds of work,
+        tens of seconds on first compile through a tunneled TPU backend) runs
+        unlocked so concurrent ``submit``/``close`` callers never stack behind
+        it; the engine thread is the sole device-state owner, so the unlocked
+        sections touch the carry safely.
+
+        With ``admit_chunk`` set, each in-flight admission advances ONE chunk
+        per pass and this method returns once ``prefill_budget`` prefill
+        tokens have run — the caller's decode dispatch interleaves with long
+        prefills, bounding resident streams' time-between-tokens at ~one
+        chunk instead of one whole prompt. Monolithic admissions (chunking
+        disabled, the sequence-parallel path, or an exact-width resume whose
+        aligned width would overflow the cache) complete in a single step,
+        exactly as before."""
+        budget = self.prefill_budget
+        spent = 0
         while True:
-            with self._lock:
-                # drop dead and expired waiters before paying allocation/prefill
-                # for them: cancelled sessions' consumers already hold the
-                # sentinel; a session past its deadline is shed with
-                # DeadlineExceeded — its client has given up, so a prefill +
-                # full decode would be pure waste (the whole list is swept, not
-                # just the head: max_waiting bounds it, so this stays cheap)
-                live = []
-                for prompt_s, s in self._pending:
-                    if s.finished:
-                        continue
-                    if expired(s.deadline):
-                        s.finished = True
-                        self.shed_deadline += 1
-                        s.out.put(DeadlineExceeded(
-                            "deadline exceeded while waiting for a decode slot"
-                        ))
-                        continue
-                    live.append((prompt_s, s))
-                self._pending = live
-                if self._closed or not self._pending or not self._free:
+            self._start_admissions()
+            if not self._admissions:
+                return
+            for adm in list(self._admissions):
+                if not self._admission_alive(adm):
+                    continue
+                try:
+                    spent += self._admission_step(adm)
+                except ValueError as exc:
+                    # a bad prompt (e.g. longer than the cache can hold) fails
+                    # its own stream; the engine and other residents keep going
+                    # — admission work builds only a fresh [1, ...] row and
+                    # never touches the shared carry, so continuing is safe.
+                    # The finished flip + enqueue happen under the lock,
+                    # mirroring _cancel's guarded pattern — otherwise a
+                    # concurrent _cancel could interleave its sentinel before
+                    # (or instead of) the error
+                    self._abort_admission(adm, exc)
+                    continue
+                except BaseException as exc:
+                    # engine-fatal: this session is in NEITHER _pending NOR
+                    # _sessions — flag it finished and notify its queue here
+                    # (the death handler skips finished sessions), then let
+                    # the engine die
+                    with self._lock:
+                        if adm in self._admissions:
+                            self._admissions.remove(adm)
+                        if not adm.session.finished:
+                            adm.session.finished = True
+                            adm.session.out.put(exc)
+                    raise
+                if adm.done:
+                    self._finalize_admission(adm)
+                if budget is not None and spent >= budget:
                     return
+
+    def _start_admissions(self) -> None:
+        """Sweep dead/expired waiters, then move head-of-queue prompts into
+        free slots as in-flight admissions (lock held throughout; no device
+        work). Cancelled sessions' consumers already hold the sentinel; a
+        session past its deadline is shed with DeadlineExceeded — its client
+        has given up, so a prefill + full decode would be pure waste (the
+        whole list is swept, not just the head: max_waiting bounds it, so
+        this stays cheap). Paged mode allocates only the prompt + first
+        dispatch (residents grow lazily); the head-of-line request keeps its
+        FIFO position while the pool cannot supply its initial blocks."""
+        with self._lock:
+            live = []
+            for prompt_s, s in self._pending:
+                if s.finished:
+                    continue
+                if expired(s.deadline):
+                    s.finished = True
+                    self.shed_deadline += 1
+                    s.out.put(DeadlineExceeded(
+                        "deadline exceeded while waiting for a decode slot"
+                    ))
+                    continue
+                live.append((prompt_s, s))
+            self._pending = live
+            if self._closed:
+                return
+            # monolithic admissions never persist across steps, so the
+            # concurrency cap only matters in chunked mode; keeping it at 1
+            # when chunking is off preserves the historical one-at-a-time
+            # pop-prefill-paste order
+            limit = self.max_admissions if self.admit_chunk else 1
+            while self._pending and self._free and len(self._admissions) < limit:
                 blocks_row = None
                 if self.block_size is not None:
-                    # memory-pressure admission: allocation covers only the
-                    # prompt + first dispatch (residents grow lazily); the
-                    # head-of-line request keeps its FIFO position until blocks
-                    # free up (the engine re-enters here at every chunk
-                    # boundary, and preemption favors residents over waiters)
                     head_prompt, head_session = self._pending[0]
                     needed = self._blocks_initial(
                         head_prompt, head_session.max_new - head_session.produced
@@ -972,140 +1214,281 @@ class ContinuousBatcher:
                     blocks_row[: len(shared)] = shared
                     blocks_row[len(shared) : len(shared) + len(alloc)] = alloc
                 self._seed += 1
-                seed = self._seed
-            remaining = session.max_new - session.produced
-            dfa_state = None
-            if self.gen._cs is not None:
-                # the DFA state is a pure function of (grammar, emitted tokens):
-                # a fresh admission starts at the grammar's start state, a
-                # preemption resume walks the echo — the resumed row continues
-                # masking exactly where the evicted one left off
-                cs = self.gen._cs
-                dfa_state = int(cs.starts[session.grammar])
-                for t in session.echo:
-                    dfa_state = int(cs.trans[dfa_state, t])
-            try:
-                tok0, row_len, row_cache = self._prefill_row(
-                    prompt, seed, budget=remaining, dfa_state=dfa_state
+                self._admissions.append(_Admission(
+                    session=session,
+                    prompt=prompt,
+                    slot=slot,
+                    seed=self._seed,
+                    budget=session.max_new - session.produced,
+                    blocks_row=blocks_row,
+                    started_at=time.monotonic(),
+                    start=p0,
+                ))
+
+    def _admission_alive(self, adm: _Admission) -> bool:
+        """Drop an in-flight admission whose consumer went away (cancel) or
+        whose deadline passed mid-prefill: the slot and any pool blocks come
+        back immediately and the partially filled row is simply dropped — it
+        was never pasted, so no device-side masking is needed. Residents are
+        unaffected (a deadline governs the waiting/prefill phases only)."""
+        with self._lock:
+            session = adm.session
+            if not session.finished and expired(session.deadline):
+                session.finished = True
+                self.shed_deadline += 1
+                session.out.put(DeadlineExceeded(
+                    "deadline exceeded mid-prefill; admission abandoned"
+                ))
+            if session.finished:
+                if adm in self._admissions:
+                    self._admissions.remove(adm)
+                self._free.append(adm.slot)
+                self._release_blocks_locked(adm.slot)
+                return False
+            return True
+
+    def _abort_admission(self, adm: _Admission, exc: BaseException) -> None:
+        """Fail one admission's stream (free the slot/blocks, notify the
+        consumer) without touching the engine or other residents."""
+        with self._lock:
+            if adm in self._admissions:
+                self._admissions.remove(adm)
+            self._free.append(adm.slot)
+            self._release_blocks_locked(adm.slot)
+            if not adm.session.finished:
+                adm.session.finished = True
+                adm.session.out.put(exc)
+
+    def _admission_begin(self, adm: _Admission) -> int:
+        """Classify an admission and set up its prefill. Monolithic paths run
+        the whole prefill here through :meth:`_prefill_row` — identical
+        numerics and dispatch rules to the pre-chunking engine (including the
+        sequence-parallel admission and the exact-width preemption-resume
+        fallback) — and return their token cost; the chunked path allocates
+        the row cache(s), pads the prompt to a chunk-aligned width, and
+        leaves the stepping to :meth:`_admission_step` (cost 0: no columns
+        ran yet)."""
+        cfg = self.gen.config
+        gen = self.gen
+        prompt, session = adm.prompt, adm.session
+        dfa_state = None
+        if gen._cs is not None:
+            # the DFA state is a pure function of (grammar, emitted tokens):
+            # a fresh admission starts at the grammar's start state, a
+            # preemption resume walks the echo — the resumed row continues
+            # masking exactly where the evicted one left off
+            cs = gen._cs
+            dfa_state = int(cs.starts[session.grammar])
+            for t in session.echo:
+                dfa_state = int(cs.trans[dfa_state, t])
+        adm.dfa_state = dfa_state
+        adm.cstate = () if dfa_state is None else (jnp.asarray([dfa_state], jnp.int32),)
+        p0 = self.prefix.length if self.prefix is not None else 0
+        bucket = gen._bucket(max(len(prompt), 1))
+        if p0 + bucket + adm.budget > self.cache_len:
+            # a PREEMPTED request resumes as prompt + emitted tokens, which
+            # can outgrow every configured bucket while still fitting the
+            # cache contiguously — admit at the exact width instead of
+            # failing the stream (_prefill_row applies the same rule)
+            exact = max(len(prompt), 1)
+            if p0 + exact + adm.budget > self.cache_len:
+                raise ValueError(
+                    f"prompt of length {len(prompt)} needs prefix {p0} + bucket {bucket} + "
+                    f"{adm.budget} new tokens > cache_len {self.cache_len}"
                 )
-                if self._spec is not None:
-                    # the draft's cache row: same prompt through the draft model
-                    # with the DRAFT's prefix rows (its prompt-sampled token is
-                    # discarded — emission #1 is the target's, exactly as in
-                    # SpeculativeGenerator._start_state). dfa_state rides along:
-                    # the draft Generator shares the constraints config, so its
-                    # prefill closure requires the state argument too
-                    _, _, d_row = self._prefill_row(
-                        prompt, seed, gen=self._spec._draft, prefix=self._draft_prefix,
-                        budget=remaining, dfa_state=dfa_state,
-                    )
-            except ValueError as exc:
-                # a bad prompt (e.g. longer than the cache can hold) fails its
-                # own stream; the engine and other residents keep going —
-                # _prefill_row builds only a fresh [1, ...] row and never
-                # touches the shared carry, so continuing is safe. The finished
-                # flip + enqueue happen under the lock, mirroring _cancel's
-                # guarded pattern — otherwise a concurrent _cancel could
-                # interleave its sentinel before (or instead of) the error
-                with self._lock:
-                    self._free.append(slot)
-                    self._release_blocks_locked(slot)
-                    if not session.finished:
-                        session.finished = True
-                        session.out.put(exc)
-                continue
-            except BaseException as exc:
-                # engine-fatal: this session is in NEITHER _pending NOR
-                # _sessions (popped above, not yet registered), so
-                # _engine_loop's death handler cannot reach its queue — notify
-                # it here or its consumer blocks forever, then let the engine die
-                with self._lock:
-                    if not session.finished:
-                        session.finished = True
-                        session.out.put(exc)
-                raise
-            try:
-                if self._carry is None:
-                    self._carry = self._init_carry()
-                first = np.asarray(tok0)
-                hit_eos = cfg.eos_id is not None and int(first[0]) == cfg.eos_id
-                # produced carries across preemptions; this residency adds one token
-                start_done = hit_eos or session.produced + 1 >= session.max_new
-                if self._spec is None:
-                    cache, tok, lengths, done, key, *cst = self._carry
-                    if blocks_row is not None:
-                        cache, tok, lengths, done = self._paged_admit_fn(
-                            cache, row_cache, tok, lengths, done, jnp.int32(slot), tok0, row_len,
-                            jnp.asarray(blocks_row), len(self._shared_prefix_blocks),
-                        )
-                    else:
-                        cache, tok, lengths, done = self._admit_fn(
-                            cache, row_cache, tok, lengths, done, jnp.int32(slot), tok0, row_len
-                        )
-                    self._carry = (cache, tok, lengths, done, key, *cst)
-                else:
-                    t_cache, d_cache, tok, lengths, done, produced, out_buf, rounds, acc, key, *cst = self._carry
-                    if blocks_row is not None:
-                        t_cache, d_cache, out_buf, tok, lengths, done, produced = self._paged_spec_admit_fn(
-                            t_cache, d_cache, out_buf, row_cache, d_row, tok, lengths, done, produced,
-                            jnp.int32(slot), tok0, row_len, jnp.asarray([start_done]),
-                            jnp.int32(cfg.pad_id), jnp.asarray(blocks_row),
-                            len(self._shared_prefix_blocks),
-                        )
-                    else:
-                        t_cache, d_cache, out_buf, tok, lengths, done, produced = self._spec_admit_fn(
-                            t_cache, d_cache, out_buf, row_cache, d_row, tok, lengths, done, produced,
-                            jnp.int32(slot), tok0, row_len, jnp.asarray([start_done]),
-                            jnp.int32(cfg.pad_id),
-                        )
-                    self._carry = (t_cache, d_cache, tok, lengths, done, produced, out_buf, rounds, acc, key, *cst)
-                if dfa_state is not None:
-                    # advance past the (constrained) prompt-sampled token and
-                    # activate the slot's DFA state — the carry TAIL in both the
-                    # plain and speculative layouts (one copy of the rule)
-                    state = list(self._carry)
-                    state[-1] = state[-1].at[slot].set(
-                        int(self.gen._cs.trans[dfa_state, int(first[0])])
-                    )
-                    self._carry = tuple(state)
-            except BaseException as exc:
-                # ANY failure here — carry init or the donating admit
-                # dispatches — is engine-fatal: donation may already have
-                # invalidated the carry's buffers, so treating it as a
-                # per-request failure would leave the engine decoding deleted
-                # arrays (or, past the carry reassignment, a freed slot's
-                # ride-along writes corrupting reallocated pages). Notify the
-                # in-flight session (reachable by neither death handler), then
-                # let the engine die.
-                with self._lock:
-                    if not session.finished:
-                        session.finished = True
-                        session.out.put(exc)
-                raise
+            bucket = exact
+        sp = cfg.sp_prefill and gen.mesh is not None and self._sp_seq > 1 and self.prefix is None
+        chunk = self.admit_chunk
+        aligned = chunk_aligned(bucket, chunk) if chunk else bucket
+        if not chunk or sp or p0 + aligned > self.cache_len:
+            # monolithic admission: chunking disabled, a sequence-parallel
+            # prefill (already spread over chips — slicing it would serialize
+            # the shard_map), or an exact-width resume whose chunk-aligned
+            # width would overflow the cache (the fallback keeps the resume's
+            # token-exactness guarantee instead of failing the stream)
+            adm.tok0, adm.row_len, adm.row_cache = self._prefill_row(
+                prompt, adm.seed, budget=adm.budget, dfa_state=dfa_state
+            )
+            if self._spec is not None:
+                # the draft's cache row: same prompt through the draft model
+                # with the DRAFT's prefix rows (its prompt-sampled token is
+                # discarded — emission #1 is the target's, exactly as in
+                # SpeculativeGenerator._start_state). dfa_state rides along:
+                # the draft Generator shares the constraints config, so its
+                # prefill closure requires the state argument too
+                _, _, adm.d_row_cache = self._prefill_row(
+                    prompt, adm.seed, gen=self._spec._draft, prefix=self._draft_prefix,
+                    budget=adm.budget, dfa_state=dfa_state,
+                )
+            adm.done = True
             with self._lock:
-                if session.finished:
-                    # cancelled during the unlocked prefill window (neither
-                    # pending nor resident at _cancel time): the device row was
-                    # just activated — mask it back out and return the slot
-                    # instead of decoding a full budget to a dead queue
-                    self._free.append(slot)
-                    self._release_blocks_locked(slot)
-                    self._mask_slot_done(slot)
-                    continue
-                session.out.put(first)
-                if self.block_size is not None:  # echo exists only for preemption resume
-                    session.echo.append(int(first[0]))
-                session.resident_base = session.produced
-                session.produced += 1
-                self._sessions[slot] = session
-                if start_done:
-                    # speculative mode already marked the row done on device
-                    # (row_done); plain mode must mask it here — the decode body
-                    # only flags done on tokens IT samples, and the
-                    # prompt-sampled tok0 is not one of them, so without masking
-                    # the freed slot would keep decoding as a zombie row (and
-                    # claim routed-expert capacity)
-                    self._finish_locked(slot, device_done=self._spec is not None)
+                self.prefill_monolithic += 1
+            return p0 + bucket
+        adm.chunk, adm.width = chunk, aligned
+        tokens = np.full((1, aligned), cfg.pad_id, np.int32)
+        tokens[0, : len(prompt)] = np.asarray(prompt, np.int32)
+        adm.tokens = tokens
+        adm.lengths = jnp.asarray([p0 + max(len(prompt), 1)], jnp.int32)
+        # the same key derivation as _prefill_row, so chunked and monolithic
+        # admission sample the identical first token
+        adm.key = jax.random.fold_in(jax.random.PRNGKey(adm.seed), adm.seed)
+        adm.row_valid = jnp.ones((1,), bool)
+        adm.last = jnp.zeros((1, gen.module.config.dim), jnp.float32)
+        row_cache = gen._place_cache(
+            init_cache(gen.module.config, 1, self.cache_len, kv_dtype=cfg.kv_cache_dtype)
+        )
+        if self.prefix is not None:
+            row_cache = _paste_prefix_rows(row_cache, self.prefix.layers)
+        adm.row_cache = row_cache
+        if self._spec is not None:
+            # the draft's row chunks in LOCKSTEP with the target's (same
+            # columns per step), so speculative admissions stall residents no
+            # longer than plain ones
+            draft = self._spec._draft
+            d_row = draft._place_cache(
+                init_cache(draft.module.config, 1, self.cache_len, kv_dtype=cfg.kv_cache_dtype)
+            )
+            if self._draft_prefix is not None:
+                d_row = _paste_prefix_rows(d_row, self._draft_prefix.layers)
+            adm.d_row_cache = d_row
+        return 0
+
+    def _admission_step(self, adm: _Admission) -> int:
+        """Advance one admission's prefill by one unit (engine thread; device
+        work runs unlocked). Monolithic admissions complete inside
+        :meth:`_admission_begin`; chunked admissions run exactly one
+        ``admit_chunk``-wide slice through the Generator's chunked-prefill
+        program — one compile total, the chunk shape is bucket-independent —
+        and sample the first token via ``_first_token`` once the last chunk
+        lands. Returns the prefill tokens spent (the per-iteration budget's
+        unit)."""
+        gen = self.gen
+        if adm.tokens is None:
+            cost = self._admission_begin(adm)
+            if adm.done:
+                return cost
+        c = adm.pos
+        sl = jnp.asarray(adm.tokens[:, c : c + adm.chunk])
+        chunk_last, has, adm.row_cache = gen._prefill_chunk(
+            gen.params, sl, jnp.int32(adm.start + c), adm.lengths, adm.row_cache, adm.row_valid
+        )
+        adm.last = jnp.where(has[:, None], chunk_last, adm.last)
+        if self._spec is not None:
+            draft = self._spec._draft
+            _, _, adm.d_row_cache = draft._prefill_chunk(
+                draft.params, sl, jnp.int32(adm.start + c), adm.lengths,
+                adm.d_row_cache, adm.row_valid,
+            )
+        adm.pos = c + adm.chunk
+        with self._lock:
+            self.prefill_chunks += 1
+            self.prefill_chunk_tokens += adm.chunk
+        if adm.pos >= adm.width:
+            adm.tok0 = gen._first_token(gen.params, adm.last, adm.key, *adm.cstate)
+            adm.row_len = adm.lengths
+            adm.done = True
+        return adm.chunk
+
+    def _finalize_admission(self, adm: _Admission) -> None:
+        """Paste a completed admission's row(s) into the pool and activate its
+        session — the donating admit dispatches plus carry/session
+        bookkeeping. ANY failure in the paste section is engine-fatal:
+        donation may already have invalidated the carry's buffers, so
+        treating it as a per-request failure would leave the engine decoding
+        deleted arrays (or, past the carry reassignment, a freed slot's
+        ride-along writes corrupting reallocated pages)."""
+        cfg = self.gen.config
+        session, slot = adm.session, adm.slot
+        try:
+            if self._carry is None:
+                self._carry = self._init_carry()
+            first = np.asarray(adm.tok0)
+            hit_eos = cfg.eos_id is not None and int(first[0]) == cfg.eos_id
+            # produced carries across preemptions; this residency adds one token
+            start_done = hit_eos or session.produced + 1 >= session.max_new
+            blocks_row = adm.blocks_row
+            if self._spec is None:
+                cache, tok, lengths, done, key, *cst = self._carry
+                if blocks_row is not None:
+                    cache, tok, lengths, done = self._paged_admit_fn(
+                        cache, adm.row_cache, tok, lengths, done, jnp.int32(slot), adm.tok0,
+                        adm.row_len, jnp.asarray(blocks_row), len(self._shared_prefix_blocks),
+                    )
+                else:
+                    cache, tok, lengths, done = self._admit_fn(
+                        cache, adm.row_cache, tok, lengths, done, jnp.int32(slot),
+                        adm.tok0, adm.row_len,
+                    )
+                self._carry = (cache, tok, lengths, done, key, *cst)
+            else:
+                t_cache, d_cache, tok, lengths, done, produced, out_buf, rounds, acc, key, *cst = self._carry
+                if blocks_row is not None:
+                    t_cache, d_cache, out_buf, tok, lengths, done, produced = self._paged_spec_admit_fn(
+                        t_cache, d_cache, out_buf, adm.row_cache, adm.d_row_cache, tok, lengths,
+                        done, produced, jnp.int32(slot), adm.tok0, adm.row_len,
+                        jnp.asarray([start_done]), jnp.int32(cfg.pad_id),
+                        jnp.asarray(blocks_row), len(self._shared_prefix_blocks),
+                    )
+                else:
+                    t_cache, d_cache, out_buf, tok, lengths, done, produced = self._spec_admit_fn(
+                        t_cache, d_cache, out_buf, adm.row_cache, adm.d_row_cache, tok, lengths,
+                        done, produced, jnp.int32(slot), adm.tok0, adm.row_len,
+                        jnp.asarray([start_done]), jnp.int32(cfg.pad_id),
+                    )
+                self._carry = (t_cache, d_cache, tok, lengths, done, produced, out_buf, rounds, acc, key, *cst)
+            if adm.dfa_state is not None:
+                # advance past the (constrained) prompt-sampled token and
+                # activate the slot's DFA state — the carry TAIL in both the
+                # plain and speculative layouts (one copy of the rule)
+                state = list(self._carry)
+                state[-1] = state[-1].at[slot].set(
+                    int(self.gen._cs.trans[adm.dfa_state, int(first[0])])
+                )
+                self._carry = tuple(state)
+            # drop the row references promptly: the donated buffers are dead
+            adm.row_cache = adm.d_row_cache = adm.last = None
+        except BaseException as exc:
+            with self._lock:
+                if adm in self._admissions:
+                    self._admissions.remove(adm)
+                if not session.finished:
+                    session.finished = True
+                    session.out.put(exc)
+            raise
+        with self._lock:
+            if adm in self._admissions:
+                self._admissions.remove(adm)
+            if session.finished:
+                # cancelled during the unlocked prefill/paste window (neither
+                # pending nor resident at _cancel time): the device row was
+                # just activated — mask it back out and return the slot
+                # instead of decoding a full budget to a dead queue
+                self._free.append(slot)
+                self._release_blocks_locked(slot)
+                self._mask_slot_done(slot)
+                return
+            session.out.put(first)
+            now = time.monotonic()
+            if session.produced == 0:
+                # first token EVER for this stream; a preemption resume is a
+                # later residency, not a first token
+                self._ttft.observe(now - session.created_at)
+            if session.last_emit is not None:
+                self._tbt.observe(now - session.last_emit)
+            session.last_emit = now
+            if self.block_size is not None:  # echo exists only for preemption resume
+                session.echo.append(int(first[0]))
+            session.resident_base = session.produced
+            session.produced += 1
+            self._sessions[slot] = session
+            if start_done:
+                # speculative mode already marked the row done on device
+                # (row_done); plain mode must mask it here — the decode body
+                # only flags done on tokens IT samples, and the
+                # prompt-sampled tok0 is not one of them, so without masking
+                # the freed slot would keep decoding as a zombie row (and
+                # claim routed-expert capacity)
+                self._finish_locked(slot, device_done=self._spec is not None)
 
     def _mask_slot_done(self, slot: int) -> None:
         """Set the device-side done flag of a slot (engine thread only). In
@@ -1226,6 +1609,7 @@ class ContinuousBatcher:
         with self._lock:
             self.decode_dispatches += 1
             self.decoded_rows += len(self._sessions)
+            now = time.monotonic()
             for slot in list(self._sessions):
                 session = self._sessions[slot]
                 row = toks_np[slot]
@@ -1236,6 +1620,9 @@ class ContinuousBatcher:
                         take = min(take, int(hits[0]) + 1)  # emit the eos, stop after
                 if take > 0:
                     session.out.put(row[:take].copy())
+                    if session.last_emit is not None:
+                        self._tbt.observe(now - session.last_emit)
+                    session.last_emit = now
                     if self.block_size is not None:
                         session.echo.extend(int(t) for t in row[:take])
                     session.produced += take
@@ -1279,11 +1666,15 @@ class ContinuousBatcher:
             self._spec_rounds_seen, self._spec_accepted_seen = rounds_total, accepted_total
             self.decode_dispatches += 1
             self.decoded_rows += len(self._sessions)
+            now = time.monotonic()
             for slot in list(self._sessions):
                 session = self._sessions[slot]
                 new = out_np[slot, session.produced - session.resident_base : prod_np[slot]]
                 if new.size:
                     session.out.put(new.copy())
+                    if session.last_emit is not None:
+                        self._tbt.observe(now - session.last_emit)
+                    session.last_emit = now
                     if self.block_size is not None:
                         session.echo.extend(int(t) for t in new)
                     session.produced = session.resident_base + int(prod_np[slot])

@@ -17,6 +17,58 @@ from typing import Any, Callable, Dict
 _WINDOW = 10_000  # most recent samples per route
 
 
+def _percentile(ordered: "list[float]", q: float) -> float:
+    # nearest-rank on the sorted window; ordered is non-empty
+    rank = min(len(ordered) - 1, max(0, round(q * (len(ordered) - 1))))
+    return ordered[rank]
+
+
+class LatencyWindow:
+    """Thread-safe bounded reservoir of durations with a percentile snapshot.
+
+    The building block behind every latency series ``/metrics`` exposes:
+    producers :meth:`observe` seconds on their own threads, and the snapshot
+    reports exact percentiles in milliseconds over the most recent ``window``
+    samples. The continuous-batching engine records TTFT (submit to first
+    token) and TBT (gap between consecutive token emissions to one stream —
+    the stall a streaming client actually feels while someone else's prompt
+    prefills) into these directly; ``stats()`` carries the snapshots to
+    ``/metrics``. An empty window snapshots as ``{"window": 0}`` — never a
+    ``None``-valued gauge.
+    """
+
+    def __init__(self, window: int = _WINDOW):
+        self._lock = threading.Lock()
+        self._samples: deque = deque(maxlen=window)
+
+    def observe(self, seconds: float) -> None:
+        with self._lock:
+            self._samples.append(seconds)
+
+    def clear(self) -> None:
+        """Drop accumulated samples (warmup probes must not skew percentiles)."""
+        with self._lock:
+            self._samples.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._samples)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            ordered = sorted(self._samples)
+        if not ordered:
+            return {"window": 0}
+        return {
+            "window": len(ordered),
+            "mean_ms": round(sum(ordered) / len(ordered) * 1e3, 3),
+            "p50_ms": round(_percentile(ordered, 0.50) * 1e3, 3),
+            "p95_ms": round(_percentile(ordered, 0.95) * 1e3, 3),
+            "p99_ms": round(_percentile(ordered, 0.99) * 1e3, 3),
+            "max_ms": round(ordered[-1] * 1e3, 3),
+        }
+
+
 class ServingMetrics:
     """Thread-safe request counters and a sliding-window latency reservoir."""
 
@@ -65,9 +117,7 @@ class ServingMetrics:
 
     @staticmethod
     def _percentile(ordered: "list[float]", q: float) -> float:
-        # nearest-rank on the sorted window; ordered is non-empty
-        rank = min(len(ordered) - 1, max(0, round(q * (len(ordered) - 1))))
-        return ordered[rank]
+        return _percentile(ordered, q)
 
     def snapshot(self) -> Dict[str, Any]:
         """Counts + latency percentiles (milliseconds) per route, plus overload

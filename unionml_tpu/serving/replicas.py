@@ -19,9 +19,14 @@ Design:
   so placement replicates) and owns their shared lifecycle (warmup in
   parallel, drain on close);
 - :class:`ReplicaScheduler` admits requests least-loaded-first — load is a
-  replica's live residents plus live waiters, the same backlog the engine's
-  own admission sees — with optional prefix-affinity routing so shared-prefix
-  requests land on the replica whose KV pool already holds that prefix.
+  replica's live residents plus live waiters PLUS its pending prefill
+  backlog in tokens (``ContinuousBatcher.load()``'s token weighting), so two
+  replicas with equal waiter counts but a 10k-token vs a 10-token queued
+  prompt do not tie — with optional prefix-affinity routing so shared-prefix
+  requests land on the replica whose KV pool already holds that prefix. The
+  affinity margin check and the hotspot fallback rank on the SAME
+  token-weighted loads, so a fallback never lands on a replica with a deep
+  prefill backlog that mere waiter counts would hide.
 
 Overload posture composes with PR 1's machinery: an expired deadline sheds
 before routing (:class:`DeadlineExceeded`, HTTP 503), and a prompt is shed
@@ -104,9 +109,13 @@ def slice_mesh(mesh: Any, replicas: Optional[int] = None) -> "List[Any]":
 class ReplicaScheduler:
     """Least-loaded-first routing over N replicas, with optional prefix affinity.
 
-    Load is supplied by the caller per decision (live residents + live waiters
-    of each engine); ties break toward the lowest index, so an idle fleet fills
-    in order and drains evenly. ``affinity_tokens > 0`` enables prefix-affinity
+    Load is supplied by the caller per decision (the engine's token-weighted
+    ``load()``: live residents + live waiters + prefill backlog tokens
+    normalized by the admission chunk — ints or floats both rank); ties break
+    toward the lowest index, so an idle fleet fills in order and drains
+    evenly. Both the affinity-margin comparison and the hotspot-fallback
+    ranking use these same loads, so mixed prompt lengths route sensibly on
+    every path. ``affinity_tokens > 0`` enables prefix-affinity
     routing: requests sharing their first ``affinity_tokens`` prompt tokens are
     steered to the replica that last served that prefix — its KV pool already
     holds those rows/pages (shared-prefix pages in paged mode), so the prefill
@@ -201,7 +210,8 @@ class ReplicaSet:
     ``ContinuousBatcher`` — the stream-predictor route, ``/metrics``, graceful
     drain — composes with a replica set unchanged. Engine knobs (``slots``,
     ``decode_chunk``, ``block_size``, ``pool_blocks``, ``max_waiting``,
-    ``prefix``) apply PER REPLICA; a shared ``prefix`` (token ids or a
+    ``admit_chunk``/``prefill_budget``/``max_admissions`` — stall-free
+    admission, see serving/continuous.py — and ``prefix``) apply PER REPLICA; a shared ``prefix`` (token ids or a
     ``PrefixCache`` built with ``cache_prefix``) is prefilled once per replica
     at construction, since cache rows cannot cross submeshes.
     """
@@ -217,6 +227,9 @@ class ReplicaSet:
         block_size: Optional[int] = None,
         pool_blocks: Optional[int] = None,
         max_waiting: Optional[int] = None,
+        admit_chunk: Optional[int] = None,
+        prefill_budget: Optional[int] = None,
+        max_admissions: Optional[int] = None,
         affinity_tokens: int = 0,
         affinity_margin: int = 2,
     ):
@@ -238,6 +251,9 @@ class ReplicaSet:
                             block_size=block_size,
                             pool_blocks=pool_blocks,
                             max_waiting=max_waiting,
+                            admit_chunk=admit_chunk,
+                            prefill_budget=prefill_budget,
+                            max_admissions=max_admissions,
                         )
                     )
             except BaseException:
@@ -422,10 +438,18 @@ class ReplicaSet:
             # list() propagates the first failure instead of dropping it
             list(pool.map(lambda batcher: batcher.warmup(), self._batchers))
 
-    def load(self) -> int:
-        """Aggregate live residents + waiters (the signal a layer above a
-        fleet of ReplicaSets would schedule on, mirroring the engine's own)."""
+    def load(self) -> float:
+        """Aggregate token-weighted load (the signal a layer above a fleet of
+        ReplicaSets would schedule on, mirroring the engine's own)."""
         return sum(batcher.load() for batcher in self._batchers)
+
+    def queued_prefill_tokens(self) -> int:
+        """Fleet-wide prefill backlog in tokens (engines that predate the
+        token accounting report 0)."""
+        return sum(
+            int(getattr(batcher, "queued_prefill_tokens", lambda: 0)())
+            for batcher in self._batchers
+        )
 
     def replica_loads(self) -> "List[Dict[str, Any]]":
         """Per-replica occupancy for live gauges: cheap (no full stats dict),
@@ -439,6 +463,9 @@ class ReplicaSet:
                     "resident": resident,
                     "waiting": waiting,
                     "free_slots": max(int(getattr(batcher, "slots", 0)) - resident, 0),
+                    "prefill_backlog_tokens": int(
+                        getattr(batcher, "queued_prefill_tokens", lambda: 0)()
+                    ),
                     "shed_queue_full": getattr(batcher, "shed_queue_full", 0),
                     "shed_deadline": getattr(batcher, "shed_deadline", 0),
                 }
@@ -455,14 +482,25 @@ class ReplicaSet:
 
         with self._lock:
             shed_deadline, shed_queue_full = self.shed_deadline, self.shed_queue_full
+        def total_prefill(key: str) -> int:
+            return sum(
+                int((entry.get("prefill") or {}).get(key) or 0) for entry in per_replica
+            )
+
         return {
             "replicas": len(self._batchers),
             "scheduler": self._scheduler.stats(),
             "slots": total("slots"),
             "resident": total("resident"),
             "waiting": total("waiting"),
+            "admitting": total("admitting"),
             "decode_dispatches": total("decode_dispatches"),
             "decoded_rows": total("decoded_rows"),
+            # stall-free admission, fleet-wide: chunk counters + the token
+            # backlog the token-weighted routing acts on (per-replica TTFT/TBT
+            # percentiles stay under per_replica — percentiles don't sum)
+            "prefill_chunks": total_prefill("chunks"),
+            "prefill_backlog_tokens": total_prefill("backlog_tokens"),
             # fleet-level sheds (all replicas full / expired before routing) on
             # top of each engine's own counters
             "shed_queue_full": shed_queue_full + total("shed_queue_full"),
