@@ -40,6 +40,8 @@ from benchmarks.common import Timer, emit, log, pin_platform
 
 import os
 
+from unionml_tpu.defaults import env_int
+
 # BENCH_SMALL=1: tiny shapes for a CPU smoke run of the harness itself
 _SMALL = os.environ.get("BENCH_SMALL") == "1"
 PROXY_LAYERS = 2 if _SMALL else 8
@@ -126,8 +128,8 @@ def stall_main() -> None:
     # shapes picked so the monolithic stall (one 1024-token prefill) dwarfs a
     # decode dispatch on the CPU substrate: measured 4.3x TBT-p99 reduction at
     # throughput parity (the ISSUE-4 bar is >=3x within 5% tok/s)
-    long_len = int(os.environ.get("BENCH_STALL_PROMPT", "1024"))
-    chunk = int(os.environ.get("BENCH_STALL_CHUNK", "64"))
+    long_len = env_int("BENCH_STALL_PROMPT", 1024, minimum=1)
+    chunk = env_int("BENCH_STALL_CHUNK", 64, minimum=1)
     config = LlamaConfig.tiny(
         vocab_size=512, dim=192, n_layers=4, n_heads=4, n_kv_heads=2, hidden_dim=384,
         max_seq_len=long_len + 288,
@@ -156,7 +158,7 @@ def stall_main() -> None:
     # reported attempt maximizes stall_reduction * throughput_ratio — the
     # reduction at par throughput — so every emitted field comes from one
     # coherent capture, never a cherry-picked mix.
-    attempts = max(int(os.environ.get("BENCH_STALL_ATTEMPTS", "3")), 1)
+    attempts = env_int("BENCH_STALL_ATTEMPTS", 3, minimum=1)
     best = None
     for attempt in range(attempts):
         results = {}

@@ -40,6 +40,7 @@ SCRIPTS = {
     "continuous": "bench_continuous.py",
     "continuous_stall": "bench_continuous.py",
     "replica_serving": "bench_replica_serving.py",
+    "observability": "bench_observability.py",
     "lint": "bench_lint.py",
     "int8_matmul": "bench_int8_matmul.py",
     "kv_cache": "bench_kv_cache.py",
@@ -63,8 +64,12 @@ if _cpu_extra - set(SCRIPTS):
 #: 8-device host mesh, not chip throughput; lint is pure-Python AST analysis
 #: (tracks tpu-lint's full-repo cost and the suppressed-finding count);
 #: continuous_stall measures the chunked-admission stall REDUCTION — a ratio
-#: of two same-substrate runs, meaningful on the host CPU
-CPU_ONLY = {"digits", "serving", "replica_serving", "continuous_stall", "lint"} | _cpu_extra
+#: of two same-substrate runs, meaningful on the host CPU; observability
+#: likewise pins the tracing on/off throughput ratio (host-side per-token
+#: bookkeeping, not chip throughput)
+CPU_ONLY = {
+    "digits", "serving", "replica_serving", "continuous_stall", "observability", "lint",
+} | _cpu_extra
 
 #: per-lane env overrides: lanes that reuse a script in a different mode
 LANE_ENV = {"continuous_stall": {"BENCH_STALL_ONLY": "1"}}

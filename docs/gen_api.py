@@ -58,6 +58,9 @@ MODULES = [
     "unionml_tpu.serving.overload",
     "unionml_tpu.serving.replicas",
     "unionml_tpu.serving.serverless",
+    "unionml_tpu.observability.trace",
+    "unionml_tpu.observability.recorder",
+    "unionml_tpu.observability.prometheus",
     "unionml_tpu.analysis",
     "unionml_tpu.analysis.engine",
     "unionml_tpu.artifact",

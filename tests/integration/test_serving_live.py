@@ -179,8 +179,8 @@ def test_client_disconnect_releases_continuous_slot(sklearn_model):
         assert batcher.stats()["resident"] == 1
         sock.close()  # client walks away mid-stream (budget 512 ~= forever)
 
-        deadline = time.time() + 30
-        while time.time() < deadline:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
             if batcher.stats()["resident"] == 0:
                 break
             time.sleep(0.2)

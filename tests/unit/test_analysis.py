@@ -405,6 +405,98 @@ def test_tpu005_near_miss_guarded_parse(tmp_path):
     assert result.findings == []
 
 
+# --------------------------------------------------------------------- TPU006
+
+
+def test_tpu006_flags_wall_clock_duration_subtraction(tmp_path):
+    result = lint_source(
+        tmp_path,
+        """
+        import time
+
+        def measure(step):
+            t0 = time.time()
+            step()
+            return time.time() - t0
+        """,
+    )
+    assert rule_ids(result) == ["TPU006"]
+
+
+def test_tpu006_flags_wall_clock_deadline_comparison(tmp_path):
+    result = lint_source(
+        tmp_path,
+        """
+        import time
+
+        def drain(timeout_s):
+            deadline = time.time() + timeout_s
+            while time.time() < deadline:
+                pass
+        """,
+    )
+    assert rule_ids(result) == ["TPU006"]
+
+
+def test_tpu006_flags_from_import_time_spelling(tmp_path):
+    result = lint_source(
+        tmp_path,
+        """
+        from time import time
+
+        def elapsed(t0=None):
+            start = time()
+            return time() - start
+        """,
+    )
+    assert rule_ids(result) == ["TPU006"]
+
+
+def test_tpu006_near_miss_monotonic_and_lone_timestamps(tmp_path):
+    # monotonic pairing is the FIX; a lone time.time() timestamp (heartbeat
+    # files, deployed_at records) is legitimate wall-clock use; and
+    # subtracting a wall-clock value from ANOTHER process (file-read
+    # heartbeat) is the one case monotonic cannot serve — none may flag
+    result = lint_source(
+        tmp_path,
+        """
+        import time
+
+        def measure(step):
+            t0 = time.monotonic()
+            step()
+            return time.monotonic() - t0
+
+        def heartbeat_record():
+            return {"deployed_at": time.time()}
+
+        def heartbeat_age(path):
+            return max(0.0, time.time() - float(path.read_text().strip()))
+        """,
+    )
+    assert result.findings == []
+
+
+def test_tpu006_taint_stays_in_scope(tmp_path):
+    # a name tainted in one function must not condemn the same name in
+    # another scope where it holds a monotonic value
+    result = lint_source(
+        tmp_path,
+        """
+        import time
+
+        def wall():
+            t0 = time.time()
+            return t0
+
+        def mono():
+            t0 = time.monotonic()
+            return time.monotonic() - t0
+        """,
+    )
+    assert result.findings == []
+
+
 # --------------------------------------------- suppressions, reporters, CLI
 
 

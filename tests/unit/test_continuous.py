@@ -1087,8 +1087,8 @@ def test_overload_admission_deadline_and_disconnect(tiny_gen, sklearn_model):
         # ---- 1+2: bound the waiting queue and shed the expired waiter
         occupant = batcher.submit(PROMPTS[0])  # 256-token budget: owns the slot
         next(occupant)  # first token: resident now
-        deadline = time.time() + 30
-        while time.time() < deadline and batcher.stats()["waiting"]:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and batcher.stats()["waiting"]:
             time.sleep(0.01)
         doomed = batcher.submit(PROMPTS[1], deadline=time.monotonic() + 0.02)
         waiter = batcher.submit(PROMPTS[3], max_new_tokens=4)

@@ -192,3 +192,24 @@ def test_batch_handler_url_encoded_keys(trained_model):
     handler = make_batch_handler(trained_model, store)
     result = handler(_s3_event("inbox", "daily+report.json"), None)
     assert result["outputs"] == [{"bucket": "inbox", "key": "predictions/daily report.json"}]
+
+
+def test_lambda_handler_echoes_request_id(trained_model):
+    """The X-Request-Id contract survives the event bridge (docs/observability.md):
+    inbound ids come back on success AND error responses, absent ids are minted."""
+    handler = lambda_handler(trained_model.serve())
+    event = _api_gateway_v1_event({"features": FEATURES})
+    event["headers"]["X-Request-Id"] = "lambda-rid-1"
+    response = handler(event, None)
+    assert response["statusCode"] == 200
+    assert response["headers"]["X-Request-Id"] == "lambda-rid-1"
+
+    missing = handler(
+        {"httpMethod": "GET", "path": "/nope", "headers": {"X-Request-Id": "lambda-rid-2"}},
+        None,
+    )
+    assert missing["statusCode"] == 404
+    assert missing["headers"]["X-Request-Id"] == "lambda-rid-2"
+
+    minted = handler({"httpMethod": "GET", "path": "/health"}, None)
+    assert len(minted["headers"]["X-Request-Id"]) == 32

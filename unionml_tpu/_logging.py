@@ -1,14 +1,84 @@
 """Package logger.
 
-Parity: reference unionml/_logging.py:3-7 (stream logger with a ``[unionml]`` prefix).
+Parity: reference unionml/_logging.py:3-7 (stream logger with a ``[unionml]``
+prefix). Extended for serving observability (docs/observability.md):
+
+- ``UNIONML_TPU_LOGLEVEL`` is validated — a garbage value (``=garbage``) warns
+  and falls back to INFO instead of raising ``ValueError`` at import time,
+  before any app code has run (the same warn-and-fall-back contract as
+  :func:`unionml_tpu.defaults.env_int`);
+- ``UNIONML_TPU_LOG_FORMAT=json`` (or :func:`set_log_format` — the ``serve
+  --log-format json`` flag lands there) switches every line to one JSON
+  object carrying the active request id from
+  :mod:`unionml_tpu.observability.trace`, so access-log lines correlate with
+  ``/debug/requests`` timelines by ``request_id``.
 """
 
+import json
 import logging
 import os
 
+_VALID_LEVELS = ("DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL", "NOTSET", "WARN", "FATAL")
+
+_TEXT_FORMAT = "[unionml-tpu] %(asctime)s %(levelname)s %(message)s"
+
+
+class JsonFormatter(logging.Formatter):
+    """One JSON object per line: timestamp, level, message, and — when a
+    request is being handled — its ``request_id``, the correlation key into
+    the flight recorder's timelines."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": self.formatTime(record),
+            "level": record.levelname,
+            "logger": record.name,
+            "message": record.getMessage(),
+        }
+        if record.exc_info:
+            out["exc_info"] = self.formatException(record.exc_info)
+        try:
+            # lazy import: observability.trace imports nothing from here, but
+            # keeping the edge out of module scope avoids any cycle risk and
+            # keeps bare-logger users free of the serving stack
+            from unionml_tpu.observability.trace import current_request_id
+
+            request_id = current_request_id()
+            if request_id is not None:
+                out["request_id"] = request_id
+        except Exception:  # pragma: no cover - never fail a log line
+            pass
+        return json.dumps(out, default=str)
+
+
+def _resolve_level() -> "tuple[str, str | None]":
+    """``(level, warning)`` from the env: an unknown name degrades to INFO with
+    a warning emitted AFTER the handler is attached (the logger must exist
+    before it can complain about its own configuration)."""
+    raw = os.environ.get("UNIONML_TPU_LOGLEVEL", "INFO").strip().upper()
+    if raw in _VALID_LEVELS:
+        return raw, None
+    return "INFO", f"ignoring invalid UNIONML_TPU_LOGLEVEL={raw!r}; falling back to INFO"
+
+
+def set_log_format(fmt: str) -> None:
+    """Switch the package handler's formatter: ``"json"`` for structured
+    lines (request-id correlation), anything else for the classic text
+    prefix. The ``serve --log-format`` flag calls this."""
+    formatter: logging.Formatter = (
+        JsonFormatter() if str(fmt).strip().lower() == "json" else logging.Formatter(_TEXT_FORMAT)
+    )
+    for handler in logger.handlers:
+        handler.setFormatter(formatter)
+
+
 logger = logging.getLogger("unionml_tpu")
-logger.setLevel(os.environ.get("UNIONML_TPU_LOGLEVEL", "INFO"))
-_handler = logging.StreamHandler()
-_handler.setFormatter(logging.Formatter("[unionml-tpu] %(asctime)s %(levelname)s %(message)s"))
-logger.addHandler(_handler)
+_level, _level_warning = _resolve_level()
+logger.setLevel(_level)
+if not logger.handlers:  # re-imports (importlib.reload) must not stack handlers
+    _handler = logging.StreamHandler()
+    logger.addHandler(_handler)
 logger.propagate = False
+set_log_format(os.environ.get("UNIONML_TPU_LOG_FORMAT", "text"))
+if _level_warning:
+    logger.warning(_level_warning)

@@ -14,6 +14,7 @@ from unionml_tpu.analysis.rules.tpu002_donate import UseAfterDonate
 from unionml_tpu.analysis.rules.tpu003_locks import UnlockedSharedMutation
 from unionml_tpu.analysis.rules.tpu004_blocking import BlockingCallInServingLoop
 from unionml_tpu.analysis.rules.tpu005_env import BareEnvNumericParse
+from unionml_tpu.analysis.rules.tpu006_wall_clock import WallClockDuration
 
 __all__ = ["RULES"]
 
@@ -25,5 +26,6 @@ RULES = {
         UnlockedSharedMutation,
         BlockingCallInServingLoop,
         BareEnvNumericParse,
+        WallClockDuration,
     )
 }

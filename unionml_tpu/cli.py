@@ -95,11 +95,12 @@ def templates() -> None:
 def lint(
     paths: "tuple[str, ...]", format_: str, select: Optional[str], ignore: Optional[str], show_suppressed: bool
 ) -> None:
-    """Run tpu-lint, the TPU/concurrency-aware static analyzer (TPU001-TPU005).
+    """Run tpu-lint, the TPU/concurrency-aware static analyzer (TPU001-TPU006).
 
     Checks for host syncs inside jit-compiled functions, use-after-donate,
     unlocked mutation of lock-guarded state, blocking calls in serving
-    handlers/engine loops, and bare env-var numeric parses. PATHS defaults to
+    handlers/engine loops, bare env-var numeric parses, and wall-clock
+    time.time() in duration/deadline arithmetic. PATHS defaults to
     ``unionml_tpu``; exits 0 when clean, 1 on findings, 2 on usage/parse
     errors. Also runnable as ``python -m unionml_tpu.analysis``.
     """
@@ -273,6 +274,26 @@ def fetch_model(
     "--max-admissions", default=None, type=int,
     help="concurrent partially-prefilled admissions in the continuous engine (0 = 1)",
 )
+@click.option(
+    "--trace/--no-trace", "trace", default=None,
+    help="record a per-request timeline (queue wait, routed replica, prefill chunks, "
+    "emissions) into the flight recorder, served at /debug/requests; request ids flow "
+    "and echo on every response regardless",
+)
+@click.option(
+    "--flight-recorder-size", default=None, type=int,
+    help="completed request timelines the flight recorder retains (ring buffer)",
+)
+@click.option(
+    "--log-format", default=None, type=click.Choice(["text", "json"]),
+    help="log line format; json emits structured lines carrying the request id and "
+    "turns on the per-request access log",
+)
+@click.option(
+    "--profile-dir", default=None, type=click.Path(file_okay=False, path_type=Path),
+    help="directory for on-demand POST /debug/profile jax.profiler captures "
+    "(unset disables the endpoint)",
+)
 def serve(
     app_ref: str,
     model_path: Optional[Path],
@@ -292,6 +313,10 @@ def serve(
     admit_chunk: Optional[int],
     prefill_budget: Optional[int],
     max_admissions: Optional[int],
+    trace: Optional[bool],
+    flight_recorder_size: Optional[int],
+    log_format: Optional[str],
+    profile_dir: Optional[Path],
 ) -> None:
     """Start the HTTP prediction service (reference cli.py:172-205).
 
@@ -321,6 +346,14 @@ def serve(
     admission prefill and interleave it with decode, bounding resident
     streams' time-between-tokens at ~one chunk while a long prompt admits;
     same early-export contract as ``--dp-replicas``.
+
+    Observability (docs/observability.md): ``--trace`` records per-request
+    timelines into the flight recorder (``GET /debug/requests``,
+    ``GET /debug/requests/<id>``), ``--flight-recorder-size`` bounds the ring,
+    ``--log-format json`` emits structured log lines carrying the request id,
+    and ``--profile-dir`` enables on-demand ``POST /debug/profile`` captures.
+    All exported as env vars before the app module imports, so engines and
+    loggers built at import time see them.
     """
     if dp_replicas is not None:
         if dp_replicas < 0:
@@ -345,6 +378,25 @@ def serve(
             # same early-export contract as --dp-replicas: engines built at
             # app-module import time must see the knobs
             os.environ[getattr(_defaults, env_name)] = str(value)
+    # observability knobs: same early-export contract as --dp-replicas (the
+    # serving app reads them at construction; reload/fork children inherit)
+    if trace is not None or flight_recorder_size is not None or profile_dir is not None:
+        from unionml_tpu import defaults as _defaults
+
+        if trace is not None:
+            os.environ[_defaults.SERVE_TRACE_ENV_VAR] = "1" if trace else "0"
+        if flight_recorder_size is not None:
+            if flight_recorder_size < 1:
+                raise click.ClickException("--flight-recorder-size must be >= 1")
+            os.environ[_defaults.SERVE_FLIGHT_RECORDER_ENV_VAR] = str(flight_recorder_size)
+        if profile_dir is not None:
+            os.environ[_defaults.SERVE_PROFILE_DIR_ENV_VAR] = str(profile_dir)
+    if log_format is not None:
+        from unionml_tpu import defaults as _defaults
+        from unionml_tpu._logging import set_log_format
+
+        set_log_format(log_format)
+        os.environ[_defaults.SERVE_LOG_FORMAT_ENV_VAR] = log_format
     if log_level is not None:
         from unionml_tpu._logging import logger as package_logger
 
@@ -376,7 +428,12 @@ def serve(
         default_deadline_ms=deadline_ms,
         max_deadline_ms=max_deadline_ms,
         drain_timeout_s=drain_timeout,
-    ).configure_replicas(dp_replicas)
+    ).configure_replicas(dp_replicas).configure_observability(
+        trace=trace,
+        flight_recorder_size=flight_recorder_size,
+        log_format=log_format,
+        profile_dir=str(profile_dir) if profile_dir is not None else None,
+    )
 
     if workers > 1:
         import signal

@@ -91,6 +91,33 @@ SERVE_PREFILL_BUDGET_ENV_VAR = "UNIONML_TPU_PREFILL_BUDGET"
 #: concurrent partially-prefilled admissions; 0 = unset (one at a time).
 SERVE_MAX_ADMISSIONS_ENV_VAR = "UNIONML_TPU_MAX_ADMISSIONS"
 
+# --------------------------------------------------------------- observability
+# Request-tracing / flight-recorder / profiler knobs (unionml_tpu/observability,
+# docs/observability.md). Same export pattern as the admission knobs above: the
+# serve CLI sets the env vars before the app module imports, and the serving
+# app reads them at construction.
+
+#: 1 = record a per-request timeline (spans at every lifecycle stage) into the
+#: flight recorder; 0 = off (request ids still flow — tracing is the only part
+#: with a cost, and it is strictly zero-allocation while off).
+SERVE_TRACE_ENV_VAR = "UNIONML_TPU_TRACE"
+
+#: completed request timelines the flight recorder retains (ring buffer).
+SERVE_FLIGHT_RECORDER_ENV_VAR = "UNIONML_TPU_FLIGHT_RECORDER_SIZE"
+SERVE_FLIGHT_RECORDER_SIZE = 256
+
+#: log line format: "text" (classic prefix) or "json" (structured lines
+#: carrying the request id — see _logging.JsonFormatter).
+SERVE_LOG_FORMAT_ENV_VAR = "UNIONML_TPU_LOG_FORMAT"
+
+#: directory ``POST /debug/profile`` writes jax.profiler traces into; unset
+#: disables the endpoint (it answers 400 with a pointer to the flag).
+SERVE_PROFILE_DIR_ENV_VAR = "UNIONML_TPU_PROFILE_DIR"
+
+#: ceiling on one on-demand profile capture (ms): a runaway duration request
+#: must not leave the profiler running for hours.
+SERVE_PROFILE_MAX_MS = 60_000.0
+
 
 def env_int(name: str, default: int, *, minimum: "int | None" = None) -> int:
     """Parse an integer env var, tolerating garbage: unset/empty -> ``default``,
@@ -155,3 +182,22 @@ def serve_prefill_budget() -> int:
 def serve_max_admissions() -> int:
     """Serve-time cap on concurrent partially-prefilled admissions; 0 = unset."""
     return env_int(SERVE_MAX_ADMISSIONS_ENV_VAR, 0, minimum=0)
+
+
+def serve_trace() -> bool:
+    """Whether serve-time request tracing is on (``UNIONML_TPU_TRACE=1``);
+    read at app construction, after the CLI's early export."""
+    return env_int(SERVE_TRACE_ENV_VAR, 0, minimum=0) > 0
+
+
+def serve_flight_recorder_size() -> int:
+    """Completed request timelines the flight recorder retains; garbage or
+    sub-1 values degrade to the default (the recorder requires >= 1)."""
+    return env_int(SERVE_FLIGHT_RECORDER_ENV_VAR, SERVE_FLIGHT_RECORDER_SIZE, minimum=1)
+
+
+def serve_profile_dir() -> "str | None":
+    """Directory for on-demand ``POST /debug/profile`` captures; None = the
+    endpoint is disabled."""
+    raw = os.environ.get(SERVE_PROFILE_DIR_ENV_VAR)
+    return raw.strip() or None if raw is not None else None

@@ -1,0 +1,49 @@
+"""End-to-end request observability for the serving stack.
+
+Three layers, each usable alone (docs/observability.md):
+
+- :mod:`~unionml_tpu.observability.trace` — request ids (always on: honored
+  from ``X-Request-Id``, generated otherwise, echoed on every response) and
+  per-request :class:`~unionml_tpu.observability.trace.RequestTrace` timelines
+  recording monotonic-clock events at each lifecycle stage, strictly zero-cost
+  while tracing is off;
+- :mod:`~unionml_tpu.observability.recorder` — a
+  :class:`~unionml_tpu.observability.recorder.FlightRecorder` ring of the last
+  N completed timelines plus the live in-flight table, served at
+  ``GET /debug/requests`` and dumped to the log on drain / engine failure;
+- :mod:`~unionml_tpu.observability.prometheus` — the Prometheus text
+  exposition of the ``/metrics`` snapshot
+  (``GET /metrics?format=prometheus``).
+
+Knobs flow the established serving path: engine/app kwargs <- ``serve
+--trace/--flight-recorder-size/--log-format/--profile-dir`` <-
+``UNIONML_TPU_*`` env vars via :mod:`unionml_tpu.defaults`.
+"""
+
+from unionml_tpu.observability.prometheus import render as render_prometheus
+from unionml_tpu.observability.recorder import FlightRecorder, active_recorder, set_active_recorder
+from unionml_tpu.observability.trace import (
+    REQUEST_ID_HEADER,
+    RequestTrace,
+    Span,
+    Tracer,
+    current_request_id,
+    current_trace,
+    new_request_id,
+    sanitize_request_id,
+)
+
+__all__ = [
+    "FlightRecorder",
+    "REQUEST_ID_HEADER",
+    "RequestTrace",
+    "Span",
+    "Tracer",
+    "active_recorder",
+    "current_request_id",
+    "current_trace",
+    "new_request_id",
+    "render_prometheus",
+    "sanitize_request_id",
+    "set_active_recorder",
+]

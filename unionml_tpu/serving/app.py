@@ -19,22 +19,34 @@ Deviations, both deliberate:
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import json
 import os
 import re
 from http import HTTPStatus
 from typing import Any, Optional
 
-from unionml_tpu._logging import logger
+from unionml_tpu._logging import logger, set_log_format
 from unionml_tpu.artifact import ModelArtifact
 from unionml_tpu.defaults import (
     MODEL_PATH_ENV_VAR,
     SERVE_DEFAULT_DEADLINE_MS,
     SERVE_DP_REPLICAS_ENV_VAR,
+    SERVE_LOG_FORMAT_ENV_VAR,
     SERVE_MAX_INFLIGHT,
+    SERVE_PROFILE_MAX_MS,
+    serve_flight_recorder_size,
+    serve_profile_dir,
+    serve_trace,
+)
+from unionml_tpu.observability import (
+    FlightRecorder,
+    Tracer,
+    render_prometheus,
+    set_active_recorder,
 )
 from unionml_tpu.serving.batcher import MicroBatcher, ServingConfig
-from unionml_tpu.serving.http import HTTPError, HTTPServer
+from unionml_tpu.serving.http import HTTPError, HTTPServer, current_query
 from unionml_tpu.serving.metrics import ServingMetrics
 from unionml_tpu.serving.overload import DeadlineExceeded, QueueFullError, current_deadline
 
@@ -77,6 +89,25 @@ class ServingApp:
         #: serve-time --dp-replicas override (None until configure_replicas)
         self.dp_replicas: Optional[int] = None
         self._started = False
+        # ---- observability (docs/observability.md): flight recorder + tracer,
+        # defaults from the UNIONML_TPU_TRACE / _FLIGHT_RECORDER_SIZE /
+        # _PROFILE_DIR env exports (the serve CLI sets them before the app
+        # module imports); configure_observability() overrides per app.
+        self.recorder = FlightRecorder(serve_flight_recorder_size())
+        self.tracer = Tracer(enabled=serve_trace(), recorder=self.recorder)
+        self.server.tracer = self.tracer
+        # installed process-wide so the continuous engine's failure handler can
+        # dump timelines without holding an app reference
+        set_active_recorder(self.recorder)
+        #: jax.profiler capture directory for POST /debug/profile (None = off)
+        self.profile_dir: Optional[str] = serve_profile_dir()
+        self._profiling = False
+        # correlated access logs come free once either correlation signal is
+        # on: tracing (timeline ids) or JSON log lines (request_id field)
+        self.server.access_log = (
+            self.tracer.enabled
+            or os.environ.get(SERVE_LOG_FORMAT_ENV_VAR, "").strip().lower() == "json"
+        )
 
         config = getattr(model, "_predictor_config", None)
         if batcher is not None:
@@ -129,6 +160,9 @@ class ServingApp:
         self.server.route("GET", "/metrics", self._metrics)
         self.server.route("POST", "/predict", self._predict)
         self.server.route("POST", "/predict-stream", self._predict_stream)
+        self.server.route("GET", "/debug/requests", self._debug_requests)
+        self.server.route_prefix("GET", "/debug/requests/", self._debug_request_by_id)
+        self.server.route("POST", "/debug/profile", self._debug_profile)
 
     # ------------------------------------------------------------------ lifecycle
 
@@ -151,6 +185,39 @@ class ServingApp:
             self.server.max_deadline_ms = max_deadline_ms or None
         if drain_timeout_s is not None:
             self.server.drain_timeout_s = drain_timeout_s
+        return self
+
+    def configure_observability(
+        self,
+        *,
+        trace: Optional[bool] = None,
+        flight_recorder_size: Optional[int] = None,
+        log_format: Optional[str] = None,
+        profile_dir: Optional[str] = None,
+        access_log: Optional[bool] = None,
+    ) -> "ServingApp":
+        """Override the observability knobs (the ``serve
+        --trace/--flight-recorder-size/--log-format/--profile-dir`` flags land
+        here; docs/observability.md). ``None`` leaves a knob at its current
+        value. ``log_format="json"`` also turns the per-request access log on
+        (that is the correlation the structured lines exist for) unless
+        ``access_log`` explicitly says otherwise."""
+        if flight_recorder_size is not None and flight_recorder_size != self.recorder.capacity:
+            self.recorder = FlightRecorder(flight_recorder_size)
+            self.tracer.recorder = self.recorder
+            set_active_recorder(self.recorder)
+        if trace is not None:
+            self.tracer.enabled = bool(trace)
+            if access_log is None and trace:
+                access_log = True
+        if log_format is not None:
+            set_log_format(log_format)
+            if access_log is None:
+                access_log = str(log_format).strip().lower() == "json"
+        if profile_dir is not None:
+            self.profile_dir = str(profile_dir) or None
+        if access_log is not None:
+            self.server.access_log = bool(access_log)
         return self
 
     def configure_replicas(self, dp_replicas: Optional[int] = None) -> "ServingApp":
@@ -182,6 +249,14 @@ class ServingApp:
                 batcher.close(wait=False)
             except Exception:  # pragma: no cover - defensive
                 logger.exception("generation batcher close failed during drain")
+        # postmortem on the way out: whatever timelines the recorder holds
+        # (requests that never finished included) reach the log before the
+        # process exits — skipped when tracing never recorded anything
+        if len(self.recorder) or self.recorder.inflight_count:
+            try:
+                self.recorder.dump("graceful drain")
+            except Exception:  # pragma: no cover - defensive
+                logger.exception("flight recorder dump failed during drain")
 
     def startup(self) -> None:
         """Load the model artifact (reference fastapi.py:22-34 startup hook)."""
@@ -284,7 +359,11 @@ class ServingApp:
     async def _metrics(self, body: bytes):
         """Request counters and latency percentiles per route (SURVEY.md §5.5 —
         p50/p99 are the BASELINE serving metric, measured in-server, not just by
-        the external benchmark client)."""
+        the external benchmark client). ``?format=prometheus`` renders the SAME
+        snapshot as Prometheus text exposition for scrape-based monitoring."""
+        fmt = current_query().get("format", "json").strip().lower()
+        if fmt not in ("json", "prometheus"):
+            raise HTTPError(400, f"unknown metrics format {fmt!r} (json or prometheus)")
         snapshot = self.metrics.snapshot()
         compiled = getattr(self.model, "_compiled_predictor", None)
         if compiled is not None:
@@ -302,7 +381,87 @@ class ServingApp:
             # it observable (avg rows per dispatch -> how much of the
             # vectorization win concurrency is actually realizing)
             snapshot["micro_batcher"] = self.batcher.stats()
+        if fmt == "prometheus":
+            return 200, render_prometheus(snapshot), "text/plain; version=0.0.4"
         return 200, snapshot, "application/json"
+
+    # ------------------------------------------------------------------ debug surface
+
+    async def _debug_requests(self, body: bytes):
+        """The flight recorder's tables: live in-flight request timelines plus
+        the ring of recently completed ones. Filters: ``?route=`` (substring
+        of ``METHOD /path``), ``?status=`` (exact), ``?limit=`` (per table,
+        default 100)."""
+        query = current_query()
+        status: Optional[int] = None
+        if query.get("status"):
+            try:
+                status = int(query["status"])
+            except ValueError:
+                raise HTTPError(400, f"status filter must be an integer, got {query['status']!r}")
+        limit = 100
+        if query.get("limit"):
+            try:
+                limit = max(int(query["limit"]), 0)
+            except ValueError:
+                raise HTTPError(400, f"limit must be an integer, got {query['limit']!r}")
+        snapshot = self.recorder.snapshot(
+            route=query.get("route") or None, status=status, limit=limit
+        )
+        snapshot["tracing"] = self.tracer.enabled
+        return 200, snapshot, "application/json"
+
+    async def _debug_request_by_id(self, body: bytes, request_id: str):
+        """One request's full timeline by id (the value every response echoes
+        in ``X-Request-Id``)."""
+        found = self.recorder.get(request_id)
+        if found is None:
+            detail = f"no recorded timeline for request id {request_id!r}"
+            if not self.tracer.enabled:
+                detail += " (tracing is off; enable with serve --trace or UNIONML_TPU_TRACE=1)"
+            raise HTTPError(404, detail)
+        return 200, found, "application/json"
+
+    async def _debug_profile(self, body: bytes):
+        """On-demand ``jax.profiler`` capture (the serve-side mirror of the
+        train driver's ``profile_dir``/``profile_steps`` hooks): traces device
+        + host activity for ``duration_ms`` into ``profile_dir``, bounded by
+        ``SERVE_PROFILE_MAX_MS``. One capture at a time — overlapping requests
+        get 409 (the profiler is process-global state)."""
+        if self.profile_dir is None:
+            raise HTTPError(
+                400,
+                "profiling is not configured; start serve with --profile-dir "
+                "(or set UNIONML_TPU_PROFILE_DIR)",
+            )
+        payload = self._parse_json_object(body) if body.strip() else {}
+        duration_ms = payload.get("duration_ms", 1000.0)
+        try:
+            duration_ms = float(duration_ms)
+        except (TypeError, ValueError):
+            raise HTTPError(400, f"duration_ms must be a number, got {duration_ms!r}")
+        if duration_ms <= 0:
+            raise HTTPError(400, "duration_ms must be > 0")
+        duration_ms = min(duration_ms, SERVE_PROFILE_MAX_MS)
+        if self._profiling:
+            # process-global profiler state: a second start_trace would raise
+            # deep inside jax — shed the overlap cleanly instead
+            raise HTTPError(409, "a profile capture is already in progress")
+        self._profiling = True
+        try:
+            import jax
+
+            jax.profiler.start_trace(self.profile_dir)
+            try:
+                # the capture window; a handler cancellation (deadline) still
+                # stops the trace via the finally
+                await asyncio.sleep(duration_ms / 1000.0)
+            finally:
+                jax.profiler.stop_trace()
+        finally:
+            self._profiling = False
+        logger.info(f"profile capture complete: {duration_ms:.0f} ms -> {self.profile_dir}")
+        return 200, {"profile_dir": self.profile_dir, "duration_ms": duration_ms}, "application/json"
 
     async def _submit_batched(self, features: Any) -> Any:
         """Batcher submit with the request deadline attached and overload
@@ -395,10 +554,16 @@ class ServingApp:
             raise HTTPError(500, "Model artifact not found.")
         loop = asyncio.get_running_loop()
         sentinel = object()
+        # run_in_executor does NOT propagate contextvars — but a generator
+        # stream predictor's body runs at first next(), on the executor, and
+        # that body is where ContinuousBatcher.submit captures the request
+        # id/trace. ctx.run carries the handler's context across; the nexts
+        # are strictly sequential, so re-entering the copy is safe.
+        ctx = contextvars.copy_context()
         try:
             features = self.model._dataset.get_features(features)
             iterator = iter(self.model._stream_predictor(self.model.artifact.model_object, features))
-            first = await loop.run_in_executor(None, next, iterator, sentinel)
+            first = await loop.run_in_executor(None, ctx.run, next, iterator, sentinel)
         except (HTTPError, QueueFullError, DeadlineExceeded):
             # a continuous-batching engine shedding at admission (queue full /
             # deadline) surfaces through the predictor's first next(); let the
@@ -413,7 +578,7 @@ class ServingApp:
                 item = first
                 while item is not sentinel:
                     yield (json.dumps(_to_jsonable(item), default=str) + "\n").encode()
-                    item = await loop.run_in_executor(None, next, iterator, sentinel)
+                    item = await loop.run_in_executor(None, ctx.run, next, iterator, sentinel)
                 completed = True
             finally:
                 # the server acloses this generator when the client goes away;

@@ -27,6 +27,7 @@ from collections import deque
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 from unionml_tpu.defaults import SERVE_QUEUE_MAXSIZE
+from unionml_tpu.observability.trace import current_trace
 from unionml_tpu.parallel.mesh import MeshSpec
 from unionml_tpu.serving.overload import DeadlineExceeded, QueueFullError, expired
 
@@ -225,16 +226,27 @@ class MicroBatcher:
         A full queue sheds immediately with :class:`QueueFullError` (429)."""
         self.start()
         future: asyncio.Future = asyncio.get_event_loop().create_future()
+        # request timeline hook: submit runs in the handler's context, so the
+        # trace (None when tracing is off — the zero-cost path) is reachable
+        # here even though the dispatch happens on the worker task later
+        trace = current_trace()
+        if trace is not None:
+            trace.event("batcher.enqueue", depth=self._queue.qsize())
         try:
             self._queue.put_nowait((features, _num_rows(features), future, deadline, time.monotonic()))
         except asyncio.QueueFull:
             self.shed_queue_full += 1
             if self._metrics is not None:
                 self._metrics.inc("shed_queue_full")
+            if trace is not None:
+                trace.event("batcher.shed_queue_full")
             raise QueueFullError(
                 f"micro-batcher admission queue full ({self.config.max_queue} requests waiting)"
             )
-        return await future
+        result = await future
+        if trace is not None:
+            trace.event("batcher.complete")
+        return result
 
     def _admit(self, item: "Tuple[Any, int, asyncio.Future, Optional[float], float]") -> bool:
         """Dequeue-side shedding: a request whose future is already done (its

@@ -69,6 +69,7 @@ from unionml_tpu.defaults import (
     serve_max_admissions,
     serve_prefill_budget,
 )
+from unionml_tpu.observability.trace import current_trace
 from unionml_tpu.serving.metrics import LatencyWindow
 from unionml_tpu.serving.overload import DeadlineExceeded, QueueFullError, expired
 from unionml_tpu.models.generate import (
@@ -83,6 +84,15 @@ from unionml_tpu.models.generate import (
 __all__ = ["ContinuousBatcher"]
 
 _SENTINEL = object()
+
+
+def _tev(session: "_Session", name: str, **attrs: Any) -> None:
+    """Record an event on a session's request trace, if it carries one — the
+    single instrumentation shape every engine-side site uses (one ``is not
+    None`` test when tracing is off)."""
+    trace = session.trace
+    if trace is not None:
+        trace.event(name, **attrs)
 
 
 @dataclasses.dataclass
@@ -123,6 +133,10 @@ class _Session:
     #: between consecutive emissions is the TBT series — the stall a streaming
     #: client feels while another prompt's prefill occupies the engine
     last_emit: Optional[float] = None
+    #: the submitting request's :class:`~unionml_tpu.observability.trace.RequestTrace`
+    #: (None when tracing is off — every engine-side instrumentation site is a
+    #: single ``is not None`` test, the strictly-zero-cost-off contract)
+    trace: Any = None
 
 
 @dataclasses.dataclass(eq=False)  # identity semantics: fields hold device arrays
@@ -284,6 +298,7 @@ class ContinuousBatcher:
         admit_chunk: Optional[int] = None,
         prefill_budget: Optional[int] = None,
         max_admissions: Optional[int] = None,
+        trace: Optional[bool] = None,
     ):
         if slots < 1:
             raise ValueError("slots must be >= 1")
@@ -303,6 +318,13 @@ class ContinuousBatcher:
         #: slot beyond this are shed at submit() with QueueFullError (HTTP 429)
         #: instead of growing _pending without bound under overload
         self.max_waiting = SERVE_MAX_WAITING if max_waiting is None else max_waiting
+        #: request-timeline annotation: the engine records lifecycle events
+        #: (admission start, prefill chunks, emissions, finish/shed) onto the
+        #: trace each submit() captured from its context. True by default —
+        #: the HTTP layer's tracing switch decides whether a trace EXISTS, so
+        #: with tracing off every site is one ``is not None`` test; False
+        #: opts this engine out entirely (the bench lane's control arm).
+        self.trace_requests = True if trace is None else bool(trace)
         cfg = generator.config
         self.gen = generator
         #: stall-free admission (chunked prefill interleaved with decode).
@@ -801,11 +823,14 @@ class ContinuousBatcher:
         :class:`QueueFullError` (HTTP 429) instead of queueing unboundedly."""
         if len(prompt) == 0:
             raise ValueError("prompt must be non-empty")
+        req_trace = current_trace() if self.trace_requests else None
         if expired(deadline):
             # under the lock: submit runs on arbitrary executor threads, and the
             # engine thread bumps this same counter (lost update otherwise)
             with self._lock:
                 self.shed_deadline += 1
+            if req_trace is not None:
+                req_trace.event("engine.shed_deadline", phase="submit")
             raise DeadlineExceeded("deadline expired before the prompt was enqueued")
         budget = self.gen.config.max_new_tokens
         if max_new_tokens is not None:
@@ -822,7 +847,7 @@ class ContinuousBatcher:
             grammar = int(constraint)
         session = _Session(
             slot=-1, out=queue.Queue(), max_new=budget, grammar=grammar, deadline=deadline,
-            created_at=time.monotonic(),
+            created_at=time.monotonic(), trace=req_trace,
             # the original prompt is retained only where preemption can resume it
             prompt=list(prompt) if self.block_size is not None else [],
         )
@@ -834,6 +859,8 @@ class ContinuousBatcher:
             waiting = sum(1 for _, s in self._pending if not s.finished)
             if waiting >= self.max_waiting:
                 self.shed_queue_full += 1
+                if req_trace is not None:
+                    req_trace.event("engine.shed_queue_full", waiting=waiting)
                 raise QueueFullError(
                     f"continuous-batching waiting queue full ({self.max_waiting} prompts queued "
                     f"ahead of {self.slots} slots)"
@@ -845,7 +872,10 @@ class ContinuousBatcher:
                 self._thread = threading.Thread(target=self._engine_loop, daemon=True)
                 self._thread.start()
             self._lock.notify_all()
-
+        if req_trace is not None:
+            req_trace.event(
+                "engine.submit", prompt_tokens=len(prompt), queued_behind=waiting
+            )
         return _TokenStream(self, session)
 
     def _cancel(self, session: _Session) -> None:
@@ -862,6 +892,7 @@ class ContinuousBatcher:
                 self._pending = [(p, s) for p, s in self._pending if s is not session]
             elif session.slot >= 0 and self._sessions.get(session.slot) is session:
                 self._cancelled.append(session)
+            _tev(session, "engine.cancel", produced=session.produced)
             session.out.put(_SENTINEL)
             self._lock.notify_all()
 
@@ -1069,6 +1100,12 @@ class ContinuousBatcher:
                     self._decode_chunk()
         except BaseException as exc:  # engine death must not strand consumers
             logger.error(f"continuous-batching engine failed: {exc!r}")
+            # postmortem: the timelines that explain the failure leave the
+            # process before the consumers see the error (no-op when no
+            # recorder is installed, i.e. outside a serving app)
+            from unionml_tpu.observability.recorder import dump_active
+
+            dump_active(f"continuous engine failed: {type(exc).__name__}")
             with self._lock:
                 self._closed = True
                 for _, session in self._pending:
@@ -1163,6 +1200,7 @@ class ContinuousBatcher:
                 if expired(s.deadline):
                     s.finished = True
                     self.shed_deadline += 1
+                    _tev(s, "engine.shed_deadline", phase="waiting")
                     s.out.put(DeadlineExceeded(
                         "deadline exceeded while waiting for a decode slot"
                     ))
@@ -1214,6 +1252,11 @@ class ContinuousBatcher:
                     blocks_row[: len(shared)] = shared
                     blocks_row[len(shared) : len(shared) + len(alloc)] = alloc
                 self._seed += 1
+                now = time.monotonic()
+                _tev(
+                    session, "engine.admission_start", slot=slot,
+                    queue_wait_ms=round((now - session.created_at) * 1e3, 3),
+                )
                 self._admissions.append(_Admission(
                     session=session,
                     prompt=prompt,
@@ -1221,7 +1264,7 @@ class ContinuousBatcher:
                     seed=self._seed,
                     budget=session.max_new - session.produced,
                     blocks_row=blocks_row,
-                    started_at=time.monotonic(),
+                    started_at=now,
                     start=p0,
                 ))
 
@@ -1236,6 +1279,7 @@ class ContinuousBatcher:
             if not session.finished and expired(session.deadline):
                 session.finished = True
                 self.shed_deadline += 1
+                _tev(session, "engine.shed_deadline", phase="prefill")
                 session.out.put(DeadlineExceeded(
                     "deadline exceeded mid-prefill; admission abandoned"
                 ))
@@ -1323,6 +1367,7 @@ class ContinuousBatcher:
             adm.done = True
             with self._lock:
                 self.prefill_monolithic += 1
+            _tev(session, "engine.prefill", tokens=p0 + bucket, mode="monolithic")
             return p0 + bucket
         adm.chunk, adm.width = chunk, aligned
         tokens = np.full((1, aligned), cfg.pad_id, np.int32)
@@ -1383,6 +1428,10 @@ class ContinuousBatcher:
         with self._lock:
             self.prefill_chunks += 1
             self.prefill_chunk_tokens += adm.chunk
+        _tev(
+            adm.session, "engine.prefill_chunk",
+            pos=adm.pos, width=adm.width, chunk=adm.chunk,
+        )
         if adm.pos >= adm.width:
             adm.tok0 = gen._first_token(gen.params, adm.last, adm.key, *adm.cstate)
             adm.row_len = adm.lengths
@@ -1473,6 +1522,11 @@ class ContinuousBatcher:
                 # first token EVER for this stream; a preemption resume is a
                 # later residency, not a first token
                 self._ttft.observe(now - session.created_at)
+                _tev(
+                    session, "engine.first_token",
+                    ttft_ms=round((now - session.created_at) * 1e3, 3),
+                )
+            _tev(session, "engine.emit", tokens=1, produced=session.produced + 1)
             if session.last_emit is not None:
                 self._tbt.observe(now - session.last_emit)
             session.last_emit = now
@@ -1537,6 +1591,7 @@ class ContinuousBatcher:
         preemption)."""
         session = self._sessions.pop(slot)
         self.preemptions += 1
+        _tev(session, "engine.preempt", produced=session.produced)
         self._free.append(slot)
         self._release_blocks_locked(slot)
         self._mask_slot_done(slot)
@@ -1583,6 +1638,7 @@ class ContinuousBatcher:
     def _finish_locked(self, slot: int, *, device_done: bool) -> None:
         session = self._sessions.pop(slot)
         session.finished = True
+        _tev(session, "engine.finish", produced=session.produced)
         self._free.append(slot)
         self._release_blocks_locked(slot)
         if not device_done or self.block_size is not None:
@@ -1626,6 +1682,7 @@ class ContinuousBatcher:
                     if self.block_size is not None:
                         session.echo.extend(int(t) for t in row[:take])
                     session.produced += take
+                    _tev(session, "engine.emit", tokens=take, produced=session.produced)
                 device_done = bool(done_np[slot])
                 if session.produced >= session.max_new or device_done:
                     self._finish_locked(slot, device_done=device_done)
@@ -1678,5 +1735,6 @@ class ContinuousBatcher:
                     if self.block_size is not None:
                         session.echo.extend(int(t) for t in new)
                     session.produced = session.resident_base + int(prod_np[slot])
+                    _tev(session, "engine.emit", tokens=int(new.size), produced=session.produced)
                 if bool(done_np[slot]):
                     self._finish_locked(slot, device_done=True)

@@ -16,13 +16,22 @@ lean on TPU VMs.
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import json
 import signal
 import time
+import urllib.parse
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
 from unionml_tpu._logging import logger
 from unionml_tpu.defaults import SERVE_DRAIN_TIMEOUT_S, SERVE_MAX_DEADLINE_MS, SERVE_RETRY_AFTER_S
+from unionml_tpu.observability.trace import (
+    REQUEST_ID_HEADER,
+    bind as _bind_request,
+    new_request_id,
+    sanitize_request_id,
+    unbind as _unbind_request,
+)
 from unionml_tpu.serving.overload import (
     DeadlineExceeded,
     QueueFullError,
@@ -38,11 +47,25 @@ _STATUS_PHRASES = {
     404: "Not Found",
     405: "Method Not Allowed",
     408: "Request Timeout",
+    409: "Conflict",
     413: "Payload Too Large",
     429: "Too Many Requests",
     500: "Internal Server Error",
     503: "Service Unavailable",
 }
+
+#: query parameters of the request currently being handled, bound by
+#: ``_dispatch_full`` — handlers read them via :func:`current_query` instead of
+#: a signature change on the Handler protocol (``/metrics?format=prometheus``,
+#: ``/debug/requests?route=...``)
+request_query: "contextvars.ContextVar[Dict[str, str]]" = contextvars.ContextVar(
+    "request_query", default={}
+)
+
+
+def current_query() -> "Dict[str, str]":
+    """The active request's parsed query-string parameters."""
+    return request_query.get()
 
 MAX_BODY_BYTES = 64 * 1024 * 1024
 KEEPALIVE_IDLE_S = 75.0
@@ -69,10 +92,23 @@ class HTTPServer:
 
     def __init__(self) -> None:
         self._routes: Dict[Tuple[str, str], Handler] = {}
+        #: prefix routes (``/debug/requests/<id>``): handler receives the path
+        #: suffix as a second argument; exact routes always win
+        self._prefix_routes: Dict[Tuple[str, str], Callable[[bytes, str], Awaitable[Any]]] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         #: optional sink with a ``record(route, status, latency_s)`` method
         #: (:class:`unionml_tpu.serving.metrics.ServingMetrics`)
         self.metrics: Any = None
+        #: optional :class:`~unionml_tpu.observability.trace.Tracer`: when set
+        #: and enabled, every request gets a RequestTrace timeline registered
+        #: in the app's flight recorder. Request IDS flow regardless — inbound
+        #: ``X-Request-Id`` honored, generated otherwise, echoed on every
+        #: response including errors and sheds.
+        self.tracer: Any = None
+        #: one structured line per completed request (request id attached via
+        #: the contextvar, so JSON-format logs correlate with traces); off by
+        #: default — the bare server stays silent on the request path
+        self.access_log: bool = False
         # ---- overload knobs (None = unbounded, the bare-server default;
         # ServingApp applies the production defaults from defaults.py)
         self.max_inflight: Optional[int] = None
@@ -88,8 +124,9 @@ class HTTPServer:
         self._inflight = 0
         self._streams = 0
         #: routes that keep answering while draining (health must report
-        #: ready=false, metrics must stay scrapable through the drain)
-        self._drain_exempt = {("GET", "/health"), ("GET", "/metrics")}
+        #: ready=false, metrics must stay scrapable through the drain, and the
+        #: flight recorder is most useful exactly while a drain is stuck)
+        self._drain_exempt = {("GET", "/health"), ("GET", "/metrics"), ("GET", "/debug/requests")}
         self._stop_serving: Optional[asyncio.Event] = None
 
     @property
@@ -99,6 +136,37 @@ class HTTPServer:
 
     def route(self, method: str, path: str, handler: Handler) -> None:
         self._routes[(method.upper(), path)] = handler
+
+    def route_prefix(
+        self, method: str, prefix: str, handler: "Callable[[bytes, str], Awaitable[Any]]"
+    ) -> None:
+        """Register a prefix route: requests whose path extends ``prefix`` call
+        ``handler(body, suffix)``. Exact routes win over prefixes, and the
+        metrics label is the prefix + ``*`` (bounded cardinality — arbitrary
+        suffixes must not mint metric routes)."""
+        self._prefix_routes[(method.upper(), prefix)] = handler
+
+    def _resolve(self, method: str, path: str) -> "Tuple[Optional[Handler], Optional[str]]":
+        """``(handler, metrics_route)`` for a request path: exact match first,
+        then the longest matching prefix route (its suffix is bound into the
+        returned handler)."""
+        handler = self._routes.get((method, path))
+        if handler is not None:
+            return handler, f"{method} {path}"
+        best: Optional[Tuple[str, Callable[[bytes, str], Awaitable[Any]]]] = None
+        for (pmethod, prefix), phandler in self._prefix_routes.items():
+            if pmethod == method and path.startswith(prefix) and len(path) > len(prefix):
+                if best is None or len(prefix) > len(best[0]):
+                    best = (prefix, phandler)
+        if best is None:
+            return None, None
+        prefix, phandler = best
+        suffix = path[len(prefix):]
+
+        async def bound(body: bytes) -> Any:
+            return await phandler(body, suffix)
+
+        return bound, f"{method} {prefix}*"
 
     async def _read_request(
         self, reader: asyncio.StreamReader, request_line: Optional[bytes] = None
@@ -111,7 +179,10 @@ class HTTPServer:
             method, target, version = request_line.decode("latin1").split(" ", 2)
         except ValueError:
             raise ValueError("malformed request line")
-        path = target.split("?", 1)[0]
+        # the query string rides along; _dispatch_full splits and parses it so
+        # the in-process test client (`dispatch("GET", "/metrics?format=...")`)
+        # behaves exactly like the wire
+        path = target
 
         content_length = 0
         # HTTP/1.1 defaults to persistent connections; 1.0 must opt in
@@ -247,97 +318,178 @@ class HTTPServer:
         return {"Retry-After": str(self.retry_after_s)}
 
     async def dispatch(self, method: str, path: str, body: bytes, headers: Optional[Dict[str, str]] = None) -> Tuple[int, Any, str]:
-        """Route a request; usable directly by tests (in-process 'test client')."""
+        """Route a request; usable directly by tests (in-process 'test client').
+        ``path`` may carry a query string (``/metrics?format=prometheus``)."""
         status, payload, content_type, _, _ = await self._dispatch_full(method, path, body, headers)
         return status, payload, content_type
+
+    async def dispatch_with_headers(
+        self, method: str, path: str, body: bytes = b"", headers: Optional[Dict[str, str]] = None
+    ) -> Tuple[int, Any, str, Dict[str, str]]:
+        """:meth:`dispatch` plus the response's extra headers — the serverless
+        adapter uses this so ``X-Request-Id``/``Retry-After`` survive the
+        event bridge."""
+        status, payload, content_type, extra, _ = await self._dispatch_full(method, path, body, headers)
+        return status, payload, content_type, extra
 
     async def _dispatch_full(
         self, method: str, path: str, body: bytes, headers: Optional[Dict[str, str]] = None
     ) -> Tuple[int, Any, str, Dict[str, str], Optional[float]]:
-        """Full dispatch: admission control, deadline propagation, then the
-        handler. Returns ``(status, payload, content_type, extra_headers,
-        stream_deadline)`` — the last element is the absolute deadline to apply
-        to a streaming body (set only when the client sent one explicitly)."""
+        """Full dispatch: request-id binding, admission control, deadline
+        propagation, then the handler. Returns ``(status, payload,
+        content_type, extra_headers, stream_deadline)`` — the last element is
+        the absolute deadline to apply to a streaming body (set only when the
+        client sent one explicitly)."""
         start = time.perf_counter()
         headers = headers or {}
-        handler = self._routes.get((method, path))
-        metrics_route = f"{method} {path}"
-        extra: Dict[str, str] = {}
+        path, _, raw_query = path.partition("?")
+        query = dict(urllib.parse.parse_qsl(raw_query)) if raw_query else {}
+        # request-id contract (docs/observability.md): honor an inbound
+        # X-Request-Id (sanitized — a raw echo of client bytes would be a
+        # header-injection vector), generate otherwise, echo on EVERY response
+        # — errors and sheds included
+        rid = sanitize_request_id(headers.get(REQUEST_ID_HEADER)) or new_request_id()
+        tracer = self.tracer
+        trace = tracer.start(method, path, rid) if tracer is not None else None
+        bind_tokens = _bind_request(rid, trace)
+        query_token = request_query.set(query)
+        extra: Dict[str, str] = {"X-Request-Id": rid}
         stream_deadline: Optional[float] = None
-        if handler is None:
-            if any(p == path for (_, p) in self._routes):
-                # bound the label set: arbitrary method tokens must not mint routes
-                if method not in ("GET", "POST", "PUT", "DELETE", "HEAD", "OPTIONS", "PATCH"):
+        if trace is not None:
+            trace.event("http.accept", body_bytes=len(body))
+        try:
+            handler, metrics_route = self._resolve(method, path)
+            if metrics_route is None:
+                metrics_route = f"{method} {path}"
+            if handler is None:
+                if any(p == path for (_, p) in self._routes):
+                    # bound the label set: arbitrary method tokens must not mint routes
+                    if method not in ("GET", "POST", "PUT", "DELETE", "HEAD", "OPTIONS", "PATCH"):
+                        metrics_route = "<unmatched>"
+                    result = 405, {"detail": f"method {method} not allowed for {path}"}, "application/json"
+                else:
+                    # unmatched paths share one metrics label — per-path labels would let
+                    # a scanner grow the route table (and snapshot) without bound
                     metrics_route = "<unmatched>"
-                result = 405, {"detail": f"method {method} not allowed for {path}"}, "application/json"
-            else:
-                # unmatched paths share one metrics label — per-path labels would let
-                # a scanner grow the route table (and snapshot) without bound
-                metrics_route = "<unmatched>"
-                result = 404, {"detail": f"no route for {path}"}, "application/json"
-        elif self.draining and (method, path) not in self._drain_exempt:
-            # readiness is off: the load balancer should already be routing
-            # around us, so anything still arriving gets a fast 503 + hint
-            self._inc("shed_draining")
-            extra.update(self._shed_headers())
-            result = 503, {"detail": "server is draining"}, "application/json"
-        elif self.max_inflight is not None and self.inflight >= self.max_inflight:
-            # admission control: shed NOW with 429 instead of queueing — a
-            # bounded queue keeps admitted-request latency bounded, and
-            # Retry-After tells well-behaved clients when to come back
-            self._inc("shed_inflight")
-            extra.update(self._shed_headers())
-            result = (
-                429,
-                {"detail": f"server at capacity ({self.max_inflight} requests in flight)"},
-                "application/json",
-            )
-        else:
-            try:
-                deadline, explicit = self._deadline_for(headers)
-            except HTTPError as exc:
-                deadline, explicit = None, False
-                result = exc.status, {"detail": exc.detail}, "application/json"
-                if self.metrics is not None:
-                    self.metrics.record(metrics_route, result[0], time.perf_counter() - start)
-                return (*result, extra, None)
-            if explicit and deadline is not None:
-                stream_deadline = deadline
-            token = request_deadline.set(deadline)
-            self._inflight += 1
-            try:
-                timeout = remaining_s(deadline)
-                if timeout is not None and timeout <= 0:
-                    # born expired (e.g. X-Request-Deadline-Ms: 0 or negative):
-                    # shed before the handler runs at all
-                    raise DeadlineExceeded("deadline expired before dispatch")
-                result = await asyncio.wait_for(handler(body), timeout)
-            except HTTPError as exc:
-                extra.update(exc.headers)
-                result = exc.status, {"detail": exc.detail}, "application/json"
-            except QueueFullError as exc:
-                # an admission queue deeper in the stack (micro-batcher or
-                # continuous engine) is full — same shed contract as ours
-                self._inc("shed_queue_full")
-                extra.update({"Retry-After": str(exc.retry_after_s)})
-                result = 429, {"detail": exc.detail}, "application/json"
-            except (asyncio.TimeoutError, DeadlineExceeded) as exc:
-                # the deadline fired: wait_for has cancelled the handler (its
-                # pending batcher future is dropped and the queued work shed at
-                # the next dispatch), so resources are reclaimed, not leaked
-                self._inc("deadline_timeouts")
+                    result = 404, {"detail": f"no route for {path}"}, "application/json"
+            elif self.draining and (method, path) not in self._drain_exempt:
+                # readiness is off: the load balancer should already be routing
+                # around us, so anything still arriving gets a fast 503 + hint
+                self._inc("shed_draining")
                 extra.update(self._shed_headers())
-                detail = str(exc) or "request deadline exceeded"
-                result = 503, {"detail": detail}, "application/json"
-            except Exception as exc:  # pragma: no cover - defensive
-                logger.exception("handler error")
-                result = 500, {"detail": f"{type(exc).__name__}: {exc}"}, "application/json"
+                if trace is not None:
+                    trace.event("http.shed", reason="draining")
+                result = 503, {"detail": "server is draining"}, "application/json"
+            elif self.max_inflight is not None and self.inflight >= self.max_inflight:
+                # admission control: shed NOW with 429 instead of queueing — a
+                # bounded queue keeps admitted-request latency bounded, and
+                # Retry-After tells well-behaved clients when to come back
+                self._inc("shed_inflight")
+                extra.update(self._shed_headers())
+                if trace is not None:
+                    trace.event("http.shed", reason="inflight_cap")
+                result = (
+                    429,
+                    {"detail": f"server at capacity ({self.max_inflight} requests in flight)"},
+                    "application/json",
+                )
+            else:
+                try:
+                    deadline, explicit = self._deadline_for(headers)
+                except HTTPError as exc:
+                    result = exc.status, {"detail": exc.detail}, "application/json"
+                else:
+                    if explicit and deadline is not None:
+                        stream_deadline = deadline
+                    token = request_deadline.set(deadline)
+                    self._inflight += 1
+                    try:
+                        timeout = remaining_s(deadline)
+                        if timeout is not None and timeout <= 0:
+                            # born expired (e.g. X-Request-Deadline-Ms: 0 or negative):
+                            # shed before the handler runs at all
+                            raise DeadlineExceeded("deadline expired before dispatch")
+                        result = await asyncio.wait_for(handler(body), timeout)
+                    except HTTPError as exc:
+                        extra.update(exc.headers)
+                        result = exc.status, {"detail": exc.detail}, "application/json"
+                    except QueueFullError as exc:
+                        # an admission queue deeper in the stack (micro-batcher or
+                        # continuous engine) is full — same shed contract as ours
+                        self._inc("shed_queue_full")
+                        extra.update({"Retry-After": str(exc.retry_after_s)})
+                        if trace is not None:
+                            trace.event("http.shed", reason="queue_full")
+                        result = 429, {"detail": exc.detail}, "application/json"
+                    except (asyncio.TimeoutError, DeadlineExceeded) as exc:
+                        # the deadline fired: wait_for has cancelled the handler (its
+                        # pending batcher future is dropped and the queued work shed at
+                        # the next dispatch), so resources are reclaimed, not leaked
+                        self._inc("deadline_timeouts")
+                        extra.update(self._shed_headers())
+                        if trace is not None:
+                            trace.event("http.shed", reason="deadline")
+                        detail = str(exc) or "request deadline exceeded"
+                        result = 503, {"detail": detail}, "application/json"
+                    except Exception as exc:  # pragma: no cover - defensive
+                        logger.exception("handler error")
+                        result = 500, {"detail": f"{type(exc).__name__}: {exc}"}, "application/json"
+                    finally:
+                        self._inflight -= 1
+                        request_deadline.reset(token)
+            status, payload = result[0], result[1]
+            if trace is not None:
+                if hasattr(payload, "__aiter__"):
+                    # the handler returned a stream: the trace must outlive this
+                    # method — the wrapper records per-chunk events and finishes
+                    # the timeline when the stream ends (or aborts)
+                    result = (status, self._traced_stream(payload, trace, status), result[2])
+                else:
+                    detail = payload.get("detail") if isinstance(payload, dict) and status >= 400 else None
+                    tracer.finish(trace, status, detail)
+            if self.metrics is not None:
+                self.metrics.record(metrics_route, status, time.perf_counter() - start)
+            if self.access_log:
+                logger.info(
+                    f"{method} {path} {status} "
+                    f"{round((time.perf_counter() - start) * 1e3, 2)}ms rid={rid}"
+                )
+            return (*result, extra, stream_deadline)
+        finally:
+            request_query.reset(query_token)
+            _unbind_request(bind_tokens)
+
+    def _traced_stream(self, payload: Any, trace: Any, status: int):
+        """Wrap a streaming body so its trace finishes when the STREAM does
+        (the handler returned long before the last chunk): one event per HTTP
+        chunk, terminal status on exhaustion/abort, and the wrapped payload's
+        ``aclose`` still runs — the producer-release contract is preserved."""
+        tracer = self.tracer
+
+        async def wrapped():
+            try:
+                async for chunk in payload:
+                    trace.event(
+                        "http.stream_chunk",
+                        bytes=len(chunk) if isinstance(chunk, (bytes, str)) else 0,
+                    )
+                    yield chunk
+            except BaseException as exc:
+                tracer.finish(trace, status, f"stream aborted: {type(exc).__name__}")
+                raise
+            else:
+                tracer.finish(trace, status)
             finally:
-                self._inflight -= 1
-                request_deadline.reset(token)
-        if self.metrics is not None:
-            self.metrics.record(metrics_route, result[0], time.perf_counter() - start)
-        return (*result, extra, stream_deadline)
+                # `async for` does not aclose an early-exited iterator; the
+                # server acloses THIS wrapper, so forward the release
+                closer = getattr(payload, "aclose", None)
+                if closer is not None:
+                    try:
+                        await closer()
+                    except Exception:  # pragma: no cover - defensive
+                        pass
+
+        return wrapped()
 
     async def _on_connection(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
         try:

@@ -119,6 +119,15 @@ class ServingMetrics:
     def _percentile(ordered: "list[float]", q: float) -> float:
         return _percentile(ordered, q)
 
+    def prometheus(self) -> str:
+        """This sink's :meth:`snapshot` rendered as Prometheus text exposition
+        (the serving app's ``/metrics?format=prometheus`` renders its MERGED
+        snapshot — generation/predictor sections included — through the same
+        :func:`unionml_tpu.observability.prometheus.render`)."""
+        from unionml_tpu.observability.prometheus import render
+
+        return render(self.snapshot())
+
     def snapshot(self) -> Dict[str, Any]:
         """Counts + latency percentiles (milliseconds) per route, plus overload
         counters, live gauges, and queue-wait percentiles."""

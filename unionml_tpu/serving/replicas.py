@@ -52,6 +52,7 @@ import numpy as np
 
 from unionml_tpu._logging import logger
 from unionml_tpu.defaults import serve_dp_replicas
+from unionml_tpu.observability.trace import current_trace
 from unionml_tpu.parallel.mesh import BATCH_AXES
 from unionml_tpu.serving.continuous import ContinuousBatcher
 from unionml_tpu.serving.overload import DeadlineExceeded, QueueFullError, expired
@@ -232,6 +233,7 @@ class ReplicaSet:
         max_admissions: Optional[int] = None,
         affinity_tokens: int = 0,
         affinity_margin: int = 2,
+        trace: Optional[bool] = None,
     ):
         if (generators is None) == (engines is None):
             raise ValueError("pass exactly one of generators= or engines=")
@@ -254,6 +256,7 @@ class ReplicaSet:
                             admit_chunk=admit_chunk,
                             prefill_budget=prefill_budget,
                             max_admissions=max_admissions,
+                            trace=trace,
                         )
                     )
             except BaseException:
@@ -405,14 +408,24 @@ class ReplicaSet:
         :class:`QueueFullError` only when every replica's waiting queue is
         full — the scheduler's order is walked so one full replica never turns
         away work its siblings could take."""
+        req_trace = current_trace()
         if expired(deadline):
             with self._lock:
                 self.shed_deadline += 1
+            if req_trace is not None:
+                req_trace.event("engine.shed_deadline", phase="routing")
             raise DeadlineExceeded("deadline expired before the prompt was routed to a replica")
         loads = [batcher.load() for batcher in self._batchers]
         order, affinity_head = self._scheduler.order(loads, prompt)
         last_exc: Optional[QueueFullError] = None
         for replica in order:
+            if req_trace is not None:
+                # which replica, and the load it saw — recorded per ATTEMPT, so
+                # a full replica's fall-through is visible on the timeline
+                req_trace.event(
+                    "engine.routed", replica=replica, load=round(loads[replica], 3),
+                    affinity=affinity_head and replica == order[0],
+                )
             try:
                 stream = self._batchers[replica].submit(
                     prompt, max_new_tokens=max_new_tokens, constraint=constraint, deadline=deadline
@@ -424,6 +437,8 @@ class ReplicaSet:
             return stream
         with self._lock:
             self.shed_queue_full += 1
+        if req_trace is not None:
+            req_trace.event("engine.shed_queue_full", replicas=len(self._batchers))
         raise QueueFullError(
             f"all {len(self._batchers)} replicas' waiting queues are full"
         ) from last_exc

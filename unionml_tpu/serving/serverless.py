@@ -64,11 +64,20 @@ def lambda_handler(serving: ServingApp) -> Callable[[Dict[str, Any], Any], Dict[
 
     def handler(event: Dict[str, Any], context: Any = None) -> Dict[str, Any]:
         method, path, body, headers = _event_request(event)
-        status, payload, content_type = asyncio.run(serving.dispatch(method, path, body, headers))
+
+        async def run() -> Any:
+            # dispatch_with_headers: the request-id echo (and Retry-After on
+            # shed responses) must survive the event bridge — API Gateway
+            # forwards response headers, so callers correlate exactly like
+            # socket clients (docs/observability.md)
+            serving.startup()
+            return await serving.server.dispatch_with_headers(method, path, body, headers)
+
+        status, payload, content_type, extra = asyncio.run(run())
         body_out = payload if isinstance(payload, str) else json.dumps(payload, default=str)
         return {
             "statusCode": status,
-            "headers": {"Content-Type": content_type},
+            "headers": {"Content-Type": content_type, **extra},
             "body": body_out,
             "isBase64Encoded": False,
         }
