@@ -39,6 +39,7 @@ SCRIPTS = {
     "speculative": "bench_speculative.py",
     "continuous": "bench_continuous.py",
     "continuous_stall": "bench_continuous.py",
+    "prefix_cache": "bench_prefix_cache.py",
     "replica_serving": "bench_replica_serving.py",
     "observability": "bench_observability.py",
     "lint": "bench_lint.py",
@@ -64,11 +65,13 @@ if _cpu_extra - set(SCRIPTS):
 #: 8-device host mesh, not chip throughput; lint is pure-Python AST analysis
 #: (tracks tpu-lint's full-repo cost and the suppressed-finding count);
 #: continuous_stall measures the chunked-admission stall REDUCTION — a ratio
-#: of two same-substrate runs, meaningful on the host CPU; observability
-#: likewise pins the tracing on/off throughput ratio (host-side per-token
-#: bookkeeping, not chip throughput)
+#: of two same-substrate runs, meaningful on the host CPU; prefix_cache pins
+#: the warm/cold TTFT ratio and tokens-avoided through one warm engine the
+#: same way; observability likewise pins the tracing on/off throughput ratio
+#: (host-side per-token bookkeeping, not chip throughput)
 CPU_ONLY = {
-    "digits", "serving", "replica_serving", "continuous_stall", "observability", "lint",
+    "digits", "serving", "replica_serving", "continuous_stall", "prefix_cache",
+    "observability", "lint",
 } | _cpu_extra
 
 #: per-lane env overrides: lanes that reuse a script in a different mode

@@ -56,6 +56,7 @@ MODULES = [
     "unionml_tpu.serving.http",
     "unionml_tpu.serving.metrics",
     "unionml_tpu.serving.overload",
+    "unionml_tpu.serving.prefix_cache",
     "unionml_tpu.serving.replicas",
     "unionml_tpu.serving.serverless",
     "unionml_tpu.observability.trace",

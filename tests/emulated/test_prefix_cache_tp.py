@@ -1,0 +1,149 @@
+"""Radix prefix cache token-identity on the emulated 8-device mesh.
+
+Oracle: serving a prefix from cached paged blocks must be invisible in the
+tokens — every warm (cache-hit) stream from a tp=2 engine equals the cold
+(first-visit) stream AND a sequential single-device ``Generator`` run (greedy,
+f32), including the chunked-admission and paged preempt-resume legs. The
+dp=2 x tp=2 ``ReplicaSet`` leg additionally pins that the cached-length
+routing probe steers shared-prefix traffic to the replica that holds the
+cache while staying exact.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from unionml_tpu.models import GenerationConfig, Generator, Llama, LlamaConfig, llama_partition_rules
+from unionml_tpu.parallel import MeshSpec
+from unionml_tpu.serving import ContinuousBatcher, ReplicaSet
+
+pytestmark = pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 emulated devices")
+
+SYSTEM = [7, 7, 3, 9, 1, 2, 5, 11, 4, 8, 6, 10, 12, 3, 2, 9, 5, 1]  # 18 shared tokens
+PROMPTS = [SYSTEM + tail for tail in ([30, 31], [30, 32, 33], [40], [30, 31, 35, 36])]
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    config = LlamaConfig.tiny(
+        vocab_size=96, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, hidden_dim=128,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    module = Llama(config)
+    params = module.init(jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32))["params"]
+    return module, params
+
+
+def _cfg(**overrides):
+    base = dict(max_new_tokens=8, temperature=0.0, prompt_buckets=(32,))
+    base.update(overrides)
+    return GenerationConfig(**base)
+
+
+def _expected(module, params, prompts, cfg=None):
+    gen = Generator(module, params, cfg or _cfg())
+    return [list(gen([p])[0]) for p in prompts]
+
+
+def _drain(stream):
+    return [int(t) for chunk in stream for t in np.asarray(chunk).ravel()]
+
+
+def _drain_concurrently(streams):
+    results = [None] * len(streams)
+
+    def worker(i):
+        results[i] = _drain(streams[i])
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(len(streams))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    return results
+
+
+def test_tp2_cached_prefix_equals_cold_and_sequential(tiny):
+    """tp=2 leg: the heads-major pools shard over the model axis, the block
+    gather/scatter ride the same sharding, and warm streams — chunked
+    admission starting mid-prompt at the first uncached token — equal the
+    cold first-visit stream and the single-device sequential run exactly."""
+    module, params = tiny
+    expected = _expected(module, params, PROMPTS)
+    mesh = MeshSpec(data=1, model=2).build(devices=jax.devices()[:2])
+    gen = Generator(module, params, _cfg(), mesh=mesh, partition_rules=llama_partition_rules())
+    batcher = ContinuousBatcher(
+        gen, slots=2, decode_chunk=4, block_size=8, admit_chunk=8, prefix_cache=True
+    )
+    try:
+        cold = _drain(batcher.submit(PROMPTS[0]))  # publishes SYSTEM's blocks
+        assert cold == expected[0]
+        warm = [_drain(batcher.submit(p)) for p in PROMPTS[1:]]
+        assert warm == expected[1:]
+        stats = batcher.stats()["prefix_cache"]
+        assert stats["hits"] == len(PROMPTS) - 1
+        assert stats["tokens_avoided"] > 0
+    finally:
+        batcher.close()
+
+
+def test_tp2_chunked_and_preempt_resume_legs_stay_exact(tiny):
+    """The two hard admission legs under the cache, on the TP mesh: chunked
+    interleaving (max_admissions > 1) and pool-pressure preempt-resume — the
+    resume's prompt + echo re-matches its own published blocks and the
+    streams stay token-identical throughout."""
+    module, params = tiny
+    cfg = _cfg(max_new_tokens=16, prompt_buckets=(16,))
+    long_prompts = [[3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8, 9, 7], [2, 7, 1, 8, 2, 8, 1, 8, 2, 8, 4, 5, 9, 4]]
+    expected = _expected(module, params, long_prompts, cfg)
+    mesh = MeshSpec(data=1, model=2).build(devices=jax.devices()[:2])
+    gen = Generator(module, params, cfg, mesh=mesh, partition_rules=llama_partition_rules())
+    probe = ContinuousBatcher(gen, slots=2, decode_chunk=8, block_size=8,
+                              admit_chunk=8, prefix_cache=True)
+    pool = 2 * probe._blocks_initial(long_prompts[0], cfg.max_new_tokens)
+    probe.close()
+    batcher = ContinuousBatcher(
+        gen, slots=2, decode_chunk=8, block_size=8, pool_blocks=pool,
+        admit_chunk=8, max_admissions=2, prefix_cache=True,
+    )
+    try:
+        streams = [batcher.submit(p) for p in long_prompts]
+        assert _drain_concurrently(streams) == expected
+        assert batcher.stats()["kv_blocks"]["preemptions"] > 0
+    finally:
+        batcher.close()
+
+
+def test_dp2_tp2_replicaset_routes_on_actual_cached_length(tiny):
+    """dp=2 x tp=2 leg: the delegation path carries prefix_cache to every
+    replica, warm shared-prefix prompts are steered to the replica whose
+    radix tree actually holds the prefix (not an LRU guess), and the fleet's
+    streams equal the sequential single-device run."""
+    module, params = tiny
+    expected = _expected(module, params, PROMPTS)
+    mesh = MeshSpec(data=2, model=2).build(devices=jax.devices()[:4])
+    gen = Generator(module, params, _cfg(), mesh=mesh, partition_rules=llama_partition_rules())
+    engine = ContinuousBatcher(
+        gen, slots=2, decode_chunk=4, block_size=8, admit_chunk=8, prefix_cache=True
+    )
+    try:
+        assert isinstance(engine, ReplicaSet) and engine.replicas == 2
+        for batcher in engine.batchers:
+            assert batcher._radix is not None
+        results = [_drain(engine.submit(p)) for p in PROMPTS]
+        assert results == expected
+        stats = engine.stats()
+        assert stats["prefix_cache"]["hits"] >= len(PROMPTS) - 1
+        # every request after the first followed the cache to one replica
+        assert max(stats["scheduler"]["submitted"]) >= len(PROMPTS) - 1
+        assert stats["scheduler"]["affinity_hits"] >= len(PROMPTS) - 1
+        per_replica_hits = [
+            (entry.get("prefix_cache") or {}).get("hits", 0) for entry in stats["per_replica"]
+        ]
+        assert sum(per_replica_hits) == stats["prefix_cache"]["hits"]
+    finally:
+        engine.close()

@@ -497,6 +497,101 @@ def test_tpu006_taint_stays_in_scope(tmp_path):
     assert result.findings == []
 
 
+# --------------------------------------------------------------------- TPU007
+
+
+def test_tpu007_flags_unlocked_locked_helper_call(tmp_path):
+    result = lint_source(
+        tmp_path,
+        """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._free_blocks = []
+
+            def _release_blocks_locked(self, ids):
+                self._free_blocks.extend(ids)
+
+            def finish(self, ids):
+                self._release_blocks_locked(ids)
+        """,
+    )
+    assert rule_ids(result) == ["TPU007"]
+    assert "_release_blocks_locked" in result.findings[0].message
+
+
+def test_tpu007_near_miss_locked_callers_stay_clean(tmp_path):
+    # under the lock, from another *_locked method (the contract propagates),
+    # from __init__ (unshared construction), on another object (its lock, not
+    # ours), and in a lockless class (naming choice, nothing to hold) — none
+    # may flag
+    result = lint_source(
+        tmp_path,
+        """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Condition()
+                self._free_blocks = []
+                self._seed_locked()
+
+            def _seed_locked(self):
+                self._free_blocks.append(0)
+
+            def _drain_locked(self):
+                self._seed_locked()
+
+            def finish(self):
+                with self._lock:
+                    self._seed_locked()
+
+            def proxy(self, other):
+                other._seed_locked()
+
+        class Lockless:
+            def _helper_locked(self):
+                pass
+
+            def run(self):
+                self._helper_locked()
+        """,
+    )
+    assert result.findings == []
+
+
+def test_tpu007_nested_with_and_closures(tmp_path):
+    # a call under an OUTER with holding the lock is fine even when the inner
+    # with manages something else; a closure's body is its own scope and the
+    # call inside it is not charged to the enclosing method
+    result = lint_source(
+        tmp_path,
+        """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def _free_locked(self):
+                pass
+
+            def drain(self, path):
+                with self._lock:
+                    with open(path) as fh:
+                        self._free_locked()
+
+            def deferred(self):
+                def cb():
+                    self._free_locked()
+                return cb
+        """,
+    )
+    assert result.findings == []
+
+
 # --------------------------------------------- suppressions, reporters, CLI
 
 

@@ -376,6 +376,29 @@ def test_prometheus_nested_sections_flatten_with_index_labels():
     assert 'unionml_tpu_generation_per_replica_resident{index="1"} 2' in text
 
 
+def test_prometheus_renders_prefix_cache_section_without_none_gauges():
+    # the radix prefix cache's stats() section (serving/continuous.py) must
+    # reach the exposition as plain numeric series — every value an int by
+    # contract, never a None-valued sample; grammar-checked like the rest
+    snapshot = {
+        "requests_total": 0,
+        "errors_total": 0,
+        "generation": {
+            "prefix_cache": {
+                "hits": 4, "misses": 1, "tokens_avoided": 96, "cow_copies": 1,
+                "evictions": 0, "evicted_blocks": 0, "cached_blocks": 7,
+                "cached_tokens": 56, "pinned_blocks": 2, "nodes": 3,
+            }
+        },
+    }
+    text = render_prometheus(snapshot)
+    assert _assert_parses(text)
+    assert "None" not in text
+    assert "unionml_tpu_generation_prefix_cache_hits 4" in text
+    assert "unionml_tpu_generation_prefix_cache_tokens_avoided 96" in text
+    assert "unionml_tpu_generation_prefix_cache_pinned_blocks 2" in text
+
+
 # ------------------------------------------------------------------ serving app surface
 
 

@@ -108,6 +108,26 @@ def test_affinity_map_is_lru_bounded():
     assert sched.stats()["affinity_entries"] == 2
 
 
+def test_cached_lengths_take_precedence_over_the_lru_heuristic():
+    """With per-replica radix probes supplied, routing follows the ACTUAL
+    cached-prefix length — even against a stale LRU entry — with the same
+    hotspot margin guard; all-zero probes fall back to the LRU path."""
+    sched = ReplicaScheduler(3, affinity_tokens=2, affinity_margin=2)
+    prompt = [1, 2, 3, 4]
+    sched.note(0, prompt)  # stale LRU memory says replica 0
+    order, affinity = sched.order([1, 0, 1], prompt, cached=[0, 0, 24])
+    assert order[0] == 2 and affinity is True  # replica 2 really holds the KV
+    # hotspot guard: the cache-holding replica is too far above least-loaded
+    order, affinity = sched.order([1, 0, 9], prompt, cached=[0, 0, 24])
+    assert order == [1, 0, 2] and affinity is False
+    # nothing cached anywhere: the LRU heuristic still applies
+    order, affinity = sched.order([1, 0, 1], prompt, cached=[0, 0, 0])
+    assert order[0] == 0 and affinity is True
+    # ties on cached length break toward the less loaded replica
+    order, _ = sched.order([3, 1, 2], prompt, cached=[16, 16, 0])
+    assert order[0] == 1
+
+
 # ------------------------------------------------------------------ replica set
 
 

@@ -15,6 +15,7 @@ from unionml_tpu.analysis.rules.tpu003_locks import UnlockedSharedMutation
 from unionml_tpu.analysis.rules.tpu004_blocking import BlockingCallInServingLoop
 from unionml_tpu.analysis.rules.tpu005_env import BareEnvNumericParse
 from unionml_tpu.analysis.rules.tpu006_wall_clock import WallClockDuration
+from unionml_tpu.analysis.rules.tpu007_locked_callers import UnlockedLockedHelperCall
 
 __all__ = ["RULES"]
 
@@ -27,5 +28,6 @@ RULES = {
         BlockingCallInServingLoop,
         BareEnvNumericParse,
         WallClockDuration,
+        UnlockedLockedHelperCall,
     )
 }

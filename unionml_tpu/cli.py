@@ -95,14 +95,15 @@ def templates() -> None:
 def lint(
     paths: "tuple[str, ...]", format_: str, select: Optional[str], ignore: Optional[str], show_suppressed: bool
 ) -> None:
-    """Run tpu-lint, the TPU/concurrency-aware static analyzer (TPU001-TPU006).
+    """Run tpu-lint, the TPU/concurrency-aware static analyzer (TPU001-TPU007).
 
     Checks for host syncs inside jit-compiled functions, use-after-donate,
     unlocked mutation of lock-guarded state, blocking calls in serving
-    handlers/engine loops, bare env-var numeric parses, and wall-clock
-    time.time() in duration/deadline arithmetic. PATHS defaults to
-    ``unionml_tpu``; exits 0 when clean, 1 on findings, 2 on usage/parse
-    errors. Also runnable as ``python -m unionml_tpu.analysis``.
+    handlers/engine loops, bare env-var numeric parses, wall-clock
+    time.time() in duration/deadline arithmetic, and *_locked helpers called
+    without holding the lock. PATHS defaults to ``unionml_tpu``; exits 0 when
+    clean, 1 on findings, 2 on usage/parse errors. Also runnable as
+    ``python -m unionml_tpu.analysis``.
     """
     from unionml_tpu.analysis.engine import main as lint_main
 
@@ -275,6 +276,12 @@ def fetch_model(
     help="concurrent partially-prefilled admissions in the continuous engine (0 = 1)",
 )
 @click.option(
+    "--prefix-cache/--no-prefix-cache", "prefix_cache", default=None,
+    help="radix prefix cache on paged continuous engines: prompts extending a "
+    "previously-seen prefix (system prompt, multi-turn history) reuse its cached KV "
+    "blocks and prefill only the suffix; off (the default) keeps today's behavior exactly",
+)
+@click.option(
     "--trace/--no-trace", "trace", default=None,
     help="record a per-request timeline (queue wait, routed replica, prefill chunks, "
     "emissions) into the flight recorder, served at /debug/requests; request ids flow "
@@ -313,6 +320,7 @@ def serve(
     admit_chunk: Optional[int],
     prefill_budget: Optional[int],
     max_admissions: Optional[int],
+    prefix_cache: Optional[bool],
     trace: Optional[bool],
     flight_recorder_size: Optional[int],
     log_format: Optional[str],
@@ -347,6 +355,11 @@ def serve(
     streams' time-between-tokens at ~one chunk while a long prompt admits;
     same early-export contract as ``--dp-replicas``.
 
+    ``--prefix-cache`` (docs/serving.md "Prefix caching") enables the radix
+    prefix cache on paged continuous engines: any prompt extending a
+    previously-seen prefix skips prefill for the cached portion, bit-identical
+    to a cold prefill; same early-export contract as ``--dp-replicas``.
+
     Observability (docs/observability.md): ``--trace`` records per-request
     timelines into the flight recorder (``GET /debug/requests``,
     ``GET /debug/requests/<id>``), ``--flight-recorder-size`` bounds the ring,
@@ -362,6 +375,12 @@ def serve(
         from unionml_tpu.defaults import SERVE_DP_REPLICAS_ENV_VAR
 
         os.environ[SERVE_DP_REPLICAS_ENV_VAR] = str(dp_replicas)
+    if prefix_cache is not None:
+        # same early-export contract as --dp-replicas: paged engines built at
+        # app-module import time must see the knob
+        from unionml_tpu.defaults import SERVE_PREFIX_CACHE_ENV_VAR
+
+        os.environ[SERVE_PREFIX_CACHE_ENV_VAR] = "1" if prefix_cache else "0"
     admission_knobs = (
         ("--admit-chunk", admit_chunk, "SERVE_ADMIT_CHUNK_ENV_VAR"),
         ("--prefill-budget", prefill_budget, "SERVE_PREFILL_BUDGET_ENV_VAR"),

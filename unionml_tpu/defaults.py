@@ -91,6 +91,12 @@ SERVE_PREFILL_BUDGET_ENV_VAR = "UNIONML_TPU_PREFILL_BUDGET"
 #: concurrent partially-prefilled admissions; 0 = unset (one at a time).
 SERVE_MAX_ADMISSIONS_ENV_VAR = "UNIONML_TPU_MAX_ADMISSIONS"
 
+#: 1 = enable the radix prefix cache (automatic cross-request KV reuse over
+#: paged blocks, serving/prefix_cache.py) on paged continuous engines; 0/unset
+#: = off, which keeps the engine byte-for-byte the pre-cache one. Same
+#: early-export contract as the admission knobs.
+SERVE_PREFIX_CACHE_ENV_VAR = "UNIONML_TPU_PREFIX_CACHE"
+
 # --------------------------------------------------------------- observability
 # Request-tracing / flight-recorder / profiler knobs (unionml_tpu/observability,
 # docs/observability.md). Same export pattern as the admission knobs above: the
@@ -182,6 +188,13 @@ def serve_prefill_budget() -> int:
 def serve_max_admissions() -> int:
     """Serve-time cap on concurrent partially-prefilled admissions; 0 = unset."""
     return env_int(SERVE_MAX_ADMISSIONS_ENV_VAR, 0, minimum=0)
+
+
+def serve_prefix_cache() -> bool:
+    """Whether the serve-time radix prefix cache is on
+    (``UNIONML_TPU_PREFIX_CACHE=1``); read at engine construction, after the
+    CLI's early export, same contract as :func:`serve_admit_chunk`."""
+    return env_int(SERVE_PREFIX_CACHE_ENV_VAR, 0, minimum=0) > 0
 
 
 def serve_trace() -> bool:

@@ -216,6 +216,33 @@ def _paste_prefix_rows(cache: Any, prefix_layers: Any) -> Any:
 _paste_prefix_rows = jax.jit(_paste_prefix_rows, donate_argnums=(0,))
 
 
+def gather_paged_rows(pool_cache: Any, blocks_row: jax.Array, width: int) -> Tuple[Any, ...]:
+    """Materialize a dense ``[1, width, H_kv, last]`` cache row from a PAGED
+    pool (:func:`init_paged_cache`): position ``pos`` reads block
+    ``blocks_row[pos // block_size]`` at offset ``pos % block_size`` — the
+    exact inverse of the admission scatter, so a row gathered from cached
+    blocks is bit-identical to the row that was scattered in. The serving
+    engine's radix prefix cache uses this to seed an admission's prefill row
+    from arbitrary cached block runs (positions past the cached region gather
+    scratch/garbage, which the suffix prefill overwrites before anything can
+    attend to it). ``width`` is static (one compile per engine: callers pass
+    their fixed ``cache_len``); the per-layer ``table`` entries ride along
+    unused."""
+    block_size = pool_cache[0]["k"].shape[2]  # pools are heads-major [H, NB, bs, last]
+    pos = jnp.arange(width)
+    blk, off = blocks_row[pos // block_size], pos % block_size
+    rows = []
+    for layer in pool_cache:
+        row = {}
+        for name in layer:
+            if name == "table":
+                continue
+            # [H, width, last] -> [1, width, H, last], the dense-row layout
+            row[name] = jnp.swapaxes(layer[name][:, blk, off], 0, 1)[None]
+        rows.append(row)
+    return tuple(rows)
+
+
 def _quantized_shardings(qparams: Any, shardings: Any, mesh: Any) -> Any:
     """Expand a (pre-quantization) sharding tree to match a quantized params tree:
     each :class:`~unionml_tpu.ops.quant.QuantizedTensor` leaf becomes a

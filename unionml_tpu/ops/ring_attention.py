@@ -22,7 +22,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from unionml_tpu.parallel.collectives import all_to_all, ring_permute
+from unionml_tpu.parallel.collectives import all_to_all, axis_size, ring_permute
 
 
 def ring_attention(
@@ -39,7 +39,7 @@ def ring_attention(
     :param q, k, v: local blocks ``[B, L_local, H, D]``, the sequence dim sharded over
         ``axis``. Supports grouped-query KV (``Hkv`` dividing ``H``).
     """
-    ring_size = lax.axis_size(axis)
+    ring_size = axis_size(axis)
     my_index = lax.axis_index(axis)
     scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
 
@@ -108,7 +108,7 @@ def ulysses_attention(
     full-sequence scores fit in HBM; ring attention remains the O(L/s)-memory
     option for extreme context lengths. Call inside ``shard_map``.
     """
-    size = lax.axis_size(axis)
+    size = axis_size(axis)
     n_heads, n_kv = q.shape[2], k.shape[2]
     if n_kv != n_heads:  # GQA: expand KV so the head dim reshards evenly
         k = jnp.repeat(k, n_heads // n_kv, axis=2)

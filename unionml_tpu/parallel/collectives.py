@@ -38,7 +38,12 @@ def axis_index(axis: str) -> jax.Array:
 
 
 def axis_size(axis: str) -> int:
-    return lax.axis_size(axis)
+    # lax.axis_size is newer than the jax this image pins; psum of the literal
+    # 1 is the classic spelling and resolves to a static python int at trace
+    # time (verified under shard_map), so ring perms can still be built host-side
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis)
+    return lax.psum(1, axis_name=axis)
 
 
 def ring_permute(x: Any, axis: str, *, shift: int = 1) -> Any:
@@ -47,7 +52,7 @@ def ring_permute(x: Any, axis: str, *, shift: int = 1) -> Any:
     Each device sends its value to ``(index + shift) % size`` — with the mesh built by
     ``mesh_utils`` these transfers ride neighboring ICI links.
     """
-    size = lax.axis_size(axis)
+    size = axis_size(axis)
     perm = [(i, (i + shift) % size) for i in range(size)]
     return jax.tree_util.tree_map(lambda leaf: lax.ppermute(leaf, axis_name=axis, perm=perm), x)
 

@@ -12,6 +12,7 @@ from unionml_tpu.serving.app import ServingApp, serving_app  # noqa: F401
 from unionml_tpu.serving.batcher import MicroBatcher, ServingConfig  # noqa: F401
 from unionml_tpu.serving.compile import CompiledPredictor  # noqa: F401
 from unionml_tpu.serving.continuous import ContinuousBatcher  # noqa: F401
+from unionml_tpu.serving.prefix_cache import RadixPrefixCache  # noqa: F401
 from unionml_tpu.serving.replicas import ReplicaScheduler, ReplicaSet, slice_mesh  # noqa: F401
 from unionml_tpu.serving.overload import (  # noqa: F401
     DeadlineExceeded,

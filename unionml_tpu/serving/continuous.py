@@ -68,15 +68,18 @@ from unionml_tpu.defaults import (
     serve_dp_replicas,
     serve_max_admissions,
     serve_prefill_budget,
+    serve_prefix_cache,
 )
 from unionml_tpu.observability.trace import current_trace
 from unionml_tpu.serving.metrics import LatencyWindow
 from unionml_tpu.serving.overload import DeadlineExceeded, QueueFullError, expired
+from unionml_tpu.serving.prefix_cache import RadixPrefixCache
 from unionml_tpu.models.generate import (
     Generator,
     PrefixCache,
     _paste_prefix_rows,
     chunk_aligned,
+    gather_paged_rows,
     init_cache,
     init_paged_cache,
 )
@@ -137,6 +140,20 @@ class _Session:
     #: (None when tracing is off — every engine-side instrumentation site is a
     #: single ``is not None`` test, the strictly-zero-cost-off contract)
     trace: Any = None
+    #: leading block-table entries that are SHARED (tree- or prefix-owned,
+    #: read-only to this stream): the admission scatter diverts their writes to
+    #: scratch. Without the radix cache this is the static shared-prefix count
+    #: — identical numbers to the historical behavior.
+    shared_blocks: int = 0
+    #: block-table entries currently assigned (shared + private, in table
+    #: order); lazy growth appends from here. Ownership of an entry's block can
+    #: move to the radix tree without changing the table, so this — not
+    #: ``len(_slot_blocks[slot])`` — is the growth cursor.
+    table_len: int = 0
+    #: radix-tree block ids this session holds pinned (refcounted against
+    #: eviction while its table references them); released on
+    #: finish/cancel/preempt via ``_release_blocks_locked``
+    pins: "List[int]" = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass(eq=False)  # identity semantics: fields hold device arrays
@@ -168,6 +185,11 @@ class _Admission:
     row_cache: Any = None  # target model's [1, cache_len] row (filling up)
     last: Any = None  # accumulated last-real-token hidden state
     d_row_cache: Any = None  # draft model's row, chunked in lockstep
+    # radix prefix cache (prefix_cache=True engines): tokens of the logical
+    # sequence already cached (> prefix length on a hit) and the matched block
+    # ids, scratch-padded, that the dense-row gather reads
+    cached: int = 0
+    gather_row: Optional[np.ndarray] = None
     # completion products consumed by _finalize_admission
     tok0: Any = None
     row_len: Any = None
@@ -250,6 +272,20 @@ class ContinuousBatcher:
     pool is exhausted and resumes as residents finish; ``stats()`` reports
     occupancy. Decoded tokens are exactly the dense path's (the test ring pins
     paged == contiguous == sequential).
+
+    ``prefix_cache=True`` (paged mode only; env default
+    ``UNIONML_TPU_PREFIX_CACHE`` / serve ``--prefix-cache``) turns on the
+    **radix prefix cache** (serving/prefix_cache.py): completed admissions
+    publish their prompts' full KV blocks into a per-engine radix tree, and
+    any later prompt extending a cached prefix skips prefill for the cached
+    portion — gathered from the shared blocks, chunk-prefilled only from the
+    first uncached token. Cached blocks are refcount-pinned while a resident
+    references them, copied-on-write when a request diverges inside a shared
+    tail block, and LRU-evicted back into the allocator under pool pressure
+    (admission never deadlocks against a full cache). Cached-prefix output is
+    bit-identical to a cold prefill; with the flag off the engine is
+    byte-for-byte the pre-cache one. ``stats()["prefix_cache"]`` carries
+    hit/miss/eviction/CoW counters and ``tokens_avoided``.
     """
 
     def __new__(cls, generator: Optional[Generator] = None, **engine_kwargs: Any):
@@ -299,6 +335,7 @@ class ContinuousBatcher:
         prefill_budget: Optional[int] = None,
         max_admissions: Optional[int] = None,
         trace: Optional[bool] = None,
+        prefix_cache: Optional[bool] = None,
     ):
         if slots < 1:
             raise ValueError("slots must be >= 1")
@@ -404,6 +441,50 @@ class ContinuousBatcher:
                 chunk_aligned(b, self.admit_chunk) for b in (cfg.prompt_buckets or (widest,))
             )
             self.cache_len = max(self.cache_len, p0 + aligned)
+        #: radix prefix cache (automatic cross-request KV reuse over paged
+        #: blocks, serving/prefix_cache.py). Resolution mirrors admit_chunk:
+        #: constructor kwarg, then the serve CLI's UNIONML_TPU_PREFIX_CACHE
+        #: export; off (the default) keeps the engine's behavior and stats
+        #: byte-for-byte the pre-cache ones. Requires paged mode — an
+        #: explicit True without block_size is a usage error, while the
+        #: env-derived default degrades with a warning (a fleet-wide export
+        #: must not crash dense engines).
+        if prefix_cache is None:
+            enable_radix = serve_prefix_cache()
+            if enable_radix and block_size is None:
+                logger.warning(
+                    "UNIONML_TPU_PREFIX_CACHE is set but this engine is not paged "
+                    "(block_size=None); prefix caching disabled"
+                )
+                enable_radix = False
+        else:
+            enable_radix = bool(prefix_cache)
+            if enable_radix and block_size is None:
+                raise ValueError("prefix_cache=True requires paged KV (block_size=...)")
+        if enable_radix:
+            if cfg.draft is not None:
+                raise ValueError(
+                    "prefix_cache does not compose with speculative decoding (config.draft) yet"
+                )
+            if prefix is not None and prefix.tokens is None:
+                raise ValueError(
+                    "prefix_cache with a shared prefix needs its token ids (build the "
+                    "PrefixCache with generator.cache_prefix) so the prefix joins the radix key"
+                )
+            #: cache-hit admissions always prefill chunked (the chunk program is
+            #: the one compile-bounded prefill for arbitrary start offsets);
+            #: chunk resolution adds block_size as the final fallback so the
+            #: cache works on engines that never enabled stall-free admission
+            self._radix_chunk = self.admit_chunk or (cfg.prefill_chunk or 0) or block_size
+            # a hit's suffix is chunk-aligned from an arbitrary (non-aligned)
+            # start, which can reach one chunk past the cold path's widest
+            # aligned write — size the rows for it
+            aligned = max(
+                chunk_aligned(b, self._radix_chunk) for b in (cfg.prompt_buckets or (widest,))
+            )
+            self.cache_len = max(self.cache_len, p0 + aligned + self._radix_chunk)
+        else:
+            self._radix_chunk = 0
         #: paged-KV mode (block_size set): a host-side allocator hands pool
         #: blocks to admissions; block index ``pool_blocks`` is the SCRATCH
         #: block — unused/finished table entries point there, so their
@@ -450,6 +531,23 @@ class ContinuousBatcher:
                 self._shared_prefix_blocks = [self._free_blocks.pop(0) for _ in range(n_shared)]
         elif pool_blocks is not None:
             raise ValueError("pool_blocks requires block_size (paged mode)")
+        #: the radix tree over paged blocks; None = prefix caching off (every
+        #: radix code path below is gated on this, so the off-mode engine is
+        #: byte-for-byte the historical one)
+        self._radix: Optional[RadixPrefixCache] = None
+        if enable_radix:
+            self._radix = RadixPrefixCache(block_size)
+            if self._shared_prefix_blocks:
+                # the static shared prefix is the tree's permanently pinned
+                # root run — matches walk through it, and the first admission
+                # caches its partial tail block (plus the prompt) on top
+                self._radix.insert(
+                    list(self.prefix.tokens)[: len(self._shared_prefix_blocks) * block_size],
+                    list(self._shared_prefix_blocks),
+                )
+                self._radix.pin(self._shared_prefix_blocks)
+            #: one compile: the dense-row gather at the engine's fixed width
+            self._gather_fn = jax.jit(gather_paged_rows, static_argnums=(2,))
         self._lock = threading.Condition()
         self._pending: "List[tuple]" = []  # (prompt, session) awaiting a free slot
         self._admissions: "List[_Admission]" = []  # slot-holding, prefill in flight
@@ -464,9 +562,9 @@ class ContinuousBatcher:
         # any output shape, so donating them would just trigger warnings
         self._admit_fn = jax.jit(self._admit_impl, donate_argnums=(0,))
         self._spec_admit_fn = jax.jit(self._spec_admit_impl, donate_argnums=(0, 1, 2))
-        self._paged_admit_fn = jax.jit(self._paged_admit_impl, donate_argnums=(0,), static_argnums=(9,))
+        self._paged_admit_fn = jax.jit(self._paged_admit_impl, donate_argnums=(0,))
         self._paged_spec_admit_fn = jax.jit(
-            self._paged_spec_admit_impl, donate_argnums=(0, 1, 2), static_argnums=(15,)
+            self._paged_spec_admit_impl, donate_argnums=(0, 1, 2)
         )
         #: dispatch/utilization counters for benchmarks and /metrics
         self.decode_dispatches = 0
@@ -484,6 +582,14 @@ class ContinuousBatcher:
         #: token-weighted load normalizer: one admit chunk (or one widest
         #: bucket) of queued prefill counts as one unit of scheduling load
         self._load_norm = float(self.admit_chunk or widest)
+        #: prefix-cache telemetry (all zero and absent from stats() when the
+        #: cache is off): admissions served partly from cache vs not, prompt
+        #: tokens whose prefill was skipped, and partially shared tail blocks
+        #: copied on write
+        self.prefix_cache_hits = 0
+        self.prefix_cache_misses = 0
+        self.prefix_cache_tokens_avoided = 0
+        self.prefix_cache_cow = 0
         #: overload counters: waiting-queue-full sheds and deadline sheds
         self.shed_queue_full = 0
         self.shed_deadline = 0
@@ -520,19 +626,20 @@ class ContinuousBatcher:
         every layer and scatter the dense ``[1, cache_len]`` prefilled row into
         those blocks. ``blocks_row`` ([max_blocks] int32) is scratch-padded past
         the request's allocation, so the dense row's unused tail lands in the
-        scratch block, never in another request's pages. ``skip`` (static)
-        diverts the first ``skip`` blocks' writes to scratch: those table
-        entries are SHARED prefix pages, already seeded once — the row's copy of
-        the prefix is identical, but re-writing shared pages per admission is
-        wasted bandwidth."""
+        scratch block, never in another request's pages. ``skip`` (traced, so
+        per-request cached-run lengths don't multiply compiles) diverts the
+        first ``skip`` blocks' writes to scratch: those table entries are
+        SHARED pages — the static prefix's, or radix-cached runs another
+        request already wrote — whose content the row duplicates exactly, so
+        re-writing them per admission would be wasted bandwidth (and, for
+        tree-owned pages, a data race against their other readers)."""
         block_size = cache[0]["k"].shape[2]  # pools are heads-major [H_kv, NB, bs, last]
         scratch = cache[0]["k"].shape[1] - 1  # scratch is the last pool block
         new_layers = []
         for layer, row in zip(cache, row_cache):
             pos = jnp.arange(row["k"].shape[1])  # the dense row is [1, cache_len, H, last]
             blk, off = blocks_row[pos // block_size], pos % block_size
-            if skip:
-                blk = jnp.where(pos < skip * block_size, scratch, blk)
+            blk = jnp.where(pos < skip * block_size, scratch, blk)
             new_layer = {"table": jax.lax.dynamic_update_slice(layer["table"], blocks_row[None], (slot, 0))}
             for name in row:
                 new_layer[name] = layer[name].at[:, blk, off].set(
@@ -770,16 +877,24 @@ class ContinuousBatcher:
             )
         return tok0, lengths, row_cache
 
-    def _blocks_for_tokens(self, tokens: int) -> int:
+    def _table_entries(self, tokens: int) -> int:
+        """Block-table entries covering positions ``[0, tokens)``."""
+        return -(-tokens // self.block_size)
+
+    def _blocks_for_tokens(self, tokens: int, shared: Optional[int] = None) -> int:
         """Private (non-shared) blocks covering positions ``[0, tokens)``.
         Only real, still-visible positions need real blocks: the prefill
         scatter also writes the prompt bucket's pad columns, but those are
         hidden by the ``slot <= position`` mask until decode overwrites them in
         order, so they can land in the scratch block. Blocks covering the
-        SHARED prefix pages are excluded — every slot reads the same ids."""
-        return max(0, -(-tokens // self.block_size) - len(self._shared_prefix_blocks))
+        ``shared`` leading table entries are excluded — the static prefix
+        pages every slot reads, plus (radix mode) this request's matched
+        cached runs."""
+        if shared is None:
+            shared = len(self._shared_prefix_blocks)
+        return max(0, self._table_entries(tokens) - shared)
 
-    def _blocks_initial(self, prompt: Sequence[int], budget: int) -> int:
+    def _blocks_initial(self, prompt: Sequence[int], budget: int, shared: Optional[int] = None) -> int:
         """Blocks an ADMISSION needs — the same target the first
         :meth:`_ensure_capacity_locked` pass will demand (prompt + one chunk of
         lookahead, capped at the request's remaining budget), so a fresh
@@ -793,7 +908,7 @@ class ContinuousBatcher:
             p0 + plen + self.decode_chunk + self._overshoot,
             p0 + plen + budget - 1 + self._overshoot,
         )
-        return self._blocks_for_tokens(tokens)
+        return self._blocks_for_tokens(tokens, shared)
 
     def _blocks_lifetime(self, prompt: Sequence[int], budget: int) -> int:
         """Worst-case blocks over a request's whole life (prompt + its budget +
@@ -906,7 +1021,7 @@ class ContinuousBatcher:
             if self._sessions.get(session.slot) is session:
                 self._sessions.pop(session.slot)
                 self._free.append(session.slot)
-                self._release_blocks_locked(session.slot)
+                self._release_blocks_locked(session.slot, session)
                 self._mask_slot_done(session.slot)
 
     def warmup(self) -> None:
@@ -951,6 +1066,10 @@ class ContinuousBatcher:
             self.prefill_chunks = 0
             self.prefill_chunk_tokens = 0
             self.prefill_monolithic = 0
+            if self._radix is not None:
+                # drop the junk prefixes the probe prompts cached (and their
+                # hit/miss counts): real traffic must start from a clean tree
+                self._radix_reset_locked()
             self._ttft.clear()  # warmup probes must not skew the percentiles
             self._tbt.clear()
             self._grammar_counts.clear()  # warmup probes all ride FREE (id 0)
@@ -971,6 +1090,20 @@ class ContinuousBatcher:
             waiting += sum(1 for a in self._admissions if not a.session.finished)
             return len(self._sessions), waiting
 
+    @staticmethod
+    def _admission_backlog(adm: _Admission) -> int:
+        """Prefill tokens an in-flight admission still owes: the unchunked
+        remainder once stepping started, else the prompt minus its radix-
+        cached run (``adm.start`` still holds the static prefix length before
+        :meth:`_admission_begin` runs) — a cache hit is backlog the scheduler
+        must not route around."""
+        if adm.tokens is not None:
+            return max(adm.width - adm.pos, 0)
+        remaining = max(len(adm.prompt), 1)
+        if adm.cached:
+            remaining = max(remaining - max(adm.cached - adm.start, 0), 1)
+        return remaining
+
     def queued_prefill_tokens(self) -> int:
         """Prompt tokens standing between arrivals and their first token: live
         waiting prompts plus the un-prefilled remainder of in-flight
@@ -980,12 +1113,8 @@ class ContinuousBatcher:
         with self._lock:
             backlog = sum(len(p) for p, s in self._pending if not s.finished)
             for adm in self._admissions:
-                if adm.session.finished:
-                    continue
-                if adm.tokens is not None:
-                    backlog += max(adm.width - adm.pos, 0)
-                else:
-                    backlog += max(len(adm.prompt), 1)
+                if not adm.session.finished:
+                    backlog += self._admission_backlog(adm)
             return backlog
 
     def load(self) -> float:
@@ -1004,11 +1133,7 @@ class ContinuousBatcher:
             backlog = sum(len(p) for p, s in self._pending if not s.finished)
             for adm in self._admissions:
                 if not adm.session.finished:
-                    backlog += (
-                        max(adm.width - adm.pos, 0)
-                        if adm.tokens is not None
-                        else max(len(adm.prompt), 1)
-                    )
+                    backlog += self._admission_backlog(adm)
             snapshot: Dict[str, Any] = {
                 "slots": self.slots,
                 "resident": len(self._sessions),
@@ -1048,6 +1173,32 @@ class ContinuousBatcher:
                     "shared_prefix": len(self._shared_prefix_blocks),
                     "block_size": self.block_size,
                     "preemptions": self.preemptions,
+                }
+                if self.prefix is not None:
+                    # the static prefix's partial tail block is NOT among the
+                    # seeded shared pages — each admission re-scatters those
+                    # tokens into a private block (the radix cache, when on,
+                    # caches the tail like any other run); surface the count
+                    # so a misaligned prefix/block_size choice is visible
+                    snapshot["kv_blocks"]["shared_prefix_tail_tokens"] = (
+                        self.prefix.length - len(self._shared_prefix_blocks) * self.block_size
+                    )
+            if self._radix is not None:
+                # radix prefix cache: admission-level hit/miss counters, the
+                # prompt tokens whose prefill the cache skipped, and the
+                # tree's structural gauges — every value an int, never None
+                # (the /metrics no-None-gauge contract)
+                snapshot["prefix_cache"] = {
+                    "hits": self.prefix_cache_hits,
+                    "misses": self.prefix_cache_misses,
+                    "tokens_avoided": self.prefix_cache_tokens_avoided,
+                    "cow_copies": self.prefix_cache_cow,
+                    "evictions": self._radix.evictions,
+                    "evicted_blocks": self._radix.evicted_blocks,
+                    "cached_blocks": self._radix.cached_blocks(),
+                    "cached_tokens": self._radix.cached_tokens(),
+                    "pinned_blocks": self._radix.pinned_blocks(),
+                    "nodes": self._radix.nodes(),
                 }
             if self._spec is not None and self._spec.rounds:
                 snapshot["acceptance_rate"] = round(
@@ -1216,41 +1367,70 @@ class ContinuousBatcher:
             limit = self.max_admissions if self.admit_chunk else 1
             while self._pending and self._free and len(self._admissions) < limit:
                 blocks_row = None
+                gather_row = None
+                cached = 0
+                pins: "List[int]" = []
+                p0 = self.prefix.length if self.prefix is not None else 0
                 if self.block_size is not None:
                     head_prompt, head_session = self._pending[0]
-                    needed = self._blocks_initial(
-                        head_prompt, head_session.max_new - head_session.produced
-                    )
-                    shared = self._shared_prefix_blocks
-                    lifetime = self._blocks_lifetime(
-                        head_prompt, head_session.max_new - head_session.produced
-                    )
-                    if len(shared) + lifetime > self.max_blocks:
+                    head_budget = head_session.max_new - head_session.produced
+                    lifetime = self._blocks_lifetime(head_prompt, head_budget)
+                    if len(self._shared_prefix_blocks) + lifetime > self.max_blocks:
                         # an oversized prompt can never fit a table row: fail its
                         # stream now instead of wedging the FIFO head forever
                         prompt, session = self._pending.pop(0)
                         if not session.finished:
                             session.finished = True
                             session.out.put(ValueError(
-                                f"prompt needs {len(shared) + lifetime} KV blocks but a slot's "
-                                f"table holds {self.max_blocks}"
+                                f"prompt needs {len(self._shared_prefix_blocks) + lifetime} KV "
+                                f"blocks but a slot's table holds {self.max_blocks}"
                             ))
                         continue
+                    # seeded leading table entries: the static prefix's full
+                    # blocks, or (on a radix hit) the matched cached run
+                    seeded = list(self._shared_prefix_blocks)
+                    if self._radix is not None:
+                        total = p0 + max(len(head_prompt), 1)
+                        # cap at total - 1: the last prompt token always
+                        # prefills so the first sampled token has its hidden
+                        # state (and stays bit-identical to a cold prefill)
+                        m, mblocks = self._radix.match(self._radix_key(head_prompt))
+                        m = min(m, total - 1)
+                        if m > p0:
+                            cached = m
+                            mblocks = mblocks[: -(-m // self.block_size)]
+                            seeded = mblocks[: m // self.block_size]
+                            # pin every matched block (the partial tail too —
+                            # the gather reads it) until this stream releases
+                            pins = list(mblocks)
+                            self._radix.pin(pins)
+                    needed = self._blocks_initial(head_prompt, head_budget, shared=len(seeded))
                     if needed > len(self._free_blocks):
+                        # pool pressure: cached-but-idle prefixes are exactly
+                        # the memory the next admission may take back
+                        self._reclaim_blocks_locked(needed - len(self._free_blocks))
+                    if needed > len(self._free_blocks):
+                        if pins:
+                            self._radix.release(pins)
                         return
                 prompt, session = self._pending.pop(0)
                 slot = self._free.pop(0)
                 session.slot = slot
                 session.admit_seq = self._admit_counter
                 self._admit_counter += 1
-                p0 = self.prefix.length if self.prefix is not None else 0
                 session.row_start = p0 + max(len(prompt), 1)
                 if self.block_size is not None:
                     alloc = [self._free_blocks.pop(0) for _ in range(needed)]
                     self._slot_blocks[slot] = alloc
+                    session.shared_blocks = len(seeded)
+                    session.table_len = len(seeded) + len(alloc)
+                    session.pins = pins
                     blocks_row = np.full((self.max_blocks,), self._scratch_block, np.int32)
-                    blocks_row[: len(shared)] = shared
-                    blocks_row[len(shared) : len(shared) + len(alloc)] = alloc
+                    blocks_row[: len(seeded)] = seeded
+                    blocks_row[len(seeded) : len(seeded) + len(alloc)] = alloc
+                    if cached:
+                        gather_row = np.full((self.max_blocks,), self._scratch_block, np.int32)
+                        gather_row[: len(pins)] = pins
                 self._seed += 1
                 now = time.monotonic()
                 _tev(
@@ -1266,6 +1446,8 @@ class ContinuousBatcher:
                     blocks_row=blocks_row,
                     started_at=now,
                     start=p0,
+                    cached=cached,
+                    gather_row=gather_row,
                 ))
 
     def _admission_alive(self, adm: _Admission) -> bool:
@@ -1287,7 +1469,7 @@ class ContinuousBatcher:
                 if adm in self._admissions:
                     self._admissions.remove(adm)
                 self._free.append(adm.slot)
-                self._release_blocks_locked(adm.slot)
+                self._release_blocks_locked(adm.slot, session)
                 return False
             return True
 
@@ -1298,7 +1480,7 @@ class ContinuousBatcher:
             if adm in self._admissions:
                 self._admissions.remove(adm)
             self._free.append(adm.slot)
-            self._release_blocks_locked(adm.slot)
+            self._release_blocks_locked(adm.slot, adm.session)
             if not adm.session.finished:
                 adm.session.finished = True
                 adm.session.out.put(exc)
@@ -1328,6 +1510,11 @@ class ContinuousBatcher:
         adm.dfa_state = dfa_state
         adm.cstate = () if dfa_state is None else (jnp.asarray([dfa_state], jnp.int32),)
         p0 = self.prefix.length if self.prefix is not None else 0
+        if adm.gather_row is not None and self._begin_cached(adm):
+            return 0
+        if self._radix is not None:
+            with self._lock:
+                self.prefix_cache_misses += 1
         bucket = gen._bucket(max(len(prompt), 1))
         if p0 + bucket + adm.budget > self.cache_len:
             # a PREEMPTED request resumes as prompt + emitted tokens, which
@@ -1398,6 +1585,60 @@ class ContinuousBatcher:
             adm.d_row_cache = d_row
         return 0
 
+    def _begin_cached(self, adm: _Admission) -> bool:
+        """Set up a radix-cache-HIT admission: gather the matched blocks into
+        a dense row and arrange chunked prefill of only the uncached suffix,
+        starting at the first uncached token (an arbitrary, possibly
+        non-block-aligned offset — the chunk program's ``start`` is traced, so
+        this stays one compile). The gathered K/V is bit-identical to what a
+        cold prefill would write at those positions (it WAS written by one),
+        so the stream's tokens equal its cold-prefill run exactly. Returns
+        False to fall back to the cold path when the suffix geometry would
+        overflow the row (exact-width preemption resumes) — the admission then
+        prefills everything but still shares the matched blocks via its
+        table."""
+        gen, cfg = self.gen, self.gen.config
+        session = adm.session
+        p0 = self.prefix.length if self.prefix is not None else 0
+        total = p0 + max(len(adm.prompt), 1)
+        start = adm.cached  # > p0 by the hit condition
+        chunk = self._radix_chunk
+        suffix = list(adm.prompt)[start - p0 :]
+        width = chunk_aligned(len(suffix), chunk)
+        if start + width > self.cache_len or self._carry is None:
+            # (a tree hit implies a prior finalize built the carry; the None
+            # check is a pure backstop)
+            return False
+        # the dense row materializes FROM the cached pool blocks — the exact
+        # inverse of the admission scatter, one fused gather dispatch; stale
+        # positions past the cached run are overwritten by the suffix prefill
+        # before anything can attend to them
+        adm.row_cache = self._gather_fn(
+            self._carry[0], jnp.asarray(adm.gather_row), self.cache_len
+        )
+        tokens = np.full((1, width), cfg.pad_id, np.int32)
+        tokens[0, : len(suffix)] = np.asarray(suffix, np.int32)
+        adm.tokens = tokens
+        adm.chunk, adm.width = chunk, width
+        adm.start = start
+        adm.pos = 0
+        adm.lengths = jnp.asarray([total], jnp.int32)
+        # same key derivation as the cold paths: the first sampled token is
+        # bit-identical to a cold (chunked or monolithic) admission's
+        adm.key = jax.random.fold_in(jax.random.PRNGKey(adm.seed), adm.seed)
+        adm.row_valid = jnp.ones((1,), bool)
+        adm.last = jnp.zeros((1, gen.module.config.dim), jnp.float32)
+        with self._lock:
+            self.prefix_cache_hits += 1
+            self.prefix_cache_tokens_avoided += start - p0
+            if start % self.block_size:
+                # the partially shared tail block: its matched prefix was
+                # gathered into the row and will scatter back into THIS
+                # request's private block — copy-on-write via the row
+                self.prefix_cache_cow += 1
+        _tev(session, "prefill.cache_hit", tokens=start - p0, cached=start)
+        return True
+
     def _admission_step(self, adm: _Admission) -> int:
         """Advance one admission's prefill by one unit (engine thread; device
         work runs unlocked). Monolithic admissions complete inside
@@ -1461,7 +1702,7 @@ class ContinuousBatcher:
                 if blocks_row is not None:
                     cache, tok, lengths, done = self._paged_admit_fn(
                         cache, adm.row_cache, tok, lengths, done, jnp.int32(slot), adm.tok0,
-                        adm.row_len, jnp.asarray(blocks_row), len(self._shared_prefix_blocks),
+                        adm.row_len, jnp.asarray(blocks_row), jnp.int32(session.shared_blocks),
                     )
                 else:
                     cache, tok, lengths, done = self._admit_fn(
@@ -1476,7 +1717,7 @@ class ContinuousBatcher:
                         t_cache, d_cache, out_buf, adm.row_cache, adm.d_row_cache, tok, lengths,
                         done, produced, jnp.int32(slot), adm.tok0, adm.row_len,
                         jnp.asarray([start_done]), jnp.int32(cfg.pad_id),
-                        jnp.asarray(blocks_row), len(self._shared_prefix_blocks),
+                        jnp.asarray(blocks_row), jnp.int32(session.shared_blocks),
                     )
                 else:
                     t_cache, d_cache, out_buf, tok, lengths, done, produced = self._spec_admit_fn(
@@ -1507,13 +1748,19 @@ class ContinuousBatcher:
         with self._lock:
             if adm in self._admissions:
                 self._admissions.remove(adm)
+            if self._radix is not None and adm.blocks_row is not None:
+                # the prompt's full blocks now hold exactly the K/V a cold
+                # prefill writes — publish them for every later request that
+                # shares the prefix (even a cancelled stream's prefill work is
+                # a free cache fill)
+                self._radix_insert_locked(adm, session)
             if session.finished:
                 # cancelled during the unlocked prefill/paste window (neither
                 # pending nor resident at _cancel time): the device row was
                 # just activated — mask it back out and return the slot
                 # instead of decoding a full budget to a dead queue
                 self._free.append(slot)
-                self._release_blocks_locked(slot)
+                self._release_blocks_locked(slot, session)
                 self._mask_slot_done(slot)
                 return
             session.out.put(first)
@@ -1563,10 +1810,96 @@ class ContinuousBatcher:
                 )
         self._carry = tuple(state)
 
-    def _release_blocks_locked(self, slot: int) -> None:
-        """Return a slot's pool blocks to the allocator (caller holds the lock)."""
+    def _release_blocks_locked(self, slot: int, session: Optional[_Session] = None) -> None:
+        """Return a slot's PRIVATE pool blocks to the allocator and release the
+        session's radix pins (caller holds the lock). Tree-owned blocks the
+        session's table referenced stay cached — unpinning merely makes them
+        evictable again."""
         if self.block_size is not None:
             self._free_blocks.extend(self._slot_blocks.pop(slot, []))
+        if session is not None and session.pins:
+            self._radix.release(session.pins)
+            session.pins = []
+
+    def _reclaim_blocks_locked(self, n: int) -> None:
+        """Evict least-recently-used unpinned radix runs until ``n`` more
+        blocks are free (or nothing evictable remains); freed ids rejoin
+        ``_free_blocks``, so cache pressure resolves before admission blocks
+        and long before preemption fires (caller holds the lock)."""
+        if self._radix is None or n <= 0:
+            return
+        self._free_blocks.extend(self._radix.evict(n))
+
+    def _radix_insert_locked(self, adm: _Admission, session: _Session) -> None:
+        """Publish a completed admission's full-token blocks into the radix
+        tree (caller holds the lock). Only blocks every position of which holds
+        a REAL token's K/V are insertable — the partial tail block (prompt tail
+        + upcoming decode writes) stays private. Ownership of the transferred
+        blocks moves to the tree; the session keeps them pinned (its table
+        still reads them) until release."""
+        p0 = self.prefix.length if self.prefix is not None else 0
+        total = p0 + max(len(adm.prompt), 1)
+        full = total // self.block_size  # table entries fully covered by real tokens
+        shared = session.shared_blocks
+        if full <= shared:
+            return
+        key = self._radix_key(adm.prompt)[: full * self.block_size]
+        entry_ids = [int(b) for b in adm.blocks_row[:full]]
+        kept = self._radix.insert(key, entry_ids)
+        # a concurrent admission may have inserted a longer run first (kept >
+        # shared): entries [shared, kept) keep their private duplicates and
+        # the tree's copy wins for future matches
+        lo, hi = max(kept, shared) - shared, full - shared
+        if lo >= hi:
+            return
+        alloc = self._slot_blocks.get(adm.slot, [])
+        transferred = alloc[lo:hi]
+        self._slot_blocks[adm.slot] = alloc[:lo] + alloc[hi:]
+        self._radix.pin(transferred)
+        session.pins.extend(transferred)
+
+    def _radix_reset_locked(self) -> None:
+        """Drop every cached run and zero the cache counters (caller holds the
+        lock; no streams may be live): warmup's junk probes must not leave
+        junk prefixes cached — or hit/miss counters skewed — when real traffic
+        starts. The static shared-prefix blocks are re-seeded as the tree's
+        permanent root run."""
+        static = set(self._shared_prefix_blocks)
+        self._free_blocks.extend(b for b in self._radix.clear() if b not in static)
+        self._radix.evictions = 0
+        self._radix.evicted_blocks = 0
+        if self._shared_prefix_blocks:
+            self._radix.insert(
+                list(self.prefix.tokens)[: len(self._shared_prefix_blocks) * self.block_size],
+                list(self._shared_prefix_blocks),
+            )
+        self.prefix_cache_hits = 0
+        self.prefix_cache_misses = 0
+        self.prefix_cache_tokens_avoided = 0
+        self.prefix_cache_cow = 0
+
+    def _radix_key(self, prompt: Sequence[int]) -> "List[int]":
+        """The radix key of a prompt: the full LOGICAL token sequence — static
+        shared prefix (whose tokens the cache constructor required) followed by
+        the prompt — so cached runs compose with the configured prefix and the
+        prefix's partial tail block is cacheable like any other run."""
+        key = list(self.prefix.tokens) if self.prefix is not None else []
+        key.extend(int(t) for t in prompt)
+        return key
+
+    def cached_prefix_tokens(self, prompt: Sequence[int]) -> int:
+        """Prompt tokens this engine could serve from its radix cache right
+        now (0 when prefix caching is off) — beyond the static shared prefix,
+        which every replica holds. The replica scheduler routes shared-prefix
+        traffic on this actual per-replica number instead of guessing from a
+        routing-history LRU."""
+        if self._radix is None:
+            return 0
+        p0 = self.prefix.length if self.prefix is not None else 0
+        total = p0 + max(len(prompt), 1)
+        with self._lock:
+            m = self._radix.match_len(self._radix_key(prompt))
+        return max(0, min(m, total - 1) - p0)
 
     def _extend_tables(self, slot: int, start_idx: int, ids: "List[int]") -> None:
         """Append freshly allocated block ids to a resident slot's table row in
@@ -1593,7 +1926,7 @@ class ContinuousBatcher:
         self.preemptions += 1
         _tev(session, "engine.preempt", produced=session.produced)
         self._free.append(slot)
-        self._release_blocks_locked(slot)
+        self._release_blocks_locked(slot, session)
         self._mask_slot_done(slot)
         session.slot = -1
         if not session.finished:
@@ -1621,16 +1954,23 @@ class ContinuousBatcher:
                     session.row_start + max(produced_res - 1, 0) + self.decode_chunk + self._overshoot,
                     session.row_start + (session.max_new - session.resident_base) - 1 + self._overshoot,
                 )
-                target = self._blocks_for_tokens(tokens)
-                have = len(self._slot_blocks.get(slot, ()))
-                if target > have:
-                    deficits[slot] = target - have
-            if sum(deficits.values()) <= len(self._free_blocks):
+                # growth is measured against the table cursor, not the private
+                # list: radix-transferred entries stay in the table after their
+                # ownership moved to the tree
+                target = self._table_entries(tokens)
+                if target > session.table_len:
+                    deficits[slot] = target - session.table_len
+            need = sum(deficits.values())
+            if need > len(self._free_blocks):
+                # evict idle cached runs before preempting live residents
+                self._reclaim_blocks_locked(need - len(self._free_blocks))
+            if need <= len(self._free_blocks):
                 for slot, extra in deficits.items():
+                    session = self._sessions[slot]
                     alloc = [self._free_blocks.pop(0) for _ in range(extra)]
-                    start_idx = len(self._shared_prefix_blocks) + len(self._slot_blocks[slot])
                     self._slot_blocks[slot].extend(alloc)
-                    self._extend_tables(slot, start_idx, alloc)
+                    self._extend_tables(slot, session.table_len, alloc)
+                    session.table_len += extra
                 return
             victim = max(self._sessions, key=lambda s: self._sessions[s].admit_seq)
             self._preempt_locked(victim)
@@ -1640,7 +1980,7 @@ class ContinuousBatcher:
         session.finished = True
         _tev(session, "engine.finish", produced=session.produced)
         self._free.append(slot)
-        self._release_blocks_locked(slot)
+        self._release_blocks_locked(slot, session)
         if not device_done or self.block_size is not None:
             # finished without the device knowing (budget exhausted, or the
             # prompt-sampled token was eos): mask the row out of future chunks.

@@ -22,11 +22,16 @@ Design:
   replica's live residents plus live waiters PLUS its pending prefill
   backlog in tokens (``ContinuousBatcher.load()``'s token weighting), so two
   replicas with equal waiter counts but a 10k-token vs a 10-token queued
-  prompt do not tie — with optional prefix-affinity routing so shared-prefix
-  requests land on the replica whose KV pool already holds that prefix. The
-  affinity margin check and the hotspot fallback rank on the SAME
-  token-weighted loads, so a fallback never lands on a replica with a deep
-  prefill backlog that mere waiter counts would hide.
+  prompt do not tie — with prefix-affinity routing so shared-prefix
+  requests land on the replica whose KV pool already holds that prefix. With
+  per-engine radix prefix caches on (``prefix_cache=True``), affinity routes
+  on each replica's ACTUAL cached-prefix length for the prompt (the radix
+  probe ``cached_prefix_tokens``) — the scheduler is the cross-replica tier
+  of the same cache; without them the bounded-LRU token-key heuristic
+  (``affinity_tokens``) remains the fallback. The affinity margin check and
+  the hotspot fallback rank on the SAME token-weighted loads, so a fallback
+  never lands on a replica with a deep prefill backlog that mere waiter
+  counts would hide.
 
 Overload posture composes with PR 1's machinery: an expired deadline sheds
 before routing (:class:`DeadlineExceeded`, HTTP 503), and a prompt is shed
@@ -156,13 +161,31 @@ class ReplicaScheduler:
             return None  # shorter than the affinity window: nothing shared to exploit
         return tuple(int(t) for t in prompt[: self.affinity_tokens])
 
-    def order(self, loads: Sequence[int], prompt: Optional[Sequence[int]] = None) -> "Tuple[List[int], bool]":
+    def order(
+        self,
+        loads: Sequence[int],
+        prompt: Optional[Sequence[int]] = None,
+        cached: Optional[Sequence[int]] = None,
+    ) -> "Tuple[List[int], bool]":
         """``(indices to try best-first, head_is_affinity)``. The caller walks
         the list so a full (QueueFullError) replica falls through to the
         next-least-loaded instead of shedding work the rest of the fleet could
-        take; the flag marks whether the head came from the affinity map (for
-        hit accounting) rather than pure load order."""
+        take; the flag marks whether the head came from affinity routing (for
+        hit accounting) rather than pure load order.
+
+        ``cached`` — per-replica ACTUAL cached-prefix token counts (each
+        engine's ``cached_prefix_tokens(prompt)`` radix probe) — takes
+        precedence over the token-key LRU heuristic: the replica whose KV pool
+        already holds the longest run of this prompt is preferred, unless it
+        is more than ``affinity_margin`` load units busier than the least
+        loaded (the same hotspot guard). The LRU map remains the fallback for
+        engines without a prefix cache."""
         ranked = sorted(range(len(loads)), key=lambda i: (loads[i], i))
+        if cached is not None and len(cached) == len(loads) and max(cached, default=0) > 0:
+            preferred = min(range(len(loads)), key=lambda i: (-cached[i], loads[i], i))
+            if loads[preferred] <= loads[ranked[0]] + self.affinity_margin:
+                return [preferred] + [i for i in ranked if i != preferred], True
+            return ranked, False
         key = self._key(prompt)
         if key is not None:
             with self._lock:
@@ -212,7 +235,8 @@ class ReplicaSet:
     drain — composes with a replica set unchanged. Engine knobs (``slots``,
     ``decode_chunk``, ``block_size``, ``pool_blocks``, ``max_waiting``,
     ``admit_chunk``/``prefill_budget``/``max_admissions`` — stall-free
-    admission, see serving/continuous.py — and ``prefix``) apply PER REPLICA; a shared ``prefix`` (token ids or a
+    admission — ``prefix_cache`` — the radix prefix cache, see
+    serving/continuous.py — and ``prefix``) apply PER REPLICA; a shared ``prefix`` (token ids or a
     ``PrefixCache`` built with ``cache_prefix``) is prefilled once per replica
     at construction, since cache rows cannot cross submeshes.
     """
@@ -234,6 +258,7 @@ class ReplicaSet:
         affinity_tokens: int = 0,
         affinity_margin: int = 2,
         trace: Optional[bool] = None,
+        prefix_cache: Optional[bool] = None,
     ):
         if (generators is None) == (engines is None):
             raise ValueError("pass exactly one of generators= or engines=")
@@ -257,6 +282,7 @@ class ReplicaSet:
                             prefill_budget=prefill_budget,
                             max_admissions=max_admissions,
                             trace=trace,
+                            prefix_cache=prefix_cache,
                         )
                     )
             except BaseException:
@@ -416,7 +442,15 @@ class ReplicaSet:
                 req_trace.event("engine.shed_deadline", phase="routing")
             raise DeadlineExceeded("deadline expired before the prompt was routed to a replica")
         loads = [batcher.load() for batcher in self._batchers]
-        order, affinity_head = self._scheduler.order(loads, prompt)
+        # actual per-replica cached-prefix lengths (the radix-tree probe) when
+        # any engine runs a prefix cache; None keeps the LRU token-key fallback
+        cached = None
+        if any(getattr(b, "_radix", None) is not None for b in self._batchers):
+            cached = [
+                int(getattr(b, "cached_prefix_tokens", lambda _p: 0)(prompt))
+                for b in self._batchers
+            ]
+        order, affinity_head = self._scheduler.order(loads, prompt, cached)
         last_exc: Optional[QueueFullError] = None
         for replica in order:
             if req_trace is not None:
@@ -516,6 +550,23 @@ class ReplicaSet:
             # percentiles stay under per_replica — percentiles don't sum)
             "prefill_chunks": total_prefill("chunks"),
             "prefill_backlog_tokens": total_prefill("backlog_tokens"),
+            # fleet-wide radix prefix-cache totals (present only when at least
+            # one replica runs the cache, so cache-off fleets keep today's
+            # stats byte-for-byte; per-replica detail stays under per_replica)
+            **(
+                {
+                    "prefix_cache": {
+                        key: sum(
+                            int((entry.get("prefix_cache") or {}).get(key) or 0)
+                            for entry in per_replica
+                        )
+                        for key in ("hits", "misses", "tokens_avoided", "evictions",
+                                    "cow_copies", "cached_blocks", "pinned_blocks")
+                    }
+                }
+                if any("prefix_cache" in entry for entry in per_replica)
+                else {}
+            ),
             # fleet-level sheds (all replicas full / expired before routing) on
             # top of each engine's own counters
             "shed_queue_full": shed_queue_full + total("shed_queue_full"),
