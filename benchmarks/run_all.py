@@ -40,6 +40,7 @@ SCRIPTS = {
     "continuous": "bench_continuous.py",
     "continuous_stall": "bench_continuous.py",
     "prefix_cache": "bench_prefix_cache.py",
+    "quantized_serving": "bench_quantized_serving.py",
     "replica_serving": "bench_replica_serving.py",
     "observability": "bench_observability.py",
     "lint": "bench_lint.py",
@@ -68,10 +69,12 @@ if _cpu_extra - set(SCRIPTS):
 #: of two same-substrate runs, meaningful on the host CPU; prefix_cache pins
 #: the warm/cold TTFT ratio and tokens-avoided through one warm engine the
 #: same way; observability likewise pins the tracing on/off throughput ratio
-#: (host-side per-token bookkeeping, not chip throughput)
+#: (host-side per-token bookkeeping, not chip throughput); quantized_serving
+#: pins the int8-vs-bf16 resident-stream capacity ratio at a fixed KV-pool
+#: byte budget — a memory/scheduling property, same-substrate by construction
 CPU_ONLY = {
     "digits", "serving", "replica_serving", "continuous_stall", "prefix_cache",
-    "observability", "lint",
+    "quantized_serving", "observability", "lint",
 } | _cpu_extra
 
 #: per-lane env overrides: lanes that reuse a script in a different mode

@@ -1,0 +1,129 @@
+"""Quantized serving token-identity on the emulated 8-device mesh.
+
+Oracle: int8 weights + an int8 paged KV pool must be invisible to the serving
+topology — a tp=2 engine's warm (radix-cache-hit) streams equal its cold
+first-visit streams AND a single-device quantized sequential ``Generator`` run
+(greedy, f32 compute), the scale planes riding through the sharded
+gather/scatter. The dp=2 x tp=2 leg pins the delegation path the replica layer
+used to reject: ``ContinuousBatcher`` over a quantized dp-mesh Generator
+transparently builds a ``ReplicaSet`` whose per-replica re-quantization is an
+exact round trip.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from unionml_tpu.models import GenerationConfig, Generator, Llama, LlamaConfig, llama_partition_rules
+from unionml_tpu.ops.quant import QuantizedTensor
+from unionml_tpu.parallel import MeshSpec
+from unionml_tpu.serving import ContinuousBatcher, ReplicaSet
+
+pytestmark = pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 emulated devices")
+
+SYSTEM = [7, 7, 3, 9, 1, 2, 5, 11, 4, 8, 6, 10, 12, 3, 2, 9, 5, 1]  # 18 shared tokens
+PROMPTS = [SYSTEM + tail for tail in ([30, 31], [30, 32, 33], [40], [30, 31, 35, 36])]
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    # hidden_dim 1024: the MLP kernels cross quantize_params' min_size, so
+    # quantize="int8" genuinely serves int8 weights on every leg below
+    config = LlamaConfig.tiny(
+        vocab_size=96, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, hidden_dim=1024,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    module = Llama(config)
+    params = module.init(jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32))["params"]
+    return module, params
+
+
+def _cfg(**overrides):
+    base = dict(
+        max_new_tokens=8, temperature=0.0, prompt_buckets=(32,), kv_cache_dtype="int8"
+    )
+    base.update(overrides)
+    return GenerationConfig(**base)
+
+
+def _expected(module, params, prompts, cfg=None):
+    gen = Generator(module, params, cfg or _cfg(), quantize="int8")
+    return [list(gen([p])[0]) for p in prompts]
+
+
+def _drain(stream):
+    return [int(t) for chunk in stream for t in np.asarray(chunk).ravel()]
+
+
+def _has_quantized_leaf(tree) -> bool:
+    return any(
+        isinstance(leaf, QuantizedTensor)
+        for leaf in jax.tree_util.tree_leaves(tree, is_leaf=lambda x: isinstance(x, QuantizedTensor))
+    )
+
+
+def test_tp2_int8_pool_warm_equals_cold_and_sequential(tiny):
+    """tp=2 leg: int8 pools shard heads-major over the model axis with their
+    f32 scale planes alongside; warm streams — gathered from cached int8
+    blocks, chunk-prefilled from the first uncached token — equal the cold
+    stream and the single-device quantized sequential run exactly."""
+    module, params = tiny
+    expected = _expected(module, params, PROMPTS)
+    mesh = MeshSpec(data=1, model=2).build(devices=jax.devices()[:2])
+    gen = Generator(
+        module, params, _cfg(), mesh=mesh,
+        partition_rules=llama_partition_rules(), quantize="int8",
+    )
+    assert _has_quantized_leaf(gen.params)
+    batcher = ContinuousBatcher(
+        gen, slots=2, decode_chunk=4, block_size=8, admit_chunk=8, prefix_cache=True
+    )
+    try:
+        cold = _drain(batcher.submit(PROMPTS[0]))  # publishes SYSTEM's int8 blocks
+        assert cold == expected[0]
+        warm = [_drain(batcher.submit(p)) for p in PROMPTS[1:]]
+        assert warm == expected[1:]
+        stats = batcher.stats()
+        assert stats["prefix_cache"]["hits"] == len(PROMPTS) - 1
+        assert stats["prefix_cache"]["tokens_avoided"] > 0
+        assert stats["kv_blocks"]["kv_dtype"] == "int8"
+        pool = batcher._carry[0]
+        assert pool[0]["k"].dtype == jnp.int8 and pool[0]["k_scale"].dtype == jnp.float32
+    finally:
+        batcher.close()
+
+
+@pytest.mark.slow  # ~6s; tier-1 keeps the tp=2 identity leg above — this leg
+# adds the dp-mesh delegation composition over the same from_generator
+# dequantize-requantize round trip the (slow) unit replication test pins
+def test_dp2_tp2_quantized_delegation_replicates_exactly(tiny):
+    """dp=2 x tp=2 leg: ContinuousBatcher over a PRE-QUANTIZED dp-mesh
+    Generator delegates to a ReplicaSet (the path replicas.py:396 used to
+    reject) — each replica dequantizes + re-quantizes its own placement, an
+    exact round trip, and the fleet's streams equal the sequential quantized
+    run with the prefix cache steering warm traffic."""
+    module, params = tiny
+    expected = _expected(module, params, PROMPTS)
+    mesh = MeshSpec(data=2, model=2).build(devices=jax.devices()[:4])
+    gen = Generator(
+        module, params, _cfg(), mesh=mesh,
+        partition_rules=llama_partition_rules(), quantize="int8",
+    )
+    engine = ContinuousBatcher(
+        gen, slots=2, decode_chunk=4, block_size=8, admit_chunk=8, prefix_cache=True
+    )
+    try:
+        assert isinstance(engine, ReplicaSet) and engine.replicas == 2
+        for batcher in engine.batchers:
+            assert batcher.gen.quantize == "int8"
+            assert batcher.gen.config.kv_cache_dtype == "int8"
+            assert _has_quantized_leaf(batcher.gen.params)
+        results = [_drain(engine.submit(p)) for p in PROMPTS]
+        assert results == expected
+        stats = engine.stats()
+        assert stats["prefix_cache"]["hits"] >= len(PROMPTS) - 1
+        assert stats["prefix_cache"]["cached_bytes"] > 0
+    finally:
+        engine.close()

@@ -399,6 +399,38 @@ def test_prometheus_renders_prefix_cache_section_without_none_gauges():
     assert "unionml_tpu_generation_prefix_cache_pinned_blocks 2" in text
 
 
+def test_prometheus_renders_quantized_pool_gauges_without_none():
+    # the int8-aware byte gauges (serving/continuous.py stats): kv_blocks
+    # carries block_bytes/used_bytes plus a STRING dtype label (skipped by the
+    # exposition, never rendered as a broken sample), and prefix_cache carries
+    # cached_bytes — every numeric leaf an int, never None
+    snapshot = {
+        "requests_total": 0,
+        "errors_total": 0,
+        "generation": {
+            "kv_blocks": {
+                "total": 38, "used": 12, "shared_prefix": 0, "block_size": 16,
+                "preemptions": 0, "block_bytes": 8704, "used_bytes": 104448,
+                "kv_dtype": "int8",
+            },
+            "prefix_cache": {
+                "hits": 4, "misses": 1, "tokens_avoided": 96, "cow_copies": 1,
+                "evictions": 0, "evicted_blocks": 0, "cached_blocks": 7,
+                "cached_tokens": 56, "cached_bytes": 60928, "pinned_blocks": 2,
+                "nodes": 3,
+            },
+        },
+    }
+    text = render_prometheus(snapshot)
+    assert _assert_parses(text)
+    assert "None" not in text
+    assert "unionml_tpu_generation_kv_blocks_block_bytes 8704" in text
+    assert "unionml_tpu_generation_kv_blocks_used_bytes 104448" in text
+    assert "unionml_tpu_generation_prefix_cache_cached_bytes 60928" in text
+    # the dtype label is a string leaf: skipped, not emitted as a series
+    assert "kv_dtype" not in text
+
+
 # ------------------------------------------------------------------ serving app surface
 
 

@@ -195,6 +195,75 @@ def test_int8_kv_cache_generation_runs_and_composes_with_int8_weights():
     assert np.concatenate(chunks, axis=1).shape[1] <= 8
 
 
+def test_quantize_params_min_size_and_path_filters():
+    """The selection edges serving depends on: ``min_size`` keeps small
+    kernels full precision (a tiny model quantizes NOTHING under the default
+    threshold — no silent accuracy tax for no bandwidth win), and the
+    include/exclude regexes retarget selection without touching the tree
+    walk."""
+    config = LlamaConfig.tiny(
+        vocab_size=61, dim=64, n_layers=1, n_heads=4, n_kv_heads=2, hidden_dim=128,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    module = Llama(config)
+    params = module.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+
+    # default min_size (1 << 16): every kernel of this tiny config is smaller,
+    # so the tree passes through untouched
+    untouched = _flat_by_path(quantize_params(params))
+    assert not any(isinstance(leaf, QuantizedTensor) for leaf in untouched.values())
+    # threshold boundary: exactly min_size elements quantizes (>=, not >)
+    wi = _flat_by_path(params)["layer_0/mlp/wi/kernel"]
+    boundary = int(np.prod(wi.shape))
+    flat = _flat_by_path(quantize_params(params, min_size=boundary))
+    assert isinstance(flat["layer_0/mlp/wi/kernel"], QuantizedTensor)
+
+    # include narrows to one projection; everything else stays fp
+    flat = _flat_by_path(quantize_params(params, include=r"q_proj/kernel$", min_size=1))
+    assert isinstance(flat["layer_0/attn/q_proj/kernel"], QuantizedTensor)
+    assert not isinstance(flat["layer_0/attn/k_proj/kernel"], QuantizedTensor)
+    assert not isinstance(flat["lm_head/kernel"], QuantizedTensor)
+
+    # exclude carves the head out of the default include
+    flat = _flat_by_path(quantize_params(params, exclude=r"(embed|norm|lm_head)", min_size=1))
+    assert not isinstance(flat["lm_head/kernel"], QuantizedTensor)
+    assert isinstance(flat["layer_0/attn/q_proj/kernel"], QuantizedTensor)
+
+
+def test_quantized_shardings_strip_axes_on_unit_dims():
+    """_quantized_shardings: the int8 values keep the kernel's resolved
+    sharding while the per-channel scale keeps mesh axes ONLY on its non-unit
+    dims — a size-1 reduction dim carrying a mesh axis would be an invalid
+    sharding. Covers the 2D kernel and the stacked [E, K, F] expert case
+    (whose scale is [E, 1, F]: the middle axis must strip, the outer ones
+    survive)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from unionml_tpu.models.generate import _quantized_shardings
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1), ("data", "model"))
+    rng = np.random.default_rng(5)
+    qparams = {
+        "dense": quantize_array(rng.normal(size=(32, 16)).astype(np.float32)),
+        "experts": quantize_array(rng.normal(size=(4, 32, 16)).astype(np.float32)),
+        "plain": jnp.zeros((8, 8), jnp.float32),
+    }
+    shardings = {
+        "dense": NamedSharding(mesh, P("data", "model")),
+        "experts": NamedSharding(mesh, P("data", None, "model")),
+        "plain": NamedSharding(mesh, P(None, "model")),
+    }
+    fixed = _quantized_shardings(qparams, shardings, mesh)
+    # dense kernel [32, 16] -> scale [1, 16]: the size-1 dim drops its axis
+    assert fixed["dense"].q.spec == P("data", "model")
+    assert fixed["dense"].scale.spec == P(None, "model")
+    # expert stack [4, 32, 16] -> scale [4, 1, 16]: only the unit dim strips
+    assert fixed["experts"].q.spec == P("data", None, "model")
+    assert fixed["experts"].scale.spec == P("data", None, "model")
+    # non-quantized leaves pass their sharding through untouched
+    assert fixed["plain"].spec == P(None, "model")
+
+
 def test_unsupported_mode_rejected():
     config = LlamaConfig.tiny(dtype=jnp.float32, param_dtype=jnp.float32)
     module = Llama(config)

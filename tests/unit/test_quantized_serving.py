@@ -1,0 +1,292 @@
+"""Quantized serving end-to-end: int8 weights + int8 KV through the stack.
+
+Rings: (1) the serve-time env readers (``UNIONML_TPU_QUANTIZE`` /
+``UNIONML_TPU_KV_CACHE_DTYPE``) — warn-and-fall-back on garbage, never a crash
+at app-import time — and their resolution inside ``Generator``; (2) the
+continuous engine over an int8 paged pool composed with the radix prefix
+cache — warm (cache-hit) output must be BIT-IDENTICAL to a cold int8 prefill
+(the same pinned contract PR 6 holds for fp pools); (3) replica and
+speculative composition — a pre-quantized Generator replicates bit-identically
+and a ``DraftSpec(quantize="int8")`` draft leaves greedy output token-exact.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from unionml_tpu.defaults import (
+    SERVE_KV_CACHE_DTYPE_ENV_VAR,
+    SERVE_QUANTIZE_ENV_VAR,
+    serve_kv_cache_dtype,
+    serve_quantize,
+)
+from unionml_tpu.models import GenerationConfig, Generator, Llama, LlamaConfig
+from unionml_tpu.ops.quant import QuantizedTensor
+from unionml_tpu.serving import ContinuousBatcher, ReplicaSet
+
+
+@pytest.fixture(scope="module")
+def quantizable_gen():
+    """A tiny Llama whose MLP kernels (64 x 1024 = 65536 elements) cross
+    ``quantize_params``' default ``min_size``, so quantize="int8" really stores
+    int8 weights — not a silent no-op."""
+    config = LlamaConfig.tiny(
+        vocab_size=97, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, hidden_dim=1024,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    module = Llama(config)
+    params = module.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    return module, params
+
+
+def _has_quantized_leaf(tree) -> bool:
+    return any(
+        isinstance(leaf, QuantizedTensor)
+        for leaf in jax.tree_util.tree_leaves(tree, is_leaf=lambda x: isinstance(x, QuantizedTensor))
+    )
+
+
+def _drain(stream):
+    return [int(t) for chunk in stream for t in np.asarray(chunk).ravel()]
+
+
+def _cfg(**overrides):
+    base = dict(max_new_tokens=10, temperature=0.0, prompt_buckets=(32,))
+    base.update(overrides)
+    return GenerationConfig(**base)
+
+
+# ------------------------------------------------------------------ env readers
+
+
+def test_env_readers_tolerate_garbage_and_accept_modes(monkeypatch, caplog):
+    from unionml_tpu._logging import logger
+
+    monkeypatch.setattr(logger, "propagate", True)  # let caplog see records
+    for var, reader in (
+        (SERVE_QUANTIZE_ENV_VAR, serve_quantize),
+        (SERVE_KV_CACHE_DTYPE_ENV_VAR, serve_kv_cache_dtype),
+    ):
+        monkeypatch.delenv(var, raising=False)
+        assert reader() is None
+        monkeypatch.setenv(var, "int8")
+        assert reader() == "int8"
+        monkeypatch.setenv(var, " INT8 ")  # normalized, deployment-env friendly
+        assert reader() == "int8"
+        for off in ("none", "off", "0", ""):
+            monkeypatch.setenv(var, off)
+            assert reader() is None
+        with caplog.at_level("WARNING", logger="unionml_tpu"):
+            monkeypatch.setenv(var, "fp4")
+            assert reader() is None  # warned, not crashed
+        assert any("fp4" in record.message for record in caplog.records)
+        caplog.clear()
+        monkeypatch.delenv(var, raising=False)
+
+
+def test_generator_resolves_serve_env_and_validates(quantizable_gen, monkeypatch):
+    module, params = quantizable_gen
+    monkeypatch.setenv(SERVE_QUANTIZE_ENV_VAR, "int8")
+    monkeypatch.setenv(SERVE_KV_CACHE_DTYPE_ENV_VAR, "int8")
+    gen = Generator(module, params, _cfg())
+    assert gen.quantize == "int8" and gen.config.kv_cache_dtype == "int8"
+    assert _has_quantized_leaf(gen.params)
+    # garbage degrades to full precision at construction, never crashes
+    monkeypatch.setenv(SERVE_QUANTIZE_ENV_VAR, "fp4")
+    monkeypatch.setenv(SERVE_KV_CACHE_DTYPE_ENV_VAR, "garbage")
+    fallback = Generator(module, params, _cfg())
+    assert fallback.quantize is None and fallback.config.kv_cache_dtype is None
+    assert not _has_quantized_leaf(fallback.params)
+    # "none" explicitly overrides an inherited fleet-wide export
+    monkeypatch.setenv(SERVE_QUANTIZE_ENV_VAR, "none")
+    assert Generator(module, params, _cfg()).quantize is None
+    # explicit API misuse still raises the Generator/init_cache ValueError text
+    monkeypatch.delenv(SERVE_QUANTIZE_ENV_VAR, raising=False)
+    monkeypatch.delenv(SERVE_KV_CACHE_DTYPE_ENV_VAR, raising=False)
+    with pytest.raises(ValueError, match="unsupported kv_cache_dtype"):
+        Generator(module, params, _cfg(kv_cache_dtype="fp8"))
+    with pytest.raises(ValueError, match="unsupported quantize mode"):
+        Generator(module, params, _cfg(), quantize="fp4")
+
+
+# ------------------------------------------------------ engine x prefix cache
+
+
+PROMPTS_SHARED = [list(range(1, 21)) + [70 + i] for i in range(4)]
+
+
+def test_int8_pool_warm_equals_cold_equals_sequential(quantizable_gen):
+    """The acceptance contract: with int8 weights AND an int8 paged pool, a
+    radix-cache-hit admission (scales gathered alongside the int8 values)
+    yields streams bit-identical to the cold int8 prefill and to a sequential
+    quantized Generator run."""
+    module, params = quantizable_gen
+    cfg = _cfg(kv_cache_dtype="int8")
+    sequential = Generator(module, params, cfg, quantize="int8")
+    expected = [list(sequential([p])[0]) for p in PROMPTS_SHARED]
+
+    batcher = ContinuousBatcher(
+        Generator(module, params, cfg, quantize="int8"), slots=2, decode_chunk=4,
+        block_size=8, admit_chunk=8, prefix_cache=True,
+    )
+    try:
+        results = [_drain(batcher.submit(p)) for p in PROMPTS_SHARED]
+        assert results == expected
+        stats = batcher.stats()
+        assert stats["prefix_cache"]["hits"] == len(PROMPTS_SHARED) - 1
+        assert stats["prefix_cache"]["tokens_avoided"] == 16 * (len(PROMPTS_SHARED) - 1)
+        # the pool really is int8 (values) + f32 (scale planes)
+        pool = batcher._carry[0]
+        assert pool[0]["k"].dtype == jnp.int8
+        assert pool[0]["k_scale"].dtype == jnp.float32
+        # int8-aware byte gauges on the same live engine, never None:
+        # head_dim 16 at int8 -> 2 layers * 2 kv heads * 8 positions * (2*16+8)
+        kv = stats["kv_blocks"]
+        assert kv["kv_dtype"] == "int8"
+        assert kv["block_bytes"] == 2 * 2 * 8 * (2 * 16 + 8)
+        assert kv["used_bytes"] == kv["used"] * kv["block_bytes"]
+        pc = stats["prefix_cache"]
+        assert pc["cached_bytes"] == pc["cached_blocks"] * kv["block_bytes"]
+        assert pc["cached_bytes"] > 0
+        assert all(value is not None for value in kv.values())
+        assert all(value is not None for value in pc.values())
+        # an fp pool reports its own dtype and the wider per-block bytes
+        # (construction-time gauges only: no stream, no extra compiles)
+        fp = ContinuousBatcher(Generator(module, params, _cfg()), slots=1, block_size=8)
+        fp_kv = fp.stats()["kv_blocks"]
+        fp.close()
+        assert fp_kv["kv_dtype"] == "float32"
+        assert fp_kv["block_bytes"] == 2 * 2 * 8 * (2 * 16 * 4)
+    finally:
+        batcher.close()
+
+
+@pytest.mark.slow  # ~7s; tier-1 keeps the warm==cold==sequential identity test
+# above — this adds the mid-block CoW leg, which the fp ring also pins daily
+def test_int8_pool_cow_divergence_stays_exact(quantizable_gen):
+    """Mid-block divergence over an int8 pool: the partially shared tail block
+    copy-on-writes through the gather+scatter with its scale planes riding
+    along, and the stream stays bit-identical to the cold run."""
+    module, params = quantizable_gen
+    cfg = _cfg(kv_cache_dtype="int8")
+    long_a = list(range(1, 28))
+    long_b = list(range(1, 21)) + [90, 91, 92]  # shares 20 tokens: mid-block
+    sequential = Generator(module, params, cfg, quantize="int8")
+    expected = [list(sequential([p])[0]) for p in (long_a, long_b)]
+
+    batcher = ContinuousBatcher(
+        Generator(module, params, cfg, quantize="int8"), slots=2, decode_chunk=3,
+        block_size=8, prefix_cache=True,
+    )
+    try:
+        results = [_drain(batcher.submit(p)) for p in (long_a, long_b)]
+        assert results == expected
+        stats = batcher.stats()["prefix_cache"]
+        assert stats["cow_copies"] == 1 and stats["tokens_avoided"] == 20
+    finally:
+        batcher.close()
+
+
+# ------------------------------------------------------------ replicas + draft
+
+
+@pytest.mark.slow  # ~7s; the emulated dp=2 x tp=2 ring pins the same
+# from_generator dequantize-requantize path in tier-1 at mesh scale
+def test_pre_quantized_generator_replicates_bit_identically(quantizable_gen):
+    """The path replicas.py used to reject: a quantized Generator replicates by
+    dequantize-then-requantize per placement — an exact round trip, so the
+    fleet's streams equal the original engine's token for token."""
+    module, params = quantizable_gen
+    gen = Generator(module, params, _cfg(kv_cache_dtype="int8"), quantize="int8")
+    expected = [list(gen([p])[0]) for p in PROMPTS_SHARED[:3]]
+    rs = ReplicaSet.from_generator(gen, replicas=2, slots=2, decode_chunk=4)
+    try:
+        assert rs.replicas == 2
+        for engine in rs.batchers:
+            assert engine.gen.quantize == "int8"
+            assert engine.gen.config.kv_cache_dtype == "int8"
+            assert _has_quantized_leaf(engine.gen.params)
+        results = [_drain(rs.submit(p)) for p in PROMPTS_SHARED[:3]]
+        assert results == expected
+    finally:
+        rs.close()
+
+
+@pytest.mark.slow  # ~7s; greedy draft-invariance is structural (the draft only
+# proposes) and the speculative ring already pins it for the fp draft in tier-1
+def test_quantized_draft_spec_keeps_greedy_exact(quantizable_gen):
+    """DraftSpec(quantize="int8"): the draft stores int8 weights (the option
+    speculative.py hardcoded away) and greedy output stays token-for-token the
+    plain target's — the draft only proposes, the target decides."""
+    from unionml_tpu.models import DraftSpec
+
+    module, params = quantizable_gen
+    config = module.config
+    draft_module = Llama(dataclasses.replace(config, n_layers=1))
+    draft_params = draft_module.init(jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32))["params"]
+    plain = Generator(module, params, _cfg())
+    expected = plain(PROMPTS_SHARED[:2])
+    spec_cfg = _cfg(
+        draft=DraftSpec(module=draft_module, params=draft_params, gamma=2, quantize="int8")
+    )
+    gen = Generator(module, params, spec_cfg)
+    assert _has_quantized_leaf(gen._speculative()._draft.params)
+    np.testing.assert_array_equal(gen(PROMPTS_SHARED[:2]), expected)
+    # default (quantize=None, no env): the draft still runs full precision
+    spec_plain = _cfg(draft=DraftSpec(module=draft_module, params=draft_params, gamma=2))
+    assert not _has_quantized_leaf(
+        Generator(module, params, spec_plain)._speculative()._draft.params
+    )
+
+
+# ------------------------------------------------------------------ app + CLI
+
+
+def test_serving_app_configure_quantization(sklearn_model, monkeypatch):
+    from unionml_tpu.serving.app import ServingApp
+
+    monkeypatch.delenv(SERVE_QUANTIZE_ENV_VAR, raising=False)
+    monkeypatch.delenv(SERVE_KV_CACHE_DTYPE_ENV_VAR, raising=False)
+    app = ServingApp(sklearn_model)
+    assert app.quantize is None and app.kv_cache_dtype is None
+    app.configure_quantization(quantize="int8", kv_cache_dtype="int8")
+    assert app.quantize == "int8" and app.kv_cache_dtype == "int8"
+    import os
+
+    assert os.environ[SERVE_QUANTIZE_ENV_VAR] == "int8"
+    assert os.environ[SERVE_KV_CACHE_DTYPE_ENV_VAR] == "int8"
+    app.configure_quantization(quantize="none")
+    assert app.quantize is None and os.environ[SERVE_QUANTIZE_ENV_VAR] == "none"
+    with pytest.raises(ValueError, match="unsupported quantize mode"):
+        app.configure_quantization(quantize="fp4")
+    monkeypatch.delenv(SERVE_QUANTIZE_ENV_VAR, raising=False)
+    monkeypatch.delenv(SERVE_KV_CACHE_DTYPE_ENV_VAR, raising=False)
+
+
+def test_serve_cli_exports_quantize_env_before_app_import(monkeypatch):
+    """The --dp-replicas early-export contract: serve writes the env vars
+    BEFORE locating the app module, so Generators built at import time resolve
+    them; the bogus app ref fails afterwards, proving the ordering."""
+    import os
+
+    from click.testing import CliRunner
+
+    from unionml_tpu.cli import app as cli_app
+
+    # register restore-to-absent with monkeypatch before the CLI overwrites
+    monkeypatch.delenv(SERVE_QUANTIZE_ENV_VAR, raising=False)
+    monkeypatch.delenv(SERVE_KV_CACHE_DTYPE_ENV_VAR, raising=False)
+    monkeypatch.setenv(SERVE_QUANTIZE_ENV_VAR, "placeholder")
+    monkeypatch.setenv(SERVE_KV_CACHE_DTYPE_ENV_VAR, "placeholder")
+    result = CliRunner().invoke(
+        cli_app,
+        ["serve", "definitely_not_a_module:model", "--quantize", "int8",
+         "--kv-cache-dtype", "int8"],
+    )
+    assert result.exit_code != 0  # the bogus app ref fails AFTER the export
+    assert os.environ[SERVE_QUANTIZE_ENV_VAR] == "int8"
+    assert os.environ[SERVE_KV_CACHE_DTYPE_ENV_VAR] == "int8"

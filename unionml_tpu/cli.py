@@ -282,6 +282,19 @@ def fetch_model(
     "blocks and prefill only the suffix; off (the default) keeps today's behavior exactly",
 )
 @click.option(
+    "--quantize", default=None, type=click.Choice(["int8", "none"]),
+    help="weight-only quantization for the app's serving Generators: int8 stores matmul "
+    "kernels as int8 with per-channel scales (dequant fuses in-jit, so int8 is what "
+    "crosses HBM — roughly 2x decode bandwidth); none forces full precision over an "
+    "inherited UNIONML_TPU_QUANTIZE export",
+)
+@click.option(
+    "--kv-cache-dtype", "kv_cache_dtype", default=None, type=click.Choice(["int8", "none"]),
+    help="KV-cache storage dtype for generation serving: int8 stores K/V rows (dense "
+    "rows and paged pools alike) symmetric-quantized per (position, head) with f32 "
+    "scales — roughly doubling resident streams per chip; none forces the compute dtype",
+)
+@click.option(
     "--trace/--no-trace", "trace", default=None,
     help="record a per-request timeline (queue wait, routed replica, prefill chunks, "
     "emissions) into the flight recorder, served at /debug/requests; request ids flow "
@@ -321,6 +334,8 @@ def serve(
     prefill_budget: Optional[int],
     max_admissions: Optional[int],
     prefix_cache: Optional[bool],
+    quantize: Optional[str],
+    kv_cache_dtype: Optional[str],
     trace: Optional[bool],
     flight_recorder_size: Optional[int],
     log_format: Optional[str],
@@ -360,6 +375,17 @@ def serve(
     previously-seen prefix skips prefill for the cached portion, bit-identical
     to a cold prefill; same early-export contract as ``--dp-replicas``.
 
+    ``--quantize int8`` / ``--kv-cache-dtype int8`` (docs/serving.md
+    "Quantized serving") store serving weights and the KV cache as int8 —
+    decode is HBM-bandwidth bound, so both roughly halve bytes per step, and
+    int8 paged pools roughly double resident streams per chip. Exported as
+    ``UNIONML_TPU_QUANTIZE``/``UNIONML_TPU_KV_CACHE_DTYPE`` before the app
+    module imports; Generators built by app code resolve them at construction,
+    so existing apps quantize with zero code changes. ``none`` forces full
+    precision over an inherited export. Composes with ``--prefix-cache``
+    (cached int8 blocks replay bit-identically) and ``--dp-replicas`` (each
+    replica quantizes its own placement).
+
     Observability (docs/observability.md): ``--trace`` records per-request
     timelines into the flight recorder (``GET /debug/requests``,
     ``GET /debug/requests/<id>``), ``--flight-recorder-size`` bounds the ring,
@@ -381,6 +407,16 @@ def serve(
         from unionml_tpu.defaults import SERVE_PREFIX_CACHE_ENV_VAR
 
         os.environ[SERVE_PREFIX_CACHE_ENV_VAR] = "1" if prefix_cache else "0"
+    if quantize is not None or kv_cache_dtype is not None:
+        # same early-export contract: Generators built at app-module import
+        # time resolve these at construction ("none" exports too — it must
+        # override an inherited fleet-wide env in reload/fork children)
+        from unionml_tpu import defaults as _defaults
+
+        if quantize is not None:
+            os.environ[_defaults.SERVE_QUANTIZE_ENV_VAR] = quantize
+        if kv_cache_dtype is not None:
+            os.environ[_defaults.SERVE_KV_CACHE_DTYPE_ENV_VAR] = kv_cache_dtype
     admission_knobs = (
         ("--admit-chunk", admit_chunk, "SERVE_ADMIT_CHUNK_ENV_VAR"),
         ("--prefill-budget", prefill_budget, "SERVE_PREFILL_BUDGET_ENV_VAR"),
@@ -447,7 +483,9 @@ def serve(
         default_deadline_ms=deadline_ms,
         max_deadline_ms=max_deadline_ms,
         drain_timeout_s=drain_timeout,
-    ).configure_replicas(dp_replicas).configure_observability(
+    ).configure_replicas(dp_replicas).configure_quantization(
+        quantize=quantize, kv_cache_dtype=kv_cache_dtype
+    ).configure_observability(
         trace=trace,
         flight_recorder_size=flight_recorder_size,
         log_format=log_format,

@@ -97,6 +97,27 @@ SERVE_MAX_ADMISSIONS_ENV_VAR = "UNIONML_TPU_MAX_ADMISSIONS"
 #: early-export contract as the admission knobs.
 SERVE_PREFIX_CACHE_ENV_VAR = "UNIONML_TPU_PREFIX_CACHE"
 
+# ------------------------------------------------------------ quantized serving
+# Serve-time quantization knobs (docs/serving.md "Quantized serving"). Decode is
+# HBM-bandwidth bound and the KV cache dominates resident memory at scale:
+# int8 weights and int8 paged KV roughly halve bytes-per-step and roughly
+# double resident streams per chip. Same early-export contract as
+# SERVE_DP_REPLICAS_ENV_VAR: the serve CLI sets these before the app module
+# imports, and Generators built by app code resolve them at construction —
+# existing apps opt into quantized serving with zero code changes.
+
+#: "int8" = weight-only int8 for serving Generators (ops/quant.py: per-channel
+#: symmetric, dequant fused in-jit so int8 is what crosses HBM); "none"/unset =
+#: full precision. Garbage values warn and fall back (never crash serve at
+#: app-import time); explicit API calls still raise the Generator's own
+#: "unsupported quantize mode" ValueError.
+SERVE_QUANTIZE_ENV_VAR = "UNIONML_TPU_QUANTIZE"
+
+#: "int8" = int8 KV cache (per-(position, head) symmetric scales — dense rows
+#: and paged pools both, models/generate.init_cache/init_paged_cache);
+#: "none"/unset = the compute dtype. Same warn-and-fall-back contract.
+SERVE_KV_CACHE_DTYPE_ENV_VAR = "UNIONML_TPU_KV_CACHE_DTYPE"
+
 # --------------------------------------------------------------- observability
 # Request-tracing / flight-recorder / profiler knobs (unionml_tpu/observability,
 # docs/observability.md). Same export pattern as the admission knobs above: the
@@ -161,6 +182,44 @@ def env_float(name: str, default: float, *, minimum: "float | None" = None) -> f
         logger.warning(f"clamping {name}={value} to the minimum {minimum}")
         return minimum
     return value
+
+
+def env_choice(name: str, choices: "tuple[str, ...]", what: str) -> "str | None":
+    """Parse a choice-valued env var with the :func:`env_int` tolerance
+    contract: unset/empty/"none"/"off"/"0" mean None (the knob's off state), a
+    listed choice is returned normalized, and anything else warns and falls
+    back to None instead of raising at whatever moment the knob happens to be
+    read (for serve knobs that is app-import time — a typo'd deployment env
+    must degrade to full precision, not take the service down). ``what`` names
+    the knob in the warning (e.g. "quantize mode"), mirroring the ValueError
+    text the explicit API raises for the same mistake."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return None
+    value = raw.strip().lower()
+    if value in ("", "none", "off", "0"):
+        return None
+    if value in choices:
+        return value
+    logger.warning(
+        f"ignoring {name}={raw!r}: unsupported {what}; expected one of "
+        f"{choices + ('none',)} — falling back to full precision"
+    )
+    return None
+
+
+def serve_quantize() -> "str | None":
+    """The serve-time weight-quantization mode ("int8" or None); read at
+    Generator construction, after the CLI's early export — same contract as
+    :func:`serve_dp_replicas`. Garbage (``UNIONML_TPU_QUANTIZE=fp4``) warns
+    and falls back to None rather than crashing serve at app-import time."""
+    return env_choice(SERVE_QUANTIZE_ENV_VAR, ("int8",), "quantize mode")
+
+
+def serve_kv_cache_dtype() -> "str | None":
+    """The serve-time KV-cache storage dtype ("int8" or None = compute dtype);
+    read at Generator construction, same contract as :func:`serve_quantize`."""
+    return env_choice(SERVE_KV_CACHE_DTYPE_ENV_VAR, ("int8",), "kv_cache_dtype")
 
 
 def serve_dp_replicas() -> int:

@@ -57,12 +57,17 @@ class DraftSpec:
     ``__call__``/``stream`` through a
     :class:`~unionml_tpu.models.speculative.SpeculativeGenerator` — same output
     law (greedy: token-exact; sampled: distribution-exact), fewer target
-    dispatches per token."""
+    dispatches per token. ``quantize`` ("int8") stores the DRAFT's weights
+    quantized too — None follows the serve-wide ``UNIONML_TPU_QUANTIZE``
+    default, exactly like the target Generator's own kwarg, so a quantized
+    serving fleet drafts in int8 without a second knob. The output law is
+    unchanged either way: the draft only proposes, the target decides."""
 
     module: Any
     params: Any
     gamma: int = 4
     partition_rules: Optional[Any] = None
+    quantize: Optional[str] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -339,6 +344,27 @@ class Generator:
         partition_rules: Optional[Any] = None,
         quantize: Optional[str] = None,
     ):
+        from unionml_tpu.defaults import serve_kv_cache_dtype, serve_quantize
+
+        # serve-time quantization defaults (the --dp-replicas early-export
+        # contract): an unset kwarg falls back to the serve CLI's
+        # UNIONML_TPU_QUANTIZE export, and an unset config.kv_cache_dtype to
+        # UNIONML_TPU_KV_CACHE_DTYPE — so `serve --quantize int8
+        # --kv-cache-dtype int8` quantizes app-built Generators with zero app
+        # code changes. Explicit values always win; with the env unset both
+        # resolutions are identity and nothing changes.
+        if quantize is None:
+            quantize = serve_quantize()
+        if config.kv_cache_dtype is None:
+            env_kv = serve_kv_cache_dtype()
+            if env_kv is not None:
+                config = dataclasses.replace(config, kv_cache_dtype=env_kv)
+        if config.kv_cache_dtype not in (None, "int8"):
+            # init_cache would raise the same at first use; failing at
+            # construction keeps the error next to the config that caused it
+            raise ValueError(
+                f"unsupported kv_cache_dtype {config.kv_cache_dtype!r}; expected None or 'int8'"
+            )
         self.module = module
         self.config = config
         self.mesh = mesh

@@ -83,6 +83,7 @@ class SpeculativeGenerator:
         mesh: Optional[Any] = None,
         partition_rules: Optional[Any] = None,
         quantize: Optional[str] = None,
+        quantize_draft: Optional[str] = None,
     ):
         import dataclasses
 
@@ -90,14 +91,21 @@ class SpeculativeGenerator:
         # plainly (a draft-bearing config would recurse through the façade)
         config = dataclasses.replace(config, draft=None)
         # reuse the Generator machinery for prefill/placement/bucketing on both
-        # models; the draft runs unquantized (it is small by construction)
+        # models. ``quantize_draft`` ("int8") stores the draft quantized too;
+        # None follows the serve-wide UNIONML_TPU_QUANTIZE default inside the
+        # Generator — either way the draft only proposes and the target
+        # decides, so the output law is untouched
+        target = Generator(
+            target_module, target_params, config,
+            mesh=mesh, partition_rules=partition_rules, quantize=quantize,
+        )
         self._init_state(
+            target,
             Generator(
-                target_module, target_params, config,
-                mesh=mesh, partition_rules=partition_rules, quantize=quantize,
+                draft_module, draft_params, target.config,
+                mesh=mesh, partition_rules=partition_rules, quantize=quantize_draft,
             ),
-            Generator(draft_module, draft_params, config, mesh=mesh, partition_rules=partition_rules),
-            config,
+            target.config,
             gamma,
         )
 
@@ -132,9 +140,13 @@ class SpeculativeGenerator:
         config = dataclasses.replace(target.config, draft=None)
         self._init_state(
             target,
+            # the DraftSpec's quantize option ("int8", or None = the serve-wide
+            # UNIONML_TPU_QUANTIZE default); target.config already resolved the
+            # KV dtype, so both caches share one storage dtype
             Generator(
                 draft.module, draft.params, config,
                 mesh=target.mesh, partition_rules=draft.partition_rules,
+                quantize=draft.quantize,
             ),
             config,
             draft.gamma,

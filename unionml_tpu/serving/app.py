@@ -33,10 +33,14 @@ from unionml_tpu.defaults import (
     SERVE_DEFAULT_DEADLINE_MS,
     SERVE_DP_REPLICAS_ENV_VAR,
     SERVE_LOG_FORMAT_ENV_VAR,
+    SERVE_KV_CACHE_DTYPE_ENV_VAR,
     SERVE_MAX_INFLIGHT,
     SERVE_PROFILE_MAX_MS,
+    SERVE_QUANTIZE_ENV_VAR,
     serve_flight_recorder_size,
+    serve_kv_cache_dtype,
     serve_profile_dir,
+    serve_quantize,
     serve_trace,
 )
 from unionml_tpu.observability import (
@@ -88,6 +92,12 @@ class ServingApp:
         self.metrics = ServingMetrics()
         #: serve-time --dp-replicas override (None until configure_replicas)
         self.dp_replicas: Optional[int] = None
+        #: serve-time quantization knobs (--quantize/--kv-cache-dtype, or the
+        #: ambient UNIONML_TPU_QUANTIZE/_KV_CACHE_DTYPE exports): recorded here
+        #: for introspection; the Generators the app builds resolve the env
+        #: directly at construction (docs/serving.md "Quantized serving")
+        self.quantize: Optional[str] = serve_quantize()
+        self.kv_cache_dtype: Optional[str] = serve_kv_cache_dtype()
         self._started = False
         # ---- observability (docs/observability.md): flight recorder + tracer,
         # defaults from the UNIONML_TPU_TRACE / _FLIGHT_RECORDER_SIZE /
@@ -231,6 +241,34 @@ class ServingApp:
                 raise ValueError("dp_replicas must be >= 0 (0 = derive from the mesh)")
             self.dp_replicas = dp_replicas
             os.environ[SERVE_DP_REPLICAS_ENV_VAR] = str(dp_replicas)
+        return self
+
+    def configure_quantization(
+        self,
+        quantize: Optional[str] = None,
+        kv_cache_dtype: Optional[str] = None,
+    ) -> "ServingApp":
+        """Record the serve-time ``--quantize``/``--kv-cache-dtype`` overrides
+        and export them so generation Generators built after startup (warmup
+        hooks, first-request construction) resolve them — the same env-export
+        contract as :meth:`configure_replicas` (docs/serving.md "Quantized
+        serving"). ``None`` leaves a knob alone; ``"none"`` explicitly forces
+        full precision over an inherited fleet-wide export; ``"int8"`` is the
+        only quantized mode today (the same values the env readers accept —
+        anything else raises here, matching the Generator's own rejection)."""
+        for value, what, env_name in (
+            (quantize, "quantize mode", SERVE_QUANTIZE_ENV_VAR),
+            (kv_cache_dtype, "kv_cache_dtype", SERVE_KV_CACHE_DTYPE_ENV_VAR),
+        ):
+            if value is None:
+                continue
+            if value not in ("int8", "none"):
+                raise ValueError(f"unsupported {what} {value!r}; expected 'int8' or 'none'")
+            os.environ[env_name] = value
+        if quantize is not None:
+            self.quantize = None if quantize == "none" else quantize
+        if kv_cache_dtype is not None:
+            self.kv_cache_dtype = None if kv_cache_dtype == "none" else kv_cache_dtype
         return self
 
     def _replica_gauge(self) -> Optional[Any]:

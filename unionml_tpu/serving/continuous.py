@@ -516,6 +516,22 @@ class ContinuousBatcher:
                     f"({self.max_blocks} blocks of {block_size}) or admission could deadlock"
                 )
             self._scratch_block = self.pool_blocks
+            #: bytes one pool block occupies across the target model's layers
+            #: at the POOL dtype — int8 pools carry f32 k/v scale planes (4 B
+            #: per (position, head) each) next to the 1-byte values, so the
+            #: int8-aware byte gauges on /metrics reflect what HBM actually
+            #: holds, not a naive values-only halving
+            mcfg = generator.module.config
+            head_dim = mcfg.dim // mcfg.n_heads
+            if cfg.kv_cache_dtype == "int8":
+                kv_itemsize, scale_bytes = 1, 8  # k_scale + v_scale, f32 each
+            else:
+                kv_itemsize, scale_bytes = jnp.dtype(mcfg.dtype).itemsize, 0
+            self._block_bytes = int(
+                mcfg.n_layers * mcfg.n_kv_heads * block_size
+                * (2 * head_dim * kv_itemsize + scale_bytes)
+            )
+            self._kv_dtype_label = cfg.kv_cache_dtype or str(jnp.dtype(mcfg.dtype))
             self._free_blocks: "List[int]" = list(range(self.pool_blocks))
             self._slot_blocks: Dict[int, "List[int]"] = {}
             #: shared-prefix pages: the system prompt's FULL blocks are written
@@ -1167,12 +1183,20 @@ class ContinuousBatcher:
             }
             if self.block_size is not None:
                 # "used" includes the permanently resident shared-prefix pages
+                used = self.pool_blocks - len(self._free_blocks)
                 snapshot["kv_blocks"] = {
                     "total": self.pool_blocks,
-                    "used": self.pool_blocks - len(self._free_blocks),
+                    "used": used,
                     "shared_prefix": len(self._shared_prefix_blocks),
                     "block_size": self.block_size,
                     "preemptions": self.preemptions,
+                    # byte gauges at the POOL dtype (int8 pools include their
+                    # f32 scale planes) — ints always, never None, so the
+                    # Prometheus exposition stays clean; the dtype label is a
+                    # string, which the exposition skips by design
+                    "block_bytes": self._block_bytes,
+                    "used_bytes": used * self._block_bytes,
+                    "kv_dtype": self._kv_dtype_label,
                 }
                 if self.prefix is not None:
                     # the static prefix's partial tail block is NOT among the
@@ -1197,6 +1221,10 @@ class ContinuousBatcher:
                     "evicted_blocks": self._radix.evicted_blocks,
                     "cached_blocks": self._radix.cached_blocks(),
                     "cached_tokens": self._radix.cached_tokens(),
+                    # bytes the cached blocks pin in HBM at the POOL dtype —
+                    # the gauge that shows the int8 cache holding ~2x the
+                    # prefixes of a bf16 pool of the same byte size
+                    "cached_bytes": self._radix.cached_bytes(self._block_bytes),
                     "pinned_blocks": self._radix.pinned_blocks(),
                     "nodes": self._radix.nodes(),
                 }

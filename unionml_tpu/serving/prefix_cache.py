@@ -303,6 +303,13 @@ class RadixPrefixCache:
     def cached_blocks(self) -> int:
         return sum(len(n.blocks) for n in self._walk())
 
+    def cached_bytes(self, block_bytes: int) -> int:
+        """HBM the cached blocks pin, at the owning engine's per-block byte
+        cost (``ContinuousBatcher._block_bytes`` — pool-dtype aware, so int8
+        pools count their f32 scale planes). The tree itself is dtype-blind;
+        the engine supplies the conversion."""
+        return self.cached_blocks() * int(block_bytes)
+
     def cached_tokens(self) -> int:
         return sum(len(n.tokens) for n in self._walk())
 

@@ -392,19 +392,28 @@ class ReplicaSet:
         """Re-host an existing Generator's weights as a replica set (the
         ``ContinuousBatcher`` delegation path). Params are re-placed onto each
         submesh — an fsdp-sharded tree is gathered per replica, paid once at
-        construction."""
-        if getattr(generator, "quantize", None) is not None:
-            raise ValueError(
-                "cannot replicate an already-quantized Generator (its params tree is "
-                "transformed); call ReplicaSet.build(module, raw_params, config, "
-                "quantize='int8', ...) so each replica quantizes its own placement"
-            )
+        construction. A pre-QUANTIZED Generator (``quantize="int8"``, by kwarg
+        or the serve-wide ``UNIONML_TPU_QUANTIZE`` export) replicates too: its
+        int8 tree is dequantized back to the param dtype once here and each
+        replica re-quantizes its own placement — symmetric per-channel int8 is
+        an exact round trip (dequantize then quantize reproduces the identical
+        ``q``/``scale`` planes), so every replica serves bit-identical weights
+        to the original engine."""
+        params = generator.params
+        quantize = getattr(generator, "quantize", None)
+        if quantize is not None:
+            from unionml_tpu.ops.quant import dequantize_tree
+
+            mcfg = getattr(generator.module, "config", None)
+            param_dtype = getattr(mcfg, "param_dtype", None) or getattr(mcfg, "dtype", None)
+            params = dequantize_tree(params, dtype=param_dtype or "float32")
         return cls.build(
             generator.module,
-            generator.params,
+            params,
             generator.config,
             mesh=generator.mesh,
             partition_rules=getattr(generator, "partition_rules", None),
+            quantize=quantize,
             replicas=replicas,
             **engine_kwargs,
         )
@@ -561,7 +570,8 @@ class ReplicaSet:
                             for entry in per_replica
                         )
                         for key in ("hits", "misses", "tokens_avoided", "evictions",
-                                    "cow_copies", "cached_blocks", "pinned_blocks")
+                                    "cow_copies", "cached_blocks", "cached_bytes",
+                                    "pinned_blocks")
                     }
                 }
                 if any("prefix_cache" in entry for entry in per_replica)
