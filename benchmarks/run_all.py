@@ -43,6 +43,7 @@ SCRIPTS = {
     "quantized_serving": "bench_quantized_serving.py",
     "replica_serving": "bench_replica_serving.py",
     "observability": "bench_observability.py",
+    "fleet_health": "bench_fleet_health.py",
     "lint": "bench_lint.py",
     "int8_matmul": "bench_int8_matmul.py",
     "kv_cache": "bench_kv_cache.py",
@@ -69,12 +70,13 @@ if _cpu_extra - set(SCRIPTS):
 #: of two same-substrate runs, meaningful on the host CPU; prefix_cache pins
 #: the warm/cold TTFT ratio and tokens-avoided through one warm engine the
 #: same way; observability likewise pins the tracing on/off throughput ratio
-#: (host-side per-token bookkeeping, not chip throughput); quantized_serving
+#: (host-side per-token bookkeeping, not chip throughput) and fleet_health the
+#: health-engine on/off ratio under scrape-cadence polling; quantized_serving
 #: pins the int8-vs-bf16 resident-stream capacity ratio at a fixed KV-pool
 #: byte budget — a memory/scheduling property, same-substrate by construction
 CPU_ONLY = {
     "digits", "serving", "replica_serving", "continuous_stall", "prefix_cache",
-    "quantized_serving", "observability", "lint",
+    "quantized_serving", "observability", "fleet_health", "lint",
 } | _cpu_extra
 
 #: per-lane env overrides: lanes that reuse a script in a different mode

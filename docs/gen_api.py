@@ -62,6 +62,9 @@ MODULES = [
     "unionml_tpu.observability.trace",
     "unionml_tpu.observability.recorder",
     "unionml_tpu.observability.prometheus",
+    "unionml_tpu.observability.timeseries",
+    "unionml_tpu.observability.slo",
+    "unionml_tpu.observability.health",
     "unionml_tpu.analysis",
     "unionml_tpu.analysis.engine",
     "unionml_tpu.artifact",

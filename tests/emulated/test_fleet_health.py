@@ -1,0 +1,128 @@
+"""Fleet health on the emulated dp=2 x tp=2 mesh (the ISSUE-8 acceptance
+shape): driving synthetic load past a configured TTFT target on one replica
+flips its SLO state ok -> breach, pins the offending request's timeline as an
+exemplar (/debug/requests?slo=breach), and the replica scheduler measurably
+shifts subsequent traffic to the healthy replica — while /debug/fleet and the
+Prometheus exposition stay None-free."""
+
+import re
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from unionml_tpu.models import GenerationConfig, Llama, LlamaConfig, llama_partition_rules
+from unionml_tpu.observability import FlightRecorder, render_prometheus
+from unionml_tpu.observability.health import fleet_debug, fleet_health
+from unionml_tpu.observability.slo import SLOConfig
+from unionml_tpu.observability.trace import RequestTrace, bind, unbind
+from unionml_tpu.parallel import MeshSpec
+from unionml_tpu.serving import ReplicaSet
+
+pytestmark = pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 emulated devices")
+
+PROMPT_LEN = 12
+VOCAB = 96
+
+_PROM_LINE = re.compile(
+    r"^(# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* (counter|gauge|summary)|"
+    r"[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?[0-9.e+-]+(\n)?)$"
+)
+
+
+@pytest.fixture(scope="module")
+def replica_set():
+    config = LlamaConfig.tiny(
+        vocab_size=VOCAB, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, hidden_dim=128,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    module = Llama(config)
+    params = module.init(jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32))["params"]
+    mesh = MeshSpec(data=2, model=2).build(devices=jax.devices()[:4])
+    cfg = GenerationConfig(max_new_tokens=8, temperature=0.0, prompt_buckets=(16,))
+    rs = ReplicaSet.build(
+        module, params, cfg, mesh=mesh, partition_rules=llama_partition_rules(),
+        slots=2, decode_chunk=4,
+    )
+    yield rs
+    rs.close()
+
+
+def _drain(stream) -> int:
+    return sum(int(np.asarray(chunk).size) for chunk in stream)
+
+
+def _no_none(node) -> bool:
+    if isinstance(node, dict):
+        return all(_no_none(value) for value in node.values())
+    if isinstance(node, (list, tuple)):
+        return all(_no_none(v) for v in node)
+    return node is not None
+
+
+def test_breach_flips_state_pins_exemplar_and_shifts_routing(replica_set):
+    rs = replica_set
+    rng = np.random.default_rng(11)
+    prompts = [
+        [int(t) for t in rng.integers(1, VOCAB, size=PROMPT_LEN)] for _ in range(8)
+    ]
+    # arm an absurd TTFT target on replica 0 ONLY: any real request breaches it
+    # (replica 1 keeps the default unarmed config — a heterogeneous fleet)
+    rs.configure_slo(SLOConfig(ttft_p95_ms=1e-4, min_samples=1), replica=0)
+    assert rs.batchers[0].health(max_age_s=0)["state"] == "ok"  # armed but idle
+
+    # --- the offending request: traced, routed to replica 0 (idle fleet fills
+    # lowest-index first), its TTFT blows the target
+    recorder = FlightRecorder(8)
+    trace = RequestTrace("slo-victim", "POST", "/gen")
+    recorder.start(trace)
+    tokens = bind(trace.request_id, trace)
+    try:
+        produced = _drain(rs.submit(prompts[0]))
+    finally:
+        unbind(tokens)
+    trace.finish(200)
+    recorder.complete(trace)
+    assert produced > 0
+    assert rs._scheduler.submitted[0] == 1  # it DID land on replica 0
+
+    # the timeline self-identified as a breach exemplar, pinned in the ring
+    snap = trace.snapshot()
+    assert snap["slo_breach"]["objective"] == "ttft_p95_ms"
+    assert any(e["event"] == "slo.breach" for e in snap["events"])
+    exemplars = recorder.snapshot(slo_breach=True)
+    assert [s["request_id"] for s in exemplars["completed"]] == ["slo-victim"]
+
+    # --- replica 0 is now breaching; the fleet view agrees and stays None-free
+    assert rs.batchers[0].health(max_age_s=0)["state"] == "breach"
+    assert rs.batchers[1].health(max_age_s=0)["state"] == "ok"
+    fleet = fleet_health(rs)
+    assert fleet["state"] == "breach"
+    assert [r["state"] for r in fleet["replicas"]] == ["breach", "ok"]
+    assert fleet["worst_score"] < 0.5 <= fleet["replicas"][1]["score"]
+    assert _no_none(fleet)
+    debug = fleet_debug(rs)
+    assert debug["replicas"] == 2 and _no_none(debug)
+
+    # --- the scheduler routes around the breaching replica: every subsequent
+    # prompt lands on replica 1 even though replica 0 is equally (un)loaded
+    before = list(rs._scheduler.submitted)
+    for prompt in prompts[1:]:
+        _drain(rs.submit(prompt))
+    after = rs._scheduler.submitted
+    assert after[0] == before[0], "breaching replica kept receiving traffic"
+    assert after[1] == before[1] + len(prompts) - 1
+    assert rs.breach_avoided >= len(prompts) - 1
+
+    # --- the merged /metrics view renders as clean Prometheus exposition
+    stats = rs.stats()
+    assert stats["health"]["state"] == "breach"
+    assert stats["breach_avoided"] >= 1
+    text = render_prometheus({"requests_total": 0, "errors_total": 0, "generation": stats})
+    assert "None" not in text
+    for line in text.strip().splitlines():
+        assert _PROM_LINE.match(line), f"unparseable exposition line: {line!r}"
+    assert "unionml_tpu_generation_health_state_code 2" in text
+    assert "unionml_tpu_generation_breach_avoided" in text

@@ -1,0 +1,406 @@
+"""Fleet health & SLO engine (observability/{slo,health}.py + the serving
+integration): burn-rate state transitions, health scoring, breach exemplars,
+the route-around-breach scheduler policy, and the /healthz + /debug/fleet
+surface.
+
+The state-machine tests drive an injectable fake clock — no sleeps, no flakes.
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from unionml_tpu.observability.health import STATE_FACTORS, engine_health, fleet_debug, fleet_health
+from unionml_tpu.observability.recorder import FlightRecorder
+from unionml_tpu.observability.slo import SLOConfig, SLOTracker, worst_state
+from unionml_tpu.observability.timeseries import EngineTimeseries
+from unionml_tpu.observability.trace import RequestTrace
+from unionml_tpu.serving.metrics import LatencyWindow
+from unionml_tpu.serving.replicas import ReplicaScheduler
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _timeseries(clock) -> EngineTimeseries:
+    return EngineTimeseries(
+        clock=clock, horizon_s=700.0,
+        ttft=LatencyWindow(clock=clock), tbt=LatencyWindow(clock=clock),
+    )
+
+
+# ------------------------------------------------------------------ SLOConfig
+
+
+def test_slo_config_validation_and_armed():
+    assert not SLOConfig().armed
+    assert SLOConfig(ttft_p95_ms=250.0).armed
+    with pytest.raises(ValueError):
+        SLOConfig(ttft_p95_ms=-1.0)
+    with pytest.raises(ValueError):
+        SLOConfig(fast_window_s=120.0, slow_window_s=60.0)
+    with pytest.raises(ValueError):
+        SLOConfig(min_samples=0)
+
+
+def test_slo_config_from_env_warn_and_fall_back(monkeypatch):
+    monkeypatch.setenv("UNIONML_TPU_SLO_TTFT_P95_MS", "250")
+    monkeypatch.setenv("UNIONML_TPU_SLO_TBT_P99_MS", "garbage")  # degrades, no crash
+    monkeypatch.setenv("UNIONML_TPU_SLO_SHED_RATIO", "0.05")
+    config = SLOConfig.from_env()
+    assert config.ttft_p95_ms == 250.0
+    assert config.tbt_p99_ms is None
+    assert config.shed_ratio == 0.05
+    # cross-value garbage (fast > slow) widens the slow window instead of raising
+    monkeypatch.setenv("UNIONML_TPU_SLO_FAST_WINDOW_S", "900")
+    config = SLOConfig.from_env()
+    assert config.fast_window_s == 900.0 and config.slow_window_s == 900.0
+
+
+# ------------------------------------------------------- burn-rate transitions
+
+
+def test_burn_rate_state_machine_up_and_down():
+    """ok -> warn (fast window breaches, slow not yet) -> breach (both) ->
+    warn (fast recovers while the slow window still holds the incident) ->
+    ok (the incident ages out of the slow window): breach never snaps
+    straight to ok."""
+    clock = FakeClock()
+    ts = _timeseries(clock)
+    tracker = SLOTracker(SLOConfig(
+        ttft_p95_ms=100.0, fast_window_s=60.0, slow_window_s=600.0, min_samples=1,
+    ))
+    assert tracker.evaluate(ts)["state"] == "ok"  # idle engine is healthy
+
+    # healthy baseline: enough good samples that one bad one cannot move the
+    # slow window's p95
+    for _ in range(60):
+        ts.ttft.observe(0.010)
+    assert tracker.evaluate(ts)["state"] == "ok"
+
+    # fresh regression: the fast window sees only the bad samples, the slow
+    # p95 still rides the baseline -> warn (early warning, not yet confirmed)
+    clock.advance(120.0)
+    for _ in range(2):
+        ts.ttft.observe(0.500)
+    out = tracker.evaluate(ts)
+    assert out["state"] == "warn"
+    obj = out["objectives"]["ttft_p95_ms"]
+    assert obj["fast"]["value"] > 100.0 > obj["slow"]["value"]
+    assert obj["fast"]["burn_rate"] == pytest.approx(5.0)
+
+    # sustained: bad samples dominate the slow window too -> breach
+    for _ in range(60):
+        ts.ttft.observe(0.500)
+    assert tracker.evaluate(ts)["state"] == "breach"
+
+    # recovery: traffic stops; the fast window drains first -> warn, not ok
+    clock.advance(120.0)
+    assert tracker.evaluate(ts)["state"] == "warn"
+
+    # the slow window finally forgets the incident -> ok
+    clock.advance(600.0)
+    assert tracker.evaluate(ts)["state"] == "ok"
+
+
+def test_min_samples_gate_keeps_idle_engines_healthy():
+    clock = FakeClock()
+    ts = _timeseries(clock)
+    tracker = SLOTracker(SLOConfig(ttft_p95_ms=10.0, min_samples=3))
+    ts.ttft.observe(5.0)  # one terrible sample, below the gate
+    out = tracker.evaluate(ts)
+    assert out["state"] == "ok"
+    assert out["objectives"]["ttft_p95_ms"]["fast"]["samples"] == 1
+
+
+def test_shed_ratio_objective():
+    clock = FakeClock()
+    ts = _timeseries(clock)
+    tracker = SLOTracker(SLOConfig(shed_ratio=0.10, min_samples=5))
+    for _ in range(18):
+        ts.admissions.add()
+    ts.sheds.add(2)  # 10% exactly -> not a breach (> target, not >=)
+    assert tracker.evaluate(ts)["state"] == "ok"
+    ts.sheds.add(3)  # ~22% -> both windows over target
+    out = tracker.evaluate(ts)
+    assert out["state"] == "breach"
+    assert out["objectives"]["shed_ratio"]["fast"]["value"] > 0.10
+
+
+def test_worst_state_ordering():
+    assert worst_state([]) == "ok"
+    assert worst_state(["ok", "warn"]) == "warn"
+    assert worst_state(["warn", "breach", "ok"]) == "breach"
+
+
+# ----------------------------------------------------------- breach exemplars
+
+
+def test_note_marks_trace_and_counts_breaches():
+    tracker = SLOTracker(SLOConfig(ttft_p95_ms=100.0, tbt_p99_ms=50.0))
+    trace = RequestTrace("r-1", "POST", "/gen")
+    tracker.note_ttft(trace, 80.0)  # under target: no mark
+    assert trace.slo_breach is None and tracker.breached_requests == 0
+    tracker.note_ttft(trace, 250.0)
+    tracker.note_tbt(None, 75.0)  # untraced requests still count
+    assert tracker.breached_requests == 2
+    snap = trace.snapshot()
+    assert snap["slo_breach"]["objective"] == "ttft_p95_ms"
+    assert snap["slo_breach"]["observed_ms"] == pytest.approx(250.0)
+    assert any(e["event"] == "slo.breach" for e in snap["events"])
+
+
+def test_mark_slo_breach_keeps_worst_and_counts_repeats():
+    trace = RequestTrace("r-2", "GET", "/x")
+    trace.mark_slo_breach("tbt_p99_ms", 60.0, 50.0)
+    trace.mark_slo_breach("tbt_p99_ms", 90.0, 50.0)
+    trace.mark_slo_breach("tbt_p99_ms", 70.0, 50.0)
+    snap = trace.snapshot()
+    assert snap["slo_breach"]["count"] == 3
+    assert snap["slo_breach"]["observed_ms"] == pytest.approx(90.0)
+    # one slo.breach event, not one per stutter
+    assert sum(1 for e in snap["events"] if e["event"] == "slo.breach") == 1
+
+
+def _completed_trace(recorder, rid, breach=False, duration_s=0.0):
+    trace = RequestTrace(rid, "POST", "/gen")
+    recorder.start(trace)
+    if breach:
+        trace.mark_slo_breach("ttft_p95_ms", 500.0, 100.0)
+    if duration_s:
+        # seal with a synthetic duration by back-dating t0 (monotonic offsets)
+        trace.t0 -= duration_s
+    trace.finish(200)
+    recorder.complete(trace)
+    return trace
+
+
+def test_recorder_pins_breaching_timelines_into_exemplar_ring():
+    recorder = FlightRecorder(4, exemplar_capacity=8)
+    _completed_trace(recorder, "ok-1")
+    _completed_trace(recorder, "bad-1", breach=True)
+    for i in range(6):  # churn the main ring far past capacity
+        _completed_trace(recorder, f"churn-{i}")
+    assert recorder.exemplar_count == 1
+    snap = recorder.snapshot(slo_breach=True)
+    assert [s["request_id"] for s in snap["completed"]] == ["bad-1"]
+    assert snap["exemplars"] == 1
+    # the exemplar outlived its eviction from the main ring
+    assert all(s["request_id"] != "bad-1" for s in recorder.snapshot()["completed"])
+    assert recorder.get("bad-1")["slo_breach"]["objective"] == "ttft_p95_ms"
+
+
+def test_recorder_min_ms_filter_and_duration_in_list_view():
+    recorder = FlightRecorder(8)
+    _completed_trace(recorder, "fast", duration_s=0.001)
+    _completed_trace(recorder, "slow", duration_s=2.0)
+    snap = recorder.snapshot()
+    assert all("duration_ms" in s for s in snap["completed"])
+    slow_only = recorder.snapshot(min_ms=1000.0)
+    assert [s["request_id"] for s in slow_only["completed"]] == ["slow"]
+    assert slow_only["completed"][0]["duration_ms"] >= 1000.0
+
+
+# ------------------------------------------------------------- health scoring
+
+
+class FakeEngine:
+    """Duck-typed engine surface health.engine_health consumes."""
+
+    slots = 4
+    max_waiting = 8
+    _load_norm = 16.0
+
+    def __init__(self, clock, config=None, resident=0, waiting=0, backlog=0):
+        self.timeseries = _timeseries(clock)
+        self.slo = SLOTracker(config or SLOConfig(ttft_p95_ms=100.0, min_samples=1))
+        self._occ = (resident, waiting)
+        self._backlog = backlog
+
+    def occupancy(self):
+        return self._occ
+
+    def queued_prefill_tokens(self):
+        return self._backlog
+
+    def rates(self, window_s=None):
+        return self.timeseries.rates(window_s or 60.0)
+
+    def health(self):
+        return engine_health(self)
+
+
+def test_engine_health_scores_states_and_saturation():
+    clock = FakeClock()
+    idle = FakeEngine(clock)
+    h = idle.health()
+    assert h == {**h, "score": 1.0, "state": "ok", "state_code": 0, "enabled": True}
+    assert h["saturation"]["max"] == 0.0
+
+    saturated = FakeEngine(clock, resident=4, waiting=8, backlog=64)
+    h = saturated.health()
+    assert h["state"] == "ok"
+    assert h["saturation"]["slots"] == 1.0 and h["saturation"]["prefill_backlog"] == 1.0
+    assert h["score"] == pytest.approx(0.5)  # loaded-but-meeting-SLO floors at 0.5
+
+    breaching = FakeEngine(clock)
+    breaching.timeseries.ttft.observe(0.500)
+    h = breaching.health()
+    assert h["state"] == "breach" and h["state_code"] == 2
+    assert h["score"] == pytest.approx(STATE_FACTORS["breach"])
+    # any breaching replica scores strictly below any non-breaching one
+    assert h["score"] < 0.5
+
+
+def test_engine_health_payload_is_none_free_and_prometheus_clean():
+    from unionml_tpu.observability import render_prometheus
+
+    clock = FakeClock()
+    engine = FakeEngine(clock)
+    engine.timeseries.ttft.observe(0.500)
+
+    def no_none(node):
+        if isinstance(node, dict):
+            return all(no_none(v) for v in node.values())
+        if isinstance(node, (list, tuple)):
+            return all(no_none(v) for v in node)
+        return node is not None
+
+    fleet = fleet_health(engine)
+    assert no_none(fleet)
+    text = render_prometheus({"requests_total": 0, "errors_total": 0, "fleet": fleet})
+    assert "None" not in text
+    assert "unionml_tpu_fleet_score" in text
+    assert "unionml_tpu_fleet_state_code 2" in text
+
+
+def test_fleet_health_aggregates_mean_worst_and_state():
+    clock = FakeClock()
+
+    class Fleet:
+        def __init__(self, engines):
+            self.batchers = tuple(engines)
+
+    good, bad = FakeEngine(clock), FakeEngine(clock)
+    bad.timeseries.ttft.observe(0.500)
+    fleet = fleet_health(Fleet([good, bad]))
+    assert fleet["state"] == "breach"
+    assert fleet["worst_score"] == pytest.approx(STATE_FACTORS["breach"])
+    assert fleet["score"] == pytest.approx((1.0 + STATE_FACTORS["breach"]) / 2)
+    assert [r["replica"] for r in fleet["replicas"]] == [0, 1]
+    # a telemetry-disabled engine reads as a healthy, routable replica
+    class Bare:
+        pass
+    fleet = fleet_health(Fleet([Bare()]))
+    assert fleet["replicas"][0] == {"replica": 0, "score": 1.0, "state": "ok",
+                                    "state_code": 0, "enabled": False}
+    assert fleet_health(None)["replicas"] == []
+
+
+# ------------------------------------------------- route-around-breach policy
+
+
+def test_scheduler_order_deprioritizes_breaching_replicas():
+    sched = ReplicaScheduler(3)
+    loads = [0.0, 5.0, 9.0]
+    assert sched.order(loads)[0] == [0, 1, 2]
+    # the least-loaded replica is breaching: it sinks below every healthy one
+    order, _ = sched.order(loads, breaching=[True, False, False])
+    assert order == [1, 2, 0]
+    # everyone breaching degrades to plain least-loaded (serve, don't shed)
+    order, _ = sched.order(loads, breaching=[True, True, True])
+    assert order == [0, 1, 2]
+
+
+def test_scheduler_affinity_head_disqualified_by_breach():
+    sched = ReplicaScheduler(2, affinity_tokens=2, affinity_margin=8)
+    prompt = [7, 7, 1]
+    sched.note(0, prompt)  # prefix lives on replica 0
+    order, affinity = sched.order([3.0, 0.0], prompt)
+    assert order[0] == 0 and affinity  # warm prefix beats load within margin
+    order, affinity = sched.order([3.0, 0.0], prompt, breaching=[True, False])
+    assert order == [1, 0] and not affinity  # breach overrides warm affinity
+
+
+def test_scheduler_cached_routing_respects_breach():
+    sched = ReplicaScheduler(2, affinity_margin=8)
+    cached = [128, 0]
+    order, affinity = sched.order([2.0, 0.0], [1, 2, 3], cached)
+    assert order[0] == 0 and affinity
+    order, affinity = sched.order([2.0, 0.0], [1, 2, 3], cached, breaching=[True, False])
+    assert order == [1, 0] and not affinity
+
+
+# ------------------------------------------------------- serving app surface
+
+
+@pytest.fixture
+def app(sklearn_model):
+    sklearn_model.train(hyperparameters={"max_iter": 500})
+    from unionml_tpu.serving.app import ServingApp
+
+    app = ServingApp(sklearn_model)
+    app.configure_observability(trace=True, flight_recorder_size=16, access_log=False)
+    return app
+
+
+def _dispatch(app, method, path, body=b""):
+    async def run():
+        app.startup()
+        return await app.server.dispatch(method, path, body)
+
+    return asyncio.run(run())
+
+
+def test_healthz_detailed_and_health_stays_bare(app):
+    status, payload, ctype = _dispatch(app, "GET", "/healthz")
+    assert status == 200 and ctype == "application/json"
+    assert payload["ready"] is True and payload["state"] == "ok"
+    assert payload["score"] == 1.0 and payload["replicas"] == []
+    # /health keeps the reference's bare readiness shape — no health fields
+    status, bare, _ = _dispatch(app, "GET", "/health")
+    assert status == 200 and "score" not in bare and bare["ready"] is True
+
+
+def test_healthz_answers_503_while_draining(app):
+    _dispatch(app, "GET", "/health")  # force startup
+    app.server.draining = True
+    try:
+        status, payload, _ = _dispatch(app, "GET", "/healthz")
+        assert status == 503 and payload["ready"] is False
+    finally:
+        app.server.draining = False
+
+
+def test_debug_fleet_endpoint(app):
+    status, payload, _ = _dispatch(app, "GET", "/debug/fleet")
+    assert status == 200
+    assert payload["replicas"] == 0 and payload["health"]["state"] == "ok"
+    assert payload["tracing"] is True and payload["exemplars"] == 0
+
+
+def test_debug_requests_min_ms_and_slo_filters(app):
+    _dispatch(app, "GET", "/health")
+    status, payload, _ = _dispatch(app, "GET", "/debug/requests?min_ms=3600000")
+    assert status == 200 and payload["completed"] == []
+    status, payload, _ = _dispatch(app, "GET", "/debug/requests?min_ms=soon")
+    assert status == 400
+    status, payload, _ = _dispatch(app, "GET", "/debug/requests?slo=warn")
+    assert status == 400 and "breach" in payload["detail"]
+    # pin an exemplar by hand and fetch it through the filter
+    _completed_trace(app.recorder, "exemplar-1", breach=True)
+    status, payload, _ = _dispatch(app, "GET", "/debug/requests?slo=breach")
+    assert status == 200
+    assert [s["request_id"] for s in payload["completed"]] == ["exemplar-1"]
+    status, payload, _ = _dispatch(app, "GET", "/debug/fleet")
+    assert payload["exemplars"] == 1

@@ -1,0 +1,209 @@
+"""Windowed time-series telemetry (observability/timeseries.py): bucket
+rotation, clock-skip, empty-window semantics, and the LatencyWindow freshness
++ lock-contention satellites (serving/metrics.py).
+
+Every test drives an injectable fake clock — no sleeps, no flakes.
+"""
+
+import threading
+
+import pytest
+
+from unionml_tpu.observability.timeseries import BucketRing, EngineTimeseries
+from unionml_tpu.serving.metrics import LatencyWindow
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ------------------------------------------------------------------ BucketRing
+
+
+def test_bucket_ring_windows_and_rates():
+    clock = FakeClock()
+    ring = BucketRing(width_s=1.0, buckets=10, clock=clock)
+    assert ring.count(5.0) == 0 and ring.rate(5.0) == 0.0  # empty window
+    ring.add(3)
+    clock.advance(1.0)
+    ring.add(2)
+    assert ring.total() == 5
+    assert ring.count(2.0) == 5
+    assert ring.count(1.0) == 2  # only the current bucket
+    assert ring.rate(2.0) == pytest.approx(2.5)
+
+
+def test_bucket_ring_rotation_evicts_old_buckets():
+    clock = FakeClock()
+    ring = BucketRing(width_s=1.0, buckets=4, clock=clock)
+    ring.add(10)
+    clock.advance(2.0)
+    assert ring.count(4.0) == 10  # still inside the window
+    clock.advance(3.0)  # bucket 0's slot has been lapped (ring of 4)
+    assert ring.count(4.0) == 0
+    assert ring.total() == 10  # lifetime total survives rotation
+
+
+def test_bucket_ring_clock_skip_reads_as_silence():
+    clock = FakeClock()
+    ring = BucketRing(width_s=1.0, buckets=8, clock=clock)
+    ring.add(7)
+    clock.advance(100.0)  # a suspended host / stalled thread
+    assert ring.count(8.0) == 0  # no stale counts resurface
+    ring.add(1)
+    assert ring.count(1.0) == 1  # the lapped slot was zeroed before reuse
+
+
+def test_bucket_ring_window_wider_than_ring_never_double_counts():
+    clock = FakeClock()
+    ring = BucketRing(width_s=1.0, buckets=4, clock=clock)
+    for _ in range(4):
+        ring.add(1)
+        clock.advance(1.0)
+    # window of 100s over a 4-bucket ring: reads the horizon, not 25 laps
+    assert ring.count(100.0) <= 4
+
+
+def test_bucket_ring_clear_and_validation():
+    clock = FakeClock()
+    ring = BucketRing(width_s=0.5, buckets=4, clock=clock)
+    ring.add(5)
+    ring.clear()
+    assert ring.total() == 0 and ring.count(2.0) == 0
+    with pytest.raises(ValueError):
+        BucketRing(width_s=0.0)
+    with pytest.raises(ValueError):
+        BucketRing(buckets=0)
+    with pytest.raises(ValueError):
+        ring.count(0.0)
+
+
+# ------------------------------------------------------------ EngineTimeseries
+
+
+def test_engine_timeseries_rates_snapshot_never_none():
+    clock = FakeClock()
+    ts = EngineTimeseries(
+        clock=clock, horizon_s=30.0,
+        ttft=LatencyWindow(clock=clock), tbt=LatencyWindow(clock=clock),
+    )
+    snap = ts.rates(10.0)
+    assert snap["tokens_per_s"] == 0.0 and snap["shed_ratio"] == 0.0
+    assert snap["ttft_ms"] == {"window": 0} and snap["tbt_ms"] == {"window": 0}
+    assert all(value is not None for value in snap.values())
+
+    ts.tokens.add(40)
+    ts.admissions.add(3)
+    ts.sheds.add(1)
+    ts.ttft.observe(0.050)
+    snap = ts.rates(10.0)
+    assert snap["tokens_per_s"] == pytest.approx(4.0)
+    assert snap["shed_ratio"] == pytest.approx(0.25)
+    assert snap["ttft_ms"]["window"] == 1
+
+
+def test_engine_timeseries_shed_ratio_and_arrivals():
+    clock = FakeClock()
+    ts = EngineTimeseries(clock=clock, horizon_s=30.0)
+    assert ts.shed_ratio(10.0) == 0.0  # no arrivals -> 0, not a ZeroDivision
+    ts.admissions.add(8)
+    ts.sheds.add(2)
+    assert ts.arrivals(10.0) == 10
+    assert ts.shed_ratio(10.0) == pytest.approx(0.2)
+    clock.advance(15.0)  # everything ages out of the window
+    assert ts.shed_ratio(10.0) == 0.0
+
+
+# ------------------------------------------- LatencyWindow freshness satellite
+
+
+def test_latency_window_snapshot_reports_freshness_ages():
+    clock = FakeClock(100.0)
+    win = LatencyWindow(clock=clock)
+    win.observe(0.010)
+    clock.advance(2.0)
+    win.observe(0.030)
+    clock.advance(1.0)
+    snap = win.snapshot()
+    assert snap["window"] == 2
+    assert snap["newest_age_ms"] == pytest.approx(1000.0)
+    assert snap["oldest_age_ms"] == pytest.approx(3000.0)
+    # the {"window": 0} contract is untouched: no ages, no None values
+    assert LatencyWindow(clock=clock).snapshot() == {"window": 0}
+
+
+def test_latency_window_time_decayed_percentiles():
+    clock = FakeClock()
+    win = LatencyWindow(clock=clock)
+    win.observe(1.0)  # an ancient 1000ms sample
+    clock.advance(120.0)
+    win.observe(0.010)
+    win.observe(0.012)
+    full = win.snapshot()
+    assert full["window"] == 3 and full["max_ms"] == pytest.approx(1000.0)
+    recent = win.snapshot(window_s=60.0)
+    assert recent["window"] == 2
+    assert recent["max_ms"] == pytest.approx(12.0)  # the stale sample decayed out
+    # a window no sample survives reports empty, not None gauges
+    clock.advance(120.0)
+    assert win.snapshot(window_s=60.0) == {"window": 0}
+
+
+# ----------------------------------------- LatencyWindow contention satellite
+
+
+def test_latency_window_snapshot_sorts_outside_the_lock():
+    """The /metrics-scrape stall regression: sorting the 10k-deep reservoir
+    must happen on a copy OUTSIDE the producer lock, so observe() (the token
+    emission path) is never blocked behind a scrape. Deterministic probe: the
+    sample values record whether the window's lock was held at each sort
+    comparison."""
+    win = LatencyWindow()
+    held = []
+
+    class Probe(float):
+        def __lt__(self, other):  # sorted() drives comparisons through this
+            held.append(win._lock.locked())
+            return float.__lt__(self, other)
+
+    for i in range(64):
+        win.observe(Probe(i % 7))
+    snap = win.snapshot()
+    assert snap["window"] == 64
+    assert held, "sort never ran"
+    assert not any(held), "snapshot sorted while holding the producer lock"
+
+
+def test_latency_window_observe_concurrent_with_snapshots():
+    """Producers and scrapers hammering one window: no exceptions, sane
+    snapshots (the copy-then-sort path is safe under concurrency)."""
+    win = LatencyWindow(window=512)
+    stop = threading.Event()
+    errors = []
+
+    def produce():
+        try:
+            while not stop.is_set():
+                win.observe(0.001)
+        except Exception as exc:  # pragma: no cover
+            errors.append(exc)
+
+    threads = [threading.Thread(target=produce) for _ in range(2)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(200):
+            snap = win.snapshot()
+            assert snap == {"window": 0} or snap["p50_ms"] == pytest.approx(1.0)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not errors

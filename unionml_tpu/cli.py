@@ -314,6 +314,22 @@ def fetch_model(
     help="directory for on-demand POST /debug/profile jax.profiler captures "
     "(unset disables the endpoint)",
 )
+@click.option(
+    "--slo-ttft-p95-ms", default=None, type=float,
+    help="SLO: time-to-first-token p95 target in ms, evaluated with multi-window "
+    "burn rates (ok/warn/breach on /healthz); breaching requests pin their "
+    "timelines as exemplars and the replica scheduler routes around a breaching "
+    "replica (0 = disarmed)",
+)
+@click.option(
+    "--slo-tbt-p99-ms", default=None, type=float,
+    help="SLO: time-between-tokens p99 target in ms (0 = disarmed)",
+)
+@click.option(
+    "--slo-shed-ratio", default=None, type=float,
+    help="SLO: tolerated fraction of arrivals shed with 429/503 over the burn-rate "
+    "windows, e.g. 0.01 (0 = disarmed)",
+)
 def serve(
     app_ref: str,
     model_path: Optional[Path],
@@ -340,6 +356,9 @@ def serve(
     flight_recorder_size: Optional[int],
     log_format: Optional[str],
     profile_dir: Optional[Path],
+    slo_ttft_p95_ms: Optional[float],
+    slo_tbt_p99_ms: Optional[float],
+    slo_shed_ratio: Optional[float],
 ) -> None:
     """Start the HTTP prediction service (reference cli.py:172-205).
 
@@ -393,6 +412,17 @@ def serve(
     and ``--profile-dir`` enables on-demand ``POST /debug/profile`` captures.
     All exported as env vars before the app module imports, so engines and
     loggers built at import time see them.
+
+    SLOs and fleet health (docs/observability.md "SLOs and fleet health"):
+    ``--slo-ttft-p95-ms`` / ``--slo-tbt-p99-ms`` / ``--slo-shed-ratio``
+    declare targets every continuous engine evaluates with multi-window burn
+    rates (fast window pages, slow window confirms the trend) through an
+    ok→warn→breach state machine. ``GET /healthz`` reports the fleet health
+    score with per-replica windowed rates and SLO states, ``GET /debug/fleet``
+    adds the routing view, requests that individually blow a target are pinned
+    as exemplars at ``/debug/requests?slo=breach``, and the replica scheduler
+    routes new work around a breaching replica. Same early-export contract as
+    the other knobs (``UNIONML_TPU_SLO_*``).
     """
     if dp_replicas is not None:
         if dp_replicas < 0:
@@ -433,6 +463,23 @@ def serve(
             # same early-export contract as --dp-replicas: engines built at
             # app-module import time must see the knobs
             os.environ[getattr(_defaults, env_name)] = str(value)
+    slo_knobs = (
+        ("--slo-ttft-p95-ms", slo_ttft_p95_ms, "SERVE_SLO_TTFT_P95_MS_ENV_VAR"),
+        ("--slo-tbt-p99-ms", slo_tbt_p99_ms, "SERVE_SLO_TBT_P99_MS_ENV_VAR"),
+        ("--slo-shed-ratio", slo_shed_ratio, "SERVE_SLO_SHED_RATIO_ENV_VAR"),
+    )
+    if any(value is not None for _, value, _ in slo_knobs):
+        from unionml_tpu import defaults as _defaults
+
+        for flag, value, env_name in slo_knobs:
+            if value is None:
+                continue
+            if value < 0:
+                raise click.ClickException(f"{flag} must be >= 0 (0 = disarmed)")
+            # same early-export contract as --dp-replicas: every continuous
+            # engine's SLO tracker reads the env at construction, so engines
+            # built at app-module import time get the targets too
+            os.environ[getattr(_defaults, env_name)] = repr(value)
     # observability knobs: same early-export contract as --dp-replicas (the
     # serving app reads them at construction; reload/fork children inherit)
     if trace is not None or flight_recorder_size is not None or profile_dir is not None:

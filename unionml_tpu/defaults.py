@@ -145,6 +145,37 @@ SERVE_PROFILE_DIR_ENV_VAR = "UNIONML_TPU_PROFILE_DIR"
 #: must not leave the profiler running for hours.
 SERVE_PROFILE_MAX_MS = 60_000.0
 
+# ------------------------------------------------------------ SLOs / fleet health
+# Declarative serving SLO targets (observability/slo.py, docs/observability.md
+# "SLOs and fleet health"). Same early-export contract as the knobs above: the
+# serve CLI sets the env vars before the app module imports, and every
+# continuous engine's SLO tracker reads them at construction. 0/unset disarms
+# an objective — an engine with no targets evaluates as healthy.
+
+#: TTFT p95 target in ms over the burn-rate windows (0 = disarmed).
+SERVE_SLO_TTFT_P95_MS_ENV_VAR = "UNIONML_TPU_SLO_TTFT_P95_MS"
+
+#: TBT p99 target in ms (0 = disarmed).
+SERVE_SLO_TBT_P99_MS_ENV_VAR = "UNIONML_TPU_SLO_TBT_P99_MS"
+
+#: tolerated shed fraction of arrivals, e.g. 0.01 (0 = disarmed).
+SERVE_SLO_SHED_RATIO_ENV_VAR = "UNIONML_TPU_SLO_SHED_RATIO"
+
+#: fast burn-rate window (seconds): the paging window — a breach needs the
+#: fast window over target, so a long-gone incident cannot page.
+SERVE_SLO_FAST_WINDOW_S_ENV_VAR = "UNIONML_TPU_SLO_FAST_WINDOW_S"
+SERVE_SLO_FAST_WINDOW_S = 60.0
+
+#: slow burn-rate window (seconds): the trend confirmation — breach requires
+#: BOTH windows over target; one alone is warn.
+SERVE_SLO_SLOW_WINDOW_S_ENV_VAR = "UNIONML_TPU_SLO_SLOW_WINDOW_S"
+SERVE_SLO_SLOW_WINDOW_S = 600.0
+
+#: samples (or arrivals, for the shed ratio) a window needs before it can
+#: breach: an idle engine is healthy, not failing.
+SERVE_SLO_MIN_SAMPLES_ENV_VAR = "UNIONML_TPU_SLO_MIN_SAMPLES"
+SERVE_SLO_MIN_SAMPLES = 3
+
 
 def env_int(name: str, default: int, *, minimum: "int | None" = None) -> int:
     """Parse an integer env var, tolerating garbage: unset/empty -> ``default``,
@@ -273,3 +304,36 @@ def serve_profile_dir() -> "str | None":
     endpoint is disabled."""
     raw = os.environ.get(SERVE_PROFILE_DIR_ENV_VAR)
     return raw.strip() or None if raw is not None else None
+
+
+def serve_slo_ttft_p95_ms() -> float:
+    """Serve-time TTFT p95 SLO target in ms; 0.0 = disarmed. Read at engine
+    construction (after the CLI's early export), same contract as
+    :func:`serve_admit_chunk` — garbage warns and falls back, never crashes
+    serve at app-import time."""
+    return env_float(SERVE_SLO_TTFT_P95_MS_ENV_VAR, 0.0, minimum=0.0)
+
+
+def serve_slo_tbt_p99_ms() -> float:
+    """Serve-time TBT p99 SLO target in ms; 0.0 = disarmed."""
+    return env_float(SERVE_SLO_TBT_P99_MS_ENV_VAR, 0.0, minimum=0.0)
+
+
+def serve_slo_shed_ratio() -> float:
+    """Serve-time shed-ratio SLO target (fraction of arrivals); 0.0 = disarmed."""
+    return env_float(SERVE_SLO_SHED_RATIO_ENV_VAR, 0.0, minimum=0.0)
+
+
+def serve_slo_fast_window_s() -> float:
+    """Fast burn-rate window in seconds (the paging window)."""
+    return env_float(SERVE_SLO_FAST_WINDOW_S_ENV_VAR, SERVE_SLO_FAST_WINDOW_S, minimum=1.0)
+
+
+def serve_slo_slow_window_s() -> float:
+    """Slow burn-rate window in seconds (the trend-confirmation window)."""
+    return env_float(SERVE_SLO_SLOW_WINDOW_S_ENV_VAR, SERVE_SLO_SLOW_WINDOW_S, minimum=1.0)
+
+
+def serve_slo_min_samples() -> int:
+    """Samples a window needs before it can breach (idle engines stay healthy)."""
+    return env_int(SERVE_SLO_MIN_SAMPLES_ENV_VAR, SERVE_SLO_MIN_SAMPLES, minimum=1)

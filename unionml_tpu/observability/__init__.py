@@ -13,15 +13,29 @@ Three layers, each usable alone (docs/observability.md):
   ``GET /debug/requests`` and dumped to the log on drain / engine failure;
 - :mod:`~unionml_tpu.observability.prometheus` — the Prometheus text
   exposition of the ``/metrics`` snapshot
-  (``GET /metrics?format=prometheus``).
+  (``GET /metrics?format=prometheus``);
+- :mod:`~unionml_tpu.observability.timeseries` — windowed time-series
+  telemetry (:class:`~unionml_tpu.observability.timeseries.BucketRing` /
+  :class:`~unionml_tpu.observability.timeseries.EngineTimeseries`): the
+  engine's counters as rates over trailing windows, time-decaying TTFT/TBT
+  percentiles;
+- :mod:`~unionml_tpu.observability.slo` — declarative SLO targets evaluated
+  with multi-window burn rates through an ok→warn→breach state machine, plus
+  per-request breach exemplars;
+- :mod:`~unionml_tpu.observability.health` — per-engine and fleet-wide health
+  scores (SLO state x saturation) behind ``GET /healthz`` /
+  ``GET /debug/fleet`` and the replica scheduler's route-around-breach.
 
 Knobs flow the established serving path: engine/app kwargs <- ``serve
---trace/--flight-recorder-size/--log-format/--profile-dir`` <-
+--trace/--flight-recorder-size/--log-format/--profile-dir/--slo-*`` <-
 ``UNIONML_TPU_*`` env vars via :mod:`unionml_tpu.defaults`.
 """
 
+from unionml_tpu.observability.health import engine_health, fleet_debug, fleet_health
 from unionml_tpu.observability.prometheus import render as render_prometheus
 from unionml_tpu.observability.recorder import FlightRecorder, active_recorder, set_active_recorder
+from unionml_tpu.observability.slo import SLOConfig, SLOTracker
+from unionml_tpu.observability.timeseries import BucketRing, EngineTimeseries
 from unionml_tpu.observability.trace import (
     REQUEST_ID_HEADER,
     RequestTrace,
@@ -34,14 +48,21 @@ from unionml_tpu.observability.trace import (
 )
 
 __all__ = [
+    "BucketRing",
+    "EngineTimeseries",
     "FlightRecorder",
     "REQUEST_ID_HEADER",
     "RequestTrace",
+    "SLOConfig",
+    "SLOTracker",
     "Span",
     "Tracer",
     "active_recorder",
     "current_request_id",
     "current_trace",
+    "engine_health",
+    "fleet_debug",
+    "fleet_health",
     "new_request_id",
     "render_prometheus",
     "sanitize_request_id",

@@ -1,0 +1,161 @@
+"""Per-engine and fleet-wide health: SLO state x saturation as one score.
+
+The signal the replica scheduler, ``GET /healthz``, ``GET /debug/fleet`` and
+(through the roadmap's item 2) an autoscaler act on. Two inputs per engine:
+
+- **SLO state** (observability/slo.py): is the engine meeting its declared
+  latency/shed targets over the burn-rate windows;
+- **saturation**: how much headroom is left — resident-slot occupancy, the
+  waiting queue's fill, KV-pool block usage, and the prefill backlog
+  normalized by the admission chunk (each already a gauge the engine keeps).
+
+The score is ``state_factor * (1 - 0.5 * saturation)`` in ``[0, 1]``: an ok
+engine ranges 1.0 (idle) down to 0.5 (fully saturated but still meeting its
+SLOs — loaded is not unhealthy), a warn engine starts from 0.6, a breaching
+engine from 0.2 — so any breaching replica scores strictly below any
+non-breaching one, which is exactly the ordering the scheduler's
+route-around-breach policy needs. Fleet health reports the mean score (the
+autoscaling signal), the worst score, and the worst state (the paging
+signal): a 4-replica fleet with one breach is ``state="breach"`` even though
+its mean still looks comfortable.
+
+Everything here is duck-typed over the engine surface (``occupancy()``,
+``queued_prefill_tokens()``, ``timeseries``, ``slo``) so a
+:class:`~unionml_tpu.serving.continuous.ContinuousBatcher`, a
+:class:`~unionml_tpu.serving.replicas.ReplicaSet`, or a test double all work;
+every leaf in every payload is numeric or a state string (strings are skipped
+by the Prometheus exposition — ``state_code``/``score`` are the series), and
+``None`` never appears.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from unionml_tpu.observability.slo import STATE_CODES, worst_state
+
+__all__ = ["STATE_FACTORS", "engine_health", "fleet_health", "fleet_debug"]
+
+#: SLO state -> score ceiling: breach < warn < ok with no overlap once the
+#: saturation discount (at most 0.5x) is applied
+STATE_FACTORS = {"ok": 1.0, "warn": 0.6, "breach": 0.2}
+
+#: the health dict of an engine with telemetry disabled (slo=False): always
+#: routable, never breaching — the pre-health-engine behavior
+DISABLED: Dict[str, Any] = {
+    "score": 1.0,
+    "state": "ok",
+    "state_code": 0,
+    "enabled": False,
+}
+
+
+def _fraction(num: float, den: float) -> float:
+    """num/den clipped into [0, 1]; 0.0 for a degenerate denominator."""
+    if den <= 0:
+        return 0.0
+    return min(max(num / den, 0.0), 1.0)
+
+
+def engine_health(engine: Any) -> Dict[str, Any]:
+    """One engine's health: SLO evaluation + saturation gauges + fast-window
+    rates, combined into the score. Called by
+    ``ContinuousBatcher.health()`` (which caches it briefly — this walks a few
+    locks and sorts the windowed reservoirs, so the per-submit routing path
+    reads the cache, not this)."""
+    timeseries = getattr(engine, "timeseries", None)
+    tracker = getattr(engine, "slo", None)
+    if timeseries is None or tracker is None:
+        return dict(DISABLED)
+    resident, waiting = engine.occupancy()
+    slots = int(getattr(engine, "slots", 0) or 0)
+    max_waiting = int(getattr(engine, "max_waiting", 0) or 0)
+    backlog = int(engine.queued_prefill_tokens())
+    saturation = {
+        "slots": round(_fraction(resident, slots), 3),
+        "waiting": round(_fraction(waiting, max_waiting), 3),
+        # backlog in units of (admission chunks x slots): a full iteration of
+        # queued prefill for every slot counts as saturated
+        "prefill_backlog": round(
+            _fraction(backlog, float(getattr(engine, "_load_norm", 0.0) or 1.0) * max(slots, 1)),
+            3,
+        ),
+    }
+    pool_blocks = getattr(engine, "pool_blocks", None)
+    free_blocks = getattr(engine, "_free_blocks", None)
+    if pool_blocks and free_blocks is not None:
+        saturation["kv_blocks"] = round(
+            _fraction(pool_blocks - len(free_blocks), pool_blocks), 3
+        )
+    worst_saturation = max(saturation.values())
+    saturation["max"] = worst_saturation
+    slo = (
+        tracker.evaluate(timeseries)
+        if tracker.armed
+        else {"state": "ok", "state_code": 0, "breached_requests": tracker.breached_requests,
+              "objectives": {}}
+    )
+    state = slo["state"]
+    score = STATE_FACTORS.get(state, 0.0) * (1.0 - 0.5 * worst_saturation)
+    return {
+        "score": round(score, 3),
+        "state": state,
+        "state_code": STATE_CODES.get(state, 0),
+        "enabled": True,
+        "saturation": saturation,
+        "slo": slo,
+        "rates": engine.rates(),
+    }
+
+
+def _engines(batcher: Any) -> "List[Any]":
+    """The per-replica engines behind a batcher-shaped object (a ReplicaSet's
+    ``batchers`` tuple), or the object itself as a one-engine fleet."""
+    replicas = getattr(batcher, "batchers", None)
+    return list(replicas) if replicas is not None else [batcher]
+
+
+def _replica_health(engine: Any, index: int) -> Dict[str, Any]:
+    health_fn = getattr(engine, "health", None)
+    health = health_fn() if callable(health_fn) else dict(DISABLED)
+    return {"replica": index, **health}
+
+
+def fleet_health(batcher: Optional[Any]) -> Dict[str, Any]:
+    """The ``GET /healthz`` payload body: fleet score/state plus each
+    replica's health (score, SLO states, saturation, windowed rates). A
+    ``None`` batcher (an app with no generation engine) is a healthy empty
+    fleet — the probe still answers, with the HTTP layer's own readiness."""
+    if batcher is None:
+        return {"score": 1.0, "worst_score": 1.0, "state": "ok", "state_code": 0, "replicas": []}
+    entries = [_replica_health(engine, i) for i, engine in enumerate(_engines(batcher))]
+    scores = [entry["score"] for entry in entries]
+    state = worst_state(entry["state"] for entry in entries)
+    return {
+        "score": round(sum(scores) / len(scores), 3),
+        "worst_score": min(scores),
+        "state": state,
+        "state_code": STATE_CODES[state],
+        "replicas": entries,
+    }
+
+
+def fleet_debug(batcher: Optional[Any]) -> Dict[str, Any]:
+    """The ``GET /debug/fleet`` payload: :func:`fleet_health` plus the routing
+    view — per-replica live loads and the scheduler's telemetry — so one fetch
+    answers "who is unhealthy AND where is traffic actually going"."""
+    out: Dict[str, Any] = {"health": fleet_health(batcher)}
+    if batcher is None:
+        out["replicas"] = 0
+        return out
+    out["replicas"] = len(_engines(batcher))
+    loads_fn = getattr(batcher, "replica_loads", None)
+    if callable(loads_fn):
+        out["replica_loads"] = loads_fn()
+    scheduler = getattr(batcher, "_scheduler", None)
+    if scheduler is not None and callable(getattr(scheduler, "stats", None)):
+        out["scheduler"] = scheduler.stats()
+    breach_avoided = getattr(batcher, "breach_avoided", None)
+    if breach_avoided is not None:
+        out["breach_avoided"] = int(breach_avoided)
+    return out

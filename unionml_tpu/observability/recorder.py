@@ -49,15 +49,29 @@ def active_recorder() -> "Optional[FlightRecorder]":
 
 
 class FlightRecorder:
-    """Bounded ring of completed request traces + live in-flight table."""
+    """Bounded ring of completed request traces + live in-flight table.
 
-    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+    A second, dedicated ring holds **SLO-breach exemplars**: completed
+    timelines whose request individually blew a declared latency target
+    (``RequestTrace.slo_breach`` set by the SLO tracker). Breaches are rare by
+    construction but the main ring churns fast under load — without the
+    separate ring the offending timeline an alert points at would usually be
+    evicted before anyone looks. ``/debug/requests?slo=breach`` serves it.
+    """
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY, exemplar_capacity: int = 64):
         if capacity < 1:
             raise ValueError("flight recorder capacity must be >= 1")
+        if exemplar_capacity < 1:
+            raise ValueError("flight recorder exemplar capacity must be >= 1")
         self.capacity = capacity
+        self.exemplar_capacity = exemplar_capacity
         self._lock = threading.Lock()
         #: completed timelines, oldest evicted first (deque maxlen = the ring)
         self._completed: "deque[Any]" = deque(maxlen=capacity)
+        #: completed timelines that breached an SLO target — pinned separately
+        #: so heavy healthy traffic cannot evict the evidence
+        self._exemplars: "deque[Any]" = deque(maxlen=exemplar_capacity)
         #: request_id -> trace for requests still in flight; insertion-ordered
         #: so the table reads oldest-first (the stalled request floats to the top)
         self._inflight: "OrderedDict[str, Any]" = OrderedDict()
@@ -70,10 +84,13 @@ class FlightRecorder:
             self._inflight[trace.request_id] = trace
 
     def complete(self, trace: Any) -> None:
-        """Move a finished trace from the in-flight table into the ring."""
+        """Move a finished trace from the in-flight table into the ring —
+        and, when its request breached an SLO target, pin it as an exemplar."""
         with self._lock:
             self._inflight.pop(trace.request_id, None)
             self._completed.append(trace)
+            if getattr(trace, "slo_breach", None):
+                self._exemplars.append(trace)
 
     # ------------------------------------------------------------------ consumers
 
@@ -86,14 +103,25 @@ class FlightRecorder:
         with self._lock:
             return len(self._inflight)
 
+    @property
+    def exemplar_count(self) -> int:
+        with self._lock:
+            return len(self._exemplars)
+
     def get(self, request_id: str) -> "Optional[Dict[str, Any]]":
         """One request's timeline by id — in-flight first (the live view wins),
         then the completed ring, newest first (re-used ids resolve to the most
-        recent occurrence)."""
+        recent occurrence), then the exemplar ring (a breach outlives its
+        eviction from the main ring)."""
         with self._lock:
             trace = self._inflight.get(request_id)
             if trace is None:
                 for candidate in reversed(self._completed):
+                    if candidate.request_id == request_id:
+                        trace = candidate
+                        break
+            if trace is None:
+                for candidate in reversed(self._exemplars):
                     if candidate.request_id == request_id:
                         trace = candidate
                         break
@@ -105,18 +133,30 @@ class FlightRecorder:
         route: Optional[str] = None,
         status: Optional[int] = None,
         limit: Optional[int] = None,
+        min_ms: Optional[float] = None,
+        slo_breach: bool = False,
     ) -> "Dict[str, Any]":
         """The ``/debug/requests`` payload: in-flight table (oldest first) and
         completed ring (newest first), optionally filtered by route substring
         and/or exact status. ``limit`` bounds EACH list (the wire payload for a
-        full 10k-deep ring would be megabytes)."""
+        full 10k-deep ring would be megabytes). ``min_ms`` keeps only timelines
+        whose total duration reached that many milliseconds (slow-request
+        triage without dumping the whole ring — in-flight entries count their
+        live duration so a currently stalled request still surfaces).
+        ``slo_breach`` draws the completed list from the exemplar ring instead
+        and keeps only in-flight requests already marked breaching."""
         with self._lock:
             inflight = list(self._inflight.values())
-            completed = list(reversed(self._completed))
+            completed = list(reversed(self._exemplars if slo_breach else self._completed))
+            exemplars = len(self._exemplars)
         def keep(snap: "Dict[str, Any]") -> bool:
             if route is not None and route not in snap["route"]:
                 return False
             if status is not None and snap["status"] != status:
+                return False
+            if min_ms is not None and snap["duration_ms"] < min_ms:
+                return False
+            if slo_breach and "slo_breach" not in snap:
                 return False
             return True
 
@@ -127,6 +167,7 @@ class FlightRecorder:
             completed_out = completed_out[:limit]
         return {
             "capacity": self.capacity,
+            "exemplars": exemplars,
             "inflight": inflight_out,
             "completed": completed_out,
         }

@@ -140,7 +140,7 @@ class RequestTrace:
     __slots__ = (
         "request_id", "method", "path", "created_at", "t0",
         "status", "detail", "duration_ms", "dropped_events",
-        "_events", "_lock", "_finished",
+        "slo_breach", "_events", "_lock", "_finished",
     )
 
     def __init__(self, request_id: str, method: str, path: str):
@@ -153,6 +153,10 @@ class RequestTrace:
         self.detail: Optional[str] = None
         self.duration_ms: Optional[float] = None
         self.dropped_events = 0
+        #: set by SLOTracker.note_* when THIS request's latency exceeded a
+        #: declared target: the flight recorder pins such timelines into its
+        #: exemplar ring (/debug/requests?slo=breach)
+        self.slo_breach: "Optional[Dict[str, Any]]" = None
         self._events: "List[Span]" = []
         self._lock = threading.Lock()
         self._finished = False
@@ -191,6 +195,29 @@ class RequestTrace:
                 Span(name, start - self.t0, (end - start) * 1e3, attrs or None)
             )
 
+    def mark_slo_breach(self, objective: str, observed_ms: float, target_ms: float) -> None:
+        """Stamp this timeline as an SLO-breach exemplar (first breach records
+        a ``slo.breach`` event; repeats bump the count and keep the worst
+        observation, so a stuttering stream reads as one exemplar, not 50)."""
+        with self._lock:
+            entry = self.slo_breach
+            if entry is not None:
+                entry["count"] += 1
+                if entry["objective"] == objective and observed_ms > entry["observed_ms"]:
+                    entry["observed_ms"] = round(observed_ms, 3)
+                return
+            self.slo_breach = {
+                "objective": objective,
+                "observed_ms": round(observed_ms, 3),
+                "target_ms": target_ms,
+                "count": 1,
+            }
+        # outside the breach bookkeeping: event() takes the same lock
+        self.event(
+            "slo.breach", objective=objective,
+            observed_ms=round(observed_ms, 3), target_ms=target_ms,
+        )
+
     def finish(self, status: int, detail: Optional[str] = None) -> None:
         """Seal the timeline (idempotent — the first finish wins, so a stream
         abort racing normal exhaustion records one terminal status)."""
@@ -223,6 +250,8 @@ class RequestTrace:
                 out["detail"] = self.detail
             if self.dropped_events:
                 out["dropped_events"] = self.dropped_events
+            if self.slo_breach:
+                out["slo_breach"] = dict(self.slo_breach)
             return out
 
 

@@ -167,11 +167,13 @@ class ServingApp:
             )
         self.server.route("GET", "/", self._root)
         self.server.route("GET", "/health", self._health)
+        self.server.route("GET", "/healthz", self._healthz)
         self.server.route("GET", "/metrics", self._metrics)
         self.server.route("POST", "/predict", self._predict)
         self.server.route("POST", "/predict-stream", self._predict_stream)
         self.server.route("GET", "/debug/requests", self._debug_requests)
         self.server.route_prefix("GET", "/debug/requests/", self._debug_request_by_id)
+        self.server.route("GET", "/debug/fleet", self._debug_fleet)
         self.server.route("POST", "/debug/profile", self._debug_profile)
 
     # ------------------------------------------------------------------ lifecycle
@@ -394,6 +396,32 @@ class ServingApp:
             "application/json",
         )
 
+    async def _healthz(self, body: bytes):
+        """Detailed fleet health (``/health`` stays the bare readiness bool the
+        reference shipped): the fleet health score with per-replica windowed
+        rates, SLO states, and saturation (observability/health.py,
+        docs/observability.md "SLOs and fleet health"). Draining answers 503
+        like ``/health`` so a load balancer probing either behaves the same."""
+        from unionml_tpu.observability.health import fleet_health
+
+        payload = fleet_health(getattr(self.model, "generation_batcher", None))
+        ready = self.model.artifact is not None and not self.server.draining
+        payload["ready"] = ready
+        status = 503 if self.server.draining else 200
+        payload["status"] = status
+        return status, payload, "application/json"
+
+    async def _debug_fleet(self, body: bytes):
+        """The routing-and-health view in one fetch: fleet + per-replica
+        health, live replica loads, the scheduler's telemetry, and the
+        exemplar count — "who is unhealthy AND where is traffic going"."""
+        from unionml_tpu.observability.health import fleet_debug
+
+        payload = fleet_debug(getattr(self.model, "generation_batcher", None))
+        payload["tracing"] = self.tracer.enabled
+        payload["exemplars"] = self.recorder.exemplar_count
+        return 200, payload, "application/json"
+
     async def _metrics(self, body: bytes):
         """Request counters and latency percentiles per route (SURVEY.md §5.5 —
         p50/p99 are the BASELINE serving metric, measured in-server, not just by
@@ -429,7 +457,9 @@ class ServingApp:
         """The flight recorder's tables: live in-flight request timelines plus
         the ring of recently completed ones. Filters: ``?route=`` (substring
         of ``METHOD /path``), ``?status=`` (exact), ``?limit=`` (per table,
-        default 100)."""
+        default 100), ``?min_ms=`` (only timelines at least that long —
+        slow-request triage without dumping the whole ring), and
+        ``?slo=breach`` (the pinned SLO-breach exemplar ring)."""
         query = current_query()
         status: Optional[int] = None
         if query.get("status"):
@@ -443,8 +473,18 @@ class ServingApp:
                 limit = max(int(query["limit"]), 0)
             except ValueError:
                 raise HTTPError(400, f"limit must be an integer, got {query['limit']!r}")
+        min_ms: Optional[float] = None
+        if query.get("min_ms"):
+            try:
+                min_ms = float(query["min_ms"])
+            except ValueError:
+                raise HTTPError(400, f"min_ms filter must be a number, got {query['min_ms']!r}")
+        slo = query.get("slo", "").strip().lower()
+        if slo and slo != "breach":
+            raise HTTPError(400, f"unknown slo filter {slo!r} (only 'breach' is recorded)")
         snapshot = self.recorder.snapshot(
-            route=query.get("route") or None, status=status, limit=limit
+            route=query.get("route") or None, status=status, limit=limit,
+            min_ms=min_ms, slo_breach=slo == "breach",
         )
         snapshot["tracing"] = self.tracer.enabled
         return 200, snapshot, "application/json"

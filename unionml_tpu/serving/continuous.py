@@ -71,6 +71,8 @@ from unionml_tpu.defaults import (
     serve_prefix_cache,
 )
 from unionml_tpu.observability.trace import current_trace
+from unionml_tpu.observability.slo import SLOConfig, SLOTracker
+from unionml_tpu.observability.timeseries import EngineTimeseries
 from unionml_tpu.serving.metrics import LatencyWindow
 from unionml_tpu.serving.overload import DeadlineExceeded, QueueFullError, expired
 from unionml_tpu.serving.prefix_cache import RadixPrefixCache
@@ -286,6 +288,16 @@ class ContinuousBatcher:
     bit-identical to a cold prefill; with the flag off the engine is
     byte-for-byte the pre-cache one. ``stats()["prefix_cache"]`` carries
     hit/miss/eviction/CoW counters and ``tokens_avoided``.
+
+    ``slo`` arms the **fleet health & SLO engine** (observability/{timeseries,
+    slo,health}.py, docs/observability.md "SLOs and fleet health"): windowed
+    rates fed per iteration, declarative latency/shed targets evaluated with
+    multi-window burn rates, per-request breach exemplars, and a cached
+    ``health()`` score the replica scheduler routes on. ``None`` (default)
+    reads the ``serve --slo-*`` env exports, an
+    :class:`~unionml_tpu.observability.slo.SLOConfig` overrides them, and
+    ``False`` disables the layer entirely (the pre-health engine, byte for
+    byte). ``stats()`` gains ``rates`` (and ``slo`` when targets are armed).
     """
 
     def __new__(cls, generator: Optional[Generator] = None, **engine_kwargs: Any):
@@ -336,6 +348,7 @@ class ContinuousBatcher:
         max_admissions: Optional[int] = None,
         trace: Optional[bool] = None,
         prefix_cache: Optional[bool] = None,
+        slo: Optional[Any] = None,
     ):
         if slots < 1:
             raise ValueError("slots must be >= 1")
@@ -595,6 +608,38 @@ class ContinuousBatcher:
         #: TBT (gap between consecutive emissions to one resident stream)
         self._ttft = LatencyWindow()
         self._tbt = LatencyWindow()
+        #: fleet health & SLO engine (observability/{timeseries,slo,health}).
+        #: ``slo=`` resolution: an SLOConfig uses it directly; None/True reads
+        #: the serve --slo-* env exports (the --dp-replicas contract); False
+        #: disables windowed telemetry AND SLO tracking entirely (the bench
+        #: lane's control arm — the pre-health-engine engine, byte for byte).
+        if slo is False:
+            self.timeseries: Optional[EngineTimeseries] = None
+            self.slo: Optional[SLOTracker] = None
+        else:
+            if slo is None or slo is True:
+                slo_config = SLOConfig.from_env()
+            elif isinstance(slo, SLOConfig):
+                slo_config = slo
+            else:
+                raise TypeError(
+                    f"slo must be an SLOConfig, True/None (read the UNIONML_TPU_SLO_* "
+                    f"exports) or False (disable), got {type(slo).__name__}"
+                )
+            self.slo = SLOTracker(slo_config)
+            # ring horizon covers the slow burn-rate window so both SLO
+            # windows read real history; TTFT/TBT percentiles ride the
+            # engine's own (timestamped) reservoirs — one bookkeeping path
+            self.timeseries = EngineTimeseries(
+                horizon_s=slo_config.slow_window_s, ttft=self._ttft, tbt=self._tbt
+            )
+        #: cached health evaluation (observability/health.engine_health): the
+        #: replica scheduler consults health per routing decision, so the full
+        #: evaluation (reservoir sorts + SLO state machine) runs at most once
+        #: per TTL and submits in between read the cached dict
+        self._health_lock = threading.Lock()
+        self._health_cache: "Optional[tuple]" = None
+        self._health_ttl = 0.5
         #: token-weighted load normalizer: one admit chunk (or one widest
         #: bucket) of queued prefill counts as one unit of scheduling load
         self._load_norm = float(self.admit_chunk or widest)
@@ -960,6 +1005,8 @@ class ContinuousBatcher:
             # engine thread bumps this same counter (lost update otherwise)
             with self._lock:
                 self.shed_deadline += 1
+                if self.timeseries is not None:
+                    self.timeseries.sheds.add()
             if req_trace is not None:
                 req_trace.event("engine.shed_deadline", phase="submit")
             raise DeadlineExceeded("deadline expired before the prompt was enqueued")
@@ -990,6 +1037,8 @@ class ContinuousBatcher:
             waiting = sum(1 for _, s in self._pending if not s.finished)
             if waiting >= self.max_waiting:
                 self.shed_queue_full += 1
+                if self.timeseries is not None:
+                    self.timeseries.sheds.add()
                 if req_trace is not None:
                     req_trace.event("engine.shed_queue_full", waiting=waiting)
                 raise QueueFullError(
@@ -1088,6 +1137,11 @@ class ContinuousBatcher:
                 self._radix_reset_locked()
             self._ttft.clear()  # warmup probes must not skew the percentiles
             self._tbt.clear()
+            if self.timeseries is not None:
+                # probe tokens/admissions must not read as real traffic rates
+                self.timeseries.clear()
+            if self.slo is not None:
+                self.slo.reset()  # a slow compile-paying probe is not a breach
             self._grammar_counts.clear()  # warmup probes all ride FREE (id 0)
             if self._spec is not None:
                 # the carry's device-side ride-along counters are NOT reset;
@@ -1095,6 +1149,55 @@ class ContinuousBatcher:
                 # accumulate onto the zeroed telemetry correctly
                 self._spec.rounds = 0
                 self._spec.accepted_tokens = 0
+        with self._health_lock:
+            self._health_cache = None  # next health() sees post-reset telemetry
+
+    def configure_slo(self, config: "SLOConfig") -> None:
+        """Swap this engine's SLO targets at runtime (retuning a live fleet,
+        or arming per-replica targets in tests). The tracker restarts at
+        all-ok; the next ``health()`` evaluates fresh against the new targets."""
+        if not isinstance(config, SLOConfig):
+            raise TypeError(f"config must be an SLOConfig, got {type(config).__name__}")
+        if self.timeseries is None:
+            raise ValueError(
+                "this engine was built with slo=False (windowed telemetry disabled); "
+                "SLO targets need the timeseries feed"
+            )
+        with self._health_lock:
+            self.slo = SLOTracker(config)
+            self._health_cache = None
+
+    def rates(self, window_s: Optional[float] = None) -> Dict[str, Any]:
+        """Windowed rates (tok/s, admissions/s, sheds/s, time-decayed TTFT/TBT
+        percentiles) plus the live prefill backlog — the per-replica quantity
+        ``/healthz`` exposes and an autoscaler acts on. Defaults to the SLO
+        fast window. ``{}`` when the engine was built with ``slo=False``."""
+        if self.timeseries is None:
+            return {}
+        if window_s is None:
+            window_s = self.slo.config.fast_window_s if self.slo is not None else 60.0
+        out = self.timeseries.rates(window_s)
+        out["prefill_backlog_tokens"] = self.queued_prefill_tokens()
+        return out
+
+    def health(self, *, max_age_s: Optional[float] = None) -> Dict[str, Any]:
+        """This engine's health (observability/health.py): SLO state x
+        saturation as one score, cached for ``max_age_s`` (default 0.5 s) so
+        the replica scheduler can consult it per routing decision without
+        paying the full evaluation each time. ``max_age_s=0`` forces a fresh
+        evaluation."""
+        from unionml_tpu.observability.health import engine_health
+
+        ttl = self._health_ttl if max_age_s is None else max_age_s
+        now = time.monotonic()
+        with self._health_lock:
+            cached = self._health_cache
+        if cached is not None and now - cached[0] < ttl:
+            return cached[1]
+        fresh = engine_health(self)
+        with self._health_lock:
+            self._health_cache = (now, fresh)
+        return fresh
 
     def occupancy(self) -> "tuple[int, int]":
         """``(resident, live waiting)`` — the cheap gauge pair the replica
@@ -1144,7 +1247,14 @@ class ContinuousBatcher:
 
     def stats(self) -> Dict[str, Any]:
         """Utilization snapshot for ``/metrics``: resident/waiting streams,
-        shared-dispatch counters, and (speculative mode) realized acceptance."""
+        shared-dispatch counters, and (speculative mode) realized acceptance.
+
+        The engine lock is held ONLY for the counter/queue/pool reads that
+        need it; the latency-window percentile sorts, windowed rates, and the
+        SLO evaluation all run after release (each is internally
+        synchronized) — a scrape-cadence ``/metrics`` poller must never stall
+        the engine thread behind reservoir sorting (the same contract as
+        ``LatencyWindow.snapshot`` itself)."""
         with self._lock:
             backlog = sum(len(p) for p, s in self._pending if not s.finished)
             for adm in self._admissions:
@@ -1176,10 +1286,6 @@ class ContinuousBatcher:
                     "monolithic_admissions": self.prefill_monolithic,
                     "backlog_tokens": backlog,
                 },
-                # first-token and between-token latency percentiles (ms); an
-                # empty window reports {"window": 0}, never a None gauge
-                "ttft_ms": self._ttft.snapshot(),
-                "tbt_ms": self._tbt.snapshot(),
             }
             if self.block_size is not None:
                 # "used" includes the permanently resident shared-prefix pages
@@ -1236,7 +1342,25 @@ class ContinuousBatcher:
                 # structured-output adoption: how many submissions rode each
                 # grammar (0 = FREE) — the signal for sizing the ConstraintSet
                 snapshot["grammar_submissions"] = dict(sorted(self._grammar_counts.items()))
-            return snapshot
+        # ---- window work, OUTSIDE the engine lock (each structure below is
+        # internally synchronized; sorting reservoirs here must not stall the
+        # engine thread behind a scrape)
+        # first-token and between-token latency percentiles (ms); an empty
+        # window reports {"window": 0}, never a None gauge
+        snapshot["ttft_ms"] = self._ttft.snapshot()
+        snapshot["tbt_ms"] = self._tbt.snapshot()
+        if self.timeseries is not None:
+            # windowed rates over the SLO fast window (the autoscaling signal,
+            # rendered as gauges in the Prometheus exposition); backlog reuses
+            # the figure computed under the lock above
+            fast_s = self.slo.config.fast_window_s if self.slo is not None else 60.0
+            snapshot["rates"] = {
+                **self.timeseries.rates(fast_s),
+                "prefill_backlog_tokens": backlog,
+            }
+        if self.slo is not None and self.slo.armed:
+            snapshot["slo"] = self.slo.evaluate(self.timeseries)
+        return snapshot
 
     def close(self, wait: bool = True, timeout: float = 120.0) -> None:
         """Stop admitting new requests, DRAIN resident streams — and
@@ -1379,6 +1503,8 @@ class ContinuousBatcher:
                 if expired(s.deadline):
                     s.finished = True
                     self.shed_deadline += 1
+                    if self.timeseries is not None:
+                        self.timeseries.sheds.add()
                     _tev(s, "engine.shed_deadline", phase="waiting")
                     s.out.put(DeadlineExceeded(
                         "deadline exceeded while waiting for a decode slot"
@@ -1489,6 +1615,8 @@ class ContinuousBatcher:
             if not session.finished and expired(session.deadline):
                 session.finished = True
                 self.shed_deadline += 1
+                if self.timeseries is not None:
+                    self.timeseries.sheds.add()
                 _tev(session, "engine.shed_deadline", phase="prefill")
                 session.out.put(DeadlineExceeded(
                     "deadline exceeded mid-prefill; admission abandoned"
@@ -1797,6 +1925,8 @@ class ContinuousBatcher:
                 # first token EVER for this stream; a preemption resume is a
                 # later residency, not a first token
                 self._ttft.observe(now - session.created_at)
+                if self.slo is not None:
+                    self.slo.note_ttft(session.trace, (now - session.created_at) * 1e3)
                 _tev(
                     session, "engine.first_token",
                     ttft_ms=round((now - session.created_at) * 1e3, 3),
@@ -1804,7 +1934,12 @@ class ContinuousBatcher:
             _tev(session, "engine.emit", tokens=1, produced=session.produced + 1)
             if session.last_emit is not None:
                 self._tbt.observe(now - session.last_emit)
+                if self.slo is not None:
+                    self.slo.note_tbt(session.trace, (now - session.last_emit) * 1e3)
             session.last_emit = now
+            if self.timeseries is not None:
+                self.timeseries.admissions.add()
+                self.timeseries.tokens.add()
             if self.block_size is not None:  # echo exists only for preemption resume
                 session.echo.append(int(first[0]))
             session.resident_base = session.produced
@@ -2046,10 +2181,14 @@ class ContinuousBatcher:
                     session.out.put(row[:take].copy())
                     if session.last_emit is not None:
                         self._tbt.observe(now - session.last_emit)
+                        if self.slo is not None:
+                            self.slo.note_tbt(session.trace, (now - session.last_emit) * 1e3)
                     session.last_emit = now
                     if self.block_size is not None:
                         session.echo.extend(int(t) for t in row[:take])
                     session.produced += take
+                    if self.timeseries is not None:
+                        self.timeseries.tokens.add(take)
                     _tev(session, "engine.emit", tokens=take, produced=session.produced)
                 device_done = bool(done_np[slot])
                 if session.produced >= session.max_new or device_done:
@@ -2099,10 +2238,14 @@ class ContinuousBatcher:
                     session.out.put(new.copy())
                     if session.last_emit is not None:
                         self._tbt.observe(now - session.last_emit)
+                        if self.slo is not None:
+                            self.slo.note_tbt(session.trace, (now - session.last_emit) * 1e3)
                     session.last_emit = now
                     if self.block_size is not None:
                         session.echo.extend(int(t) for t in new)
                     session.produced = session.resident_base + int(prod_np[slot])
+                    if self.timeseries is not None:
+                        self.timeseries.tokens.add(int(new.size))
                     _tev(session, "engine.emit", tokens=int(new.size), produced=session.produced)
                 if bool(done_np[slot]):
                     self._finish_locked(slot, device_done=True)

@@ -125,8 +125,12 @@ class HTTPServer:
         self._streams = 0
         #: routes that keep answering while draining (health must report
         #: ready=false, metrics must stay scrapable through the drain, and the
-        #: flight recorder is most useful exactly while a drain is stuck)
-        self._drain_exempt = {("GET", "/health"), ("GET", "/metrics"), ("GET", "/debug/requests")}
+        #: flight recorder and fleet-health views are most useful exactly
+        #: while a drain is stuck)
+        self._drain_exempt = {
+            ("GET", "/health"), ("GET", "/healthz"), ("GET", "/metrics"),
+            ("GET", "/debug/requests"), ("GET", "/debug/fleet"),
+        }
         self._stop_serving: Optional[asyncio.Event] = None
 
     @property

@@ -11,8 +11,9 @@ exposes counts and exact p50/p95/p99 over the most recent window. The reservoir
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
-from typing import Any, Callable, Dict
+from typing import Any, Callable, Dict, Optional
 
 _WINDOW = 10_000  # most recent samples per route
 
@@ -35,15 +36,28 @@ class LatencyWindow:
     prefills) into these directly; ``stats()`` carries the snapshots to
     ``/metrics``. An empty window snapshots as ``{"window": 0}`` — never a
     ``None``-valued gauge.
+
+    Samples carry a monotonic-clock timestamp (``clock`` injectable for
+    tests), so snapshots report **freshness** (``newest_age_ms``/
+    ``oldest_age_ms`` — a fast engine and a stale one both show a good p99;
+    only the ages tell them apart) and ``snapshot(window_s=...)`` yields
+    *time-decaying* percentiles over just the trailing window — the quantity
+    the SLO burn-rate evaluation (observability/slo.py) consumes.
+
+    Locking contract: producers only ever pay an append under the lock. The
+    snapshot copies the deque under the lock and does ALL ordering work
+    outside it — sorting a 10k-deep window while holding the producer lock
+    would stall token-emission threads for every ``/metrics`` scrape.
     """
 
-    def __init__(self, window: int = _WINDOW):
+    def __init__(self, window: int = _WINDOW, clock: Callable[[], float] = time.monotonic):
         self._lock = threading.Lock()
-        self._samples: deque = deque(maxlen=window)
+        self._clock = clock
+        self._samples: deque = deque(maxlen=window)  # (monotonic ts, seconds)
 
     def observe(self, seconds: float) -> None:
         with self._lock:
-            self._samples.append(seconds)
+            self._samples.append((self._clock(), seconds))
 
     def clear(self) -> None:
         """Drop accumulated samples (warmup probes must not skew percentiles)."""
@@ -54,11 +68,23 @@ class LatencyWindow:
         with self._lock:
             return len(self._samples)
 
-    def snapshot(self) -> Dict[str, Any]:
+    def snapshot(self, window_s: Optional[float] = None) -> Dict[str, Any]:
+        """Percentiles (+ freshness ages) over the retained samples —
+        restricted to the trailing ``window_s`` seconds when given. Empty (or
+        fully aged-out) windows report ``{"window": 0}``."""
         with self._lock:
-            ordered = sorted(self._samples)
-        if not ordered:
+            pairs = list(self._samples)
+            now = self._clock()
+        # filtering and sorting run OUTSIDE the lock on the copied list: a
+        # scrape must never stall observe() callers (the engine thread)
+        if window_s is not None:
+            cutoff = now - window_s
+            pairs = [pair for pair in pairs if pair[0] >= cutoff]
+        if not pairs:
             return {"window": 0}
+        ordered = sorted(value for _, value in pairs)
+        # the deque is appended in clock order, so the ends are the extremes
+        oldest_ts, newest_ts = pairs[0][0], pairs[-1][0]
         return {
             "window": len(ordered),
             "mean_ms": round(sum(ordered) / len(ordered) * 1e3, 3),
@@ -66,6 +92,8 @@ class LatencyWindow:
             "p95_ms": round(_percentile(ordered, 0.95) * 1e3, 3),
             "p99_ms": round(_percentile(ordered, 0.99) * 1e3, 3),
             "max_ms": round(ordered[-1] * 1e3, 3),
+            "newest_age_ms": round(max(now - newest_ts, 0.0) * 1e3, 3),
+            "oldest_age_ms": round(max(now - oldest_ts, 0.0) * 1e3, 3),
         }
 
 

@@ -166,6 +166,7 @@ class ReplicaScheduler:
         loads: Sequence[int],
         prompt: Optional[Sequence[int]] = None,
         cached: Optional[Sequence[int]] = None,
+        breaching: Optional[Sequence[bool]] = None,
     ) -> "Tuple[List[int], bool]":
         """``(indices to try best-first, head_is_affinity)``. The caller walks
         the list so a full (QueueFullError) replica falls through to the
@@ -179,18 +180,41 @@ class ReplicaScheduler:
         already holds the longest run of this prompt is preferred, unless it
         is more than ``affinity_margin`` load units busier than the least
         loaded (the same hotspot guard). The LRU map remains the fallback for
-        engines without a prefix cache."""
-        ranked = sorted(range(len(loads)), key=lambda i: (loads[i], i))
+        engines without a prefix cache.
+
+        ``breaching`` — per-replica SLO-breach flags (each engine's
+        ``health()["state"] == "breach"``, the observability→routing feedback)
+        — deprioritizes a breaching replica below EVERY non-breaching one
+        regardless of load, and disqualifies it from heading the order via
+        affinity: sending a warm-prefix request to a replica that is already
+        missing its latency targets would trade a prefill for a breach. A
+        breaching replica still appears in the walk order, so a fleet that is
+        breaching everywhere degrades to plain least-loaded rather than
+        shedding."""
+        avoid = (
+            [bool(flag) for flag in breaching]
+            if breaching is not None and len(breaching) == len(loads)
+            else [False] * len(loads)
+        )
+        ranked = sorted(range(len(loads)), key=lambda i: (avoid[i], loads[i], i))
         if cached is not None and len(cached) == len(loads) and max(cached, default=0) > 0:
-            preferred = min(range(len(loads)), key=lambda i: (-cached[i], loads[i], i))
-            if loads[preferred] <= loads[ranked[0]] + self.affinity_margin:
-                return [preferred] + [i for i in ranked if i != preferred], True
+            # warm replicas that are NOT breaching compete on cached length; a
+            # breaching replica's warm cache never heads the order
+            candidates = [i for i in range(len(loads)) if cached[i] > 0 and not avoid[i]]
+            if candidates:
+                preferred = min(candidates, key=lambda i: (-cached[i], loads[i], i))
+                if loads[preferred] <= loads[ranked[0]] + self.affinity_margin:
+                    return [preferred] + [i for i in ranked if i != preferred], True
             return ranked, False
         key = self._key(prompt)
         if key is not None:
             with self._lock:
                 preferred = self._affinity.get(key)
-            if preferred is not None and loads[preferred] <= loads[ranked[0]] + self.affinity_margin:
+            if (
+                preferred is not None
+                and not avoid[preferred]
+                and loads[preferred] <= loads[ranked[0]] + self.affinity_margin
+            ):
                 return [preferred] + [i for i in ranked if i != preferred], True
         return ranked, False
 
@@ -236,7 +260,8 @@ class ReplicaSet:
     ``decode_chunk``, ``block_size``, ``pool_blocks``, ``max_waiting``,
     ``admit_chunk``/``prefill_budget``/``max_admissions`` — stall-free
     admission — ``prefix_cache`` — the radix prefix cache, see
-    serving/continuous.py — and ``prefix``) apply PER REPLICA; a shared ``prefix`` (token ids or a
+    serving/continuous.py — ``slo`` — the fleet health & SLO engine —
+    and ``prefix``) apply PER REPLICA; a shared ``prefix`` (token ids or a
     ``PrefixCache`` built with ``cache_prefix``) is prefilled once per replica
     at construction, since cache rows cannot cross submeshes.
     """
@@ -259,6 +284,7 @@ class ReplicaSet:
         affinity_margin: int = 2,
         trace: Optional[bool] = None,
         prefix_cache: Optional[bool] = None,
+        slo: Optional[Any] = None,
     ):
         if (generators is None) == (engines is None):
             raise ValueError("pass exactly one of generators= or engines=")
@@ -283,6 +309,7 @@ class ReplicaSet:
                             max_admissions=max_admissions,
                             trace=trace,
                             prefix_cache=prefix_cache,
+                            slo=slo,
                         )
                     )
             except BaseException:
@@ -300,6 +327,10 @@ class ReplicaSet:
         #: (per-replica counters additionally record each engine's own sheds)
         self.shed_deadline = 0
         self.shed_queue_full = 0
+        #: routing decisions that walked past an SLO-breaching replica that
+        #: pure load order would have picked (the observability→routing
+        #: feedback loop, made observable itself)
+        self.breach_avoided = 0
 
     @staticmethod
     def _prefix_tokens(prefix: Optional[Any]) -> "Optional[List[int]]":
@@ -459,7 +490,21 @@ class ReplicaSet:
                 int(getattr(b, "cached_prefix_tokens", lambda _p: 0)(prompt))
                 for b in self._batchers
             ]
-        order, affinity_head = self._scheduler.order(loads, prompt, cached)
+        # per-replica SLO breach flags (cached health evaluations — cheap per
+        # decision): a breaching replica is routed around, not routed to
+        breaching = None
+        if any(callable(getattr(b, "health", None)) for b in self._batchers):
+            breaching = [
+                callable(getattr(b, "health", None)) and b.health().get("state") == "breach"
+                for b in self._batchers
+            ]
+        order, affinity_head = self._scheduler.order(loads, prompt, cached, breaching)
+        if breaching is not None and any(breaching):
+            # pure load order would have picked this replica; health demoted it
+            pure_head = min(range(len(loads)), key=lambda i: (loads[i], i))
+            if breaching[pure_head] and order and order[0] != pure_head:
+                with self._lock:
+                    self.breach_avoided += 1
         last_exc: Optional[QueueFullError] = None
         for replica in order:
             if req_trace is not None:
@@ -468,6 +513,7 @@ class ReplicaSet:
                 req_trace.event(
                     "engine.routed", replica=replica, load=round(loads[replica], 3),
                     affinity=affinity_head and replica == order[0],
+                    breaching=bool(breaching[replica]) if breaching is not None else False,
                 )
             try:
                 stream = self._batchers[replica].submit(
@@ -500,6 +546,20 @@ class ReplicaSet:
         """Aggregate token-weighted load (the signal a layer above a fleet of
         ReplicaSets would schedule on, mirroring the engine's own)."""
         return sum(batcher.load() for batcher in self._batchers)
+
+    def health(self) -> Dict[str, Any]:
+        """Fleet health (observability/health.py): mean + worst per-replica
+        scores and the worst SLO state — the ``GET /healthz`` body."""
+        from unionml_tpu.observability.health import fleet_health
+
+        return fleet_health(self)
+
+    def configure_slo(self, config: Any, replica: Optional[int] = None) -> None:
+        """Swap SLO targets on every replica (or just ``replica`` — per-role
+        targets for heterogeneous fleets) at runtime."""
+        targets = self._batchers if replica is None else [self._batchers[replica]]
+        for batcher in targets:
+            batcher.configure_slo(config)
 
     def queued_prefill_tokens(self) -> int:
         """Fleet-wide prefill backlog in tokens (engines that predate the
@@ -540,6 +600,15 @@ class ReplicaSet:
 
         with self._lock:
             shed_deadline, shed_queue_full = self.shed_deadline, self.shed_queue_full
+            breach_avoided = self.breach_avoided
+        # fleet health headline (per-replica detail rides per_replica's own
+        # rates/slo sections): strip the replicas list — stats() must not
+        # duplicate every engine's health payload
+        fleet = {
+            key: value
+            for key, value in self.health().items()
+            if key != "replicas"
+        }
         def total_prefill(key: str) -> int:
             return sum(
                 int((entry.get("prefill") or {}).get(key) or 0) for entry in per_replica
@@ -581,6 +650,10 @@ class ReplicaSet:
             # top of each engine's own counters
             "shed_queue_full": shed_queue_full + total("shed_queue_full"),
             "shed_deadline": shed_deadline + total("shed_deadline"),
+            # fleet health score/state + how often routing walked around a
+            # breaching replica (the observability→routing feedback, observable)
+            "health": fleet,
+            "breach_avoided": breach_avoided,
             "per_replica": per_replica,
         }
 
