@@ -40,6 +40,7 @@ SCRIPTS = {
     "continuous": "bench_continuous.py",
     "continuous_stall": "bench_continuous.py",
     "prefix_cache": "bench_prefix_cache.py",
+    "disagg_serving": "bench_disagg_serving.py",
     "quantized_serving": "bench_quantized_serving.py",
     "replica_serving": "bench_replica_serving.py",
     "observability": "bench_observability.py",
@@ -73,10 +74,13 @@ if _cpu_extra - set(SCRIPTS):
 #: (host-side per-token bookkeeping, not chip throughput) and fleet_health the
 #: health-engine on/off ratio under scrape-cadence polling; quantized_serving
 #: pins the int8-vs-bf16 resident-stream capacity ratio at a fixed KV-pool
-#: byte budget — a memory/scheduling property, same-substrate by construction
+#: byte budget — a memory/scheduling property, same-substrate by construction;
+#: disagg_serving pins role-split vs symmetric resident TBT-p99 through the
+#: same dispatch-bound synthetic regime as replica_serving (fleet topology,
+#: not chip speed)
 CPU_ONLY = {
     "digits", "serving", "replica_serving", "continuous_stall", "prefix_cache",
-    "quantized_serving", "observability", "fleet_health", "lint",
+    "quantized_serving", "observability", "fleet_health", "lint", "disagg_serving",
 } | _cpu_extra
 
 #: per-lane env overrides: lanes that reuse a script in a different mode

@@ -1,0 +1,172 @@
+"""Disaggregated prefill/decode fleets on the emulated 8-device mesh.
+
+The acceptance ring for docs/serving.md "Disaggregated and elastic serving":
+
+- **tp=2 handoff exactness**: a prefill-role tp=2 replica's exported KV,
+  adopted by a decode-role tp=2 replica, yields the EXACT token stream (first
+  token included) of a single mixed replica — the KV crosses submeshes via
+  ``jax.device_put`` and scatters into freshly allocated paged blocks;
+- **dp=2×tp=2 role-split fleet** serves a mixed long-prefill + decode
+  workload token-identical to a symmetric (all-mixed) fleet over the same
+  mesh — disaggregation must be invisible in the output;
+- **elastic resize on a dp mesh**: ``scale_to`` down drains a replica onto
+  the spare-submesh pool and back up re-places params on it, with zero
+  in-flight streams lost (counts asserted) and the new replica visible in
+  the fleet health payload without restart.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from unionml_tpu.models import GenerationConfig, Generator, Llama, LlamaConfig, llama_partition_rules
+from unionml_tpu.parallel import MeshSpec
+from unionml_tpu.serving import ReplicaSet
+
+pytestmark = pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 emulated devices")
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    config = LlamaConfig.tiny(
+        vocab_size=96, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, hidden_dim=128,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    module = Llama(config)
+    params = module.init(jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32))["params"]
+    return module, params
+
+
+def _cfg(**overrides):
+    kwargs = dict(max_new_tokens=8, temperature=0.0, prompt_buckets=(16,))
+    kwargs.update(overrides)
+    return GenerationConfig(**kwargs)
+
+
+def _drain(stream):
+    return [int(t) for chunk in stream for t in np.asarray(chunk).ravel()]
+
+
+def _drain_concurrently(streams):
+    results = [None] * len(streams)
+
+    def worker(i):
+        results[i] = _drain(streams[i])
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(len(streams))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    return results
+
+
+# a mixed workload: one long prompt (the prefill-tier traffic) among short
+# decode-bound ones
+PROMPTS = [
+    [3, 1, 4, 1, 5],
+    list(range(2, 16)),  # the long prompt
+    [7, 1],
+    [6, 6, 6, 2],
+    [9, 2, 6, 5, 3, 5],
+]
+
+
+def test_tp2_handoff_first_token_bit_identical(tiny):
+    """The pinned cross-submesh exactness leg: prefill on one tp=2 submesh,
+    decode on the other, paged KV — every token equals the single mixed
+    replica run, so the handed-off KV is bit-identical to locally prefilled
+    KV."""
+    module, params = tiny
+    cfg = _cfg()
+    mesh = MeshSpec(data=2, model=2).build(devices=jax.devices()[:4])
+    expected = [list(map(int, Generator(module, params, cfg)([p])[0])) for p in PROMPTS]
+    fleet = ReplicaSet.build(
+        module, params, cfg, mesh=mesh, partition_rules=llama_partition_rules(),
+        roles={"prefill": 1, "decode": 1}, prefill_threshold=0,
+        slots=2, decode_chunk=4, block_size=4,
+    )
+    try:
+        assert fleet.roles == ["prefill", "decode"]
+        for prompt, want in zip(PROMPTS, expected):
+            got = _drain(fleet.submit(prompt))
+            assert got == want  # element 0 is the handed-off first token
+        stats = fleet.stats()
+        assert stats["handoffs"]["exported"] == len(PROMPTS)
+        assert stats["handoffs"]["imported"] == len(PROMPTS)
+        assert stats["per_replica"][0]["decode_dispatches"] == 0
+    finally:
+        fleet.close()
+
+
+def test_dp2tp2_role_split_matches_symmetric_fleet(tiny):
+    """Role-split vs symmetric over the SAME dp=2×tp=2 mesh: identical token
+    streams for a mixed long-prefill + decode workload."""
+    module, params = tiny
+    cfg = _cfg()
+
+    def run(roles):
+        mesh = MeshSpec(data=2, model=2).build(devices=jax.devices()[:4])
+        fleet = ReplicaSet.build(
+            module, params, cfg, mesh=mesh, partition_rules=llama_partition_rules(),
+            roles=roles, prefill_threshold=0, slots=2, decode_chunk=4,
+        )
+        try:
+            results = _drain_concurrently([fleet.submit(p) for p in PROMPTS])
+            return results, fleet.stats()
+        finally:
+            fleet.close()
+
+    symmetric, sym_stats = run(None)
+    split, split_stats = run({"prefill": 1, "decode": 1})
+    assert split == symmetric
+    assert "handoffs" not in sym_stats  # symmetric fleets keep today's stats
+    assert split_stats["roles"] == {"prefill": 1, "decode": 1, "mixed": 0}
+    assert split_stats["handoffs"]["imported"] >= 1
+
+
+def test_dp_mesh_scale_down_up_zero_loss(tiny):
+    """Elastic resize on a dp=2 mesh mid-traffic: drain to 1 replica (the
+    submesh joins the spare pool), scale back to 2 (params re-placed on it),
+    with every in-flight stream completing exactly."""
+    module, params = tiny
+    cfg = _cfg()
+    mesh = MeshSpec(data=2, model=2).build(devices=jax.devices()[:4])
+    rng = np.random.default_rng(7)
+    prompts = [list(map(int, rng.integers(1, 96, size=int(rng.integers(2, 10))))) for _ in range(8)]
+    expected = [list(map(int, Generator(module, params, cfg)([p])[0])) for p in prompts]
+    fleet = ReplicaSet.build(
+        module, params, cfg, mesh=mesh, partition_rules=llama_partition_rules(),
+        slots=2, decode_chunk=4,
+    )
+    try:
+        assert fleet.replicas == 2 and fleet.spare_capacity() == 0
+        results = [None] * len(prompts)
+
+        def worker(i):
+            results[i] = _drain(fleet.submit(prompts[i]))
+
+        wave1 = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+        for t in wave1:
+            t.start()
+        assert fleet.scale_to(1) == 1
+        assert fleet.spare_capacity() == 1  # the drained submesh is reusable
+        wave2 = [threading.Thread(target=worker, args=(i,)) for i in range(4, 8)]
+        for t in wave2:
+            t.start()
+        assert fleet.scale_to(2) == 2
+        for t in wave1 + wave2:
+            t.join(timeout=180)
+        assert results == expected  # zero dropped, zero corrupted
+        # the re-added replica is live in the health payload without restart
+        health = fleet.health()
+        assert len(health["replicas"]) == 2
+        stats = fleet.stats()
+        assert stats["resize"]["scaled_up"] == 1 and stats["resize"]["scaled_down"] == 1
+        assert stats["replicas"] == 2
+    finally:
+        fleet.close()

@@ -75,8 +75,20 @@ def test_slice_mesh_cuts_batch_axes_into_tp_submeshes():
         assert int(sub.shape["model"]) == 2  # TP extent preserved
         seen.update(d.id for d in np.asarray(sub.devices).ravel())
     assert len(seen) == 8  # replicas partition the devices, no overlap
-    with pytest.raises(ValueError):
-        slice_mesh(mesh, replicas=2)  # partial slices would leave a >1 batch axis
+    # a DIVIDING smaller count groups batch slices per replica, folding the
+    # leftover extent into the model axis (fewer, fatter TP replicas)
+    grouped = slice_mesh(mesh, replicas=2)
+    assert len(grouped) == 2
+    grouped_ids = set()
+    for sub in grouped:
+        assert dp_extent(sub) == 1
+        assert int(sub.shape["model"]) == 4  # 2 grouped slices x tp=2
+        grouped_ids.update(d.id for d in np.asarray(sub.devices).ravel())
+    assert len(grouped_ids) == 8
+    # a NON-dividing count raises a clear error naming the batch-axis extents
+    # (historically an opaque reshape error deep in mesh construction)
+    with pytest.raises(ValueError, match="data=2, fsdp=2"):
+        slice_mesh(mesh, replicas=3)
 
 
 def test_replica_set_streams_match_single_engine_reference(tiny):

@@ -739,3 +739,120 @@ def test_env_helpers_warn_and_fall_back(monkeypatch, caplog):
     assert env_float("UNIONML_TPU_TEST_KNOB", 5.0, minimum=0.1) == 0.1
     monkeypatch.setenv("UNIONML_TPU_TEST_KNOB", "")
     assert env_int("UNIONML_TPU_TEST_KNOB", 7) == 7
+
+
+# --------------------------------------------------------------------- TPU008
+
+
+def test_tpu008_flags_unjoined_attribute_thread(tmp_path):
+    result = lint_source(
+        tmp_path,
+        """
+        import threading
+
+        class Engine:
+            def start(self):
+                self._thread = threading.Thread(target=self._loop, daemon=True)
+                self._thread.start()
+
+            def close(self):
+                self._running = False
+        """,
+    )
+    assert rule_ids(result) == ["TPU008"]
+    assert "self._thread" in result.findings[0].message
+
+
+def test_tpu008_flags_fire_and_forget_and_unjoined_local(tmp_path):
+    result = lint_source(
+        tmp_path,
+        """
+        import threading
+
+        class Fleet:
+            def kick(self):
+                threading.Thread(target=self._loop).start()
+
+            def spawn(self):
+                worker = threading.Thread(target=self._loop)
+                worker.start()
+
+            def close(self):
+                pass
+        """,
+    )
+    assert rule_ids(result) == ["TPU008", "TPU008"]
+
+
+def test_tpu008_near_misses_stay_clean(tmp_path):
+    # joined attribute (the engine idiom), join-through-local-alias (join
+    # outside the lock), local joined in-method, container-tracked workers,
+    # local promoted to an attribute, a class without close(), and a
+    # module-level function — none may flag
+    result = lint_source(
+        tmp_path,
+        """
+        import threading
+
+        class Engine:
+            def start(self):
+                self._thread = threading.Thread(target=self._loop, daemon=True)
+                self._thread.start()
+
+            def close(self):
+                thread = self._thread
+                if thread is not None:
+                    thread.join(timeout=10)
+
+        class Warmup:
+            def run(self):
+                helper = threading.Thread(target=self._probe)
+                helper.start()
+                helper.join()
+
+            def close(self):
+                pass
+
+        class Pool:
+            def grow(self):
+                worker = threading.Thread(target=self._loop)
+                self._workers.append(worker)
+                worker.start()
+
+            def promote(self):
+                t = threading.Thread(target=self._loop)
+                self._scaler = t
+                t.start()
+
+            def close(self):
+                for worker in self._workers:
+                    worker.join()
+                self._scaler.join()
+
+        class NoClose:
+            def fire(self):
+                threading.Thread(target=self._loop).start()
+
+        def module_level():
+            threading.Thread(target=print).start()
+        """,
+    )
+    assert rule_ids(result) == []
+
+
+def test_tpu008_suppression_comment(tmp_path):
+    result = lint_source(
+        tmp_path,
+        """
+        import threading
+
+        class Engine:
+            def start(self):
+                self._thread = threading.Thread(target=self._loop)  # tpu-lint: disable=TPU008
+
+            def close(self):
+                pass
+        """,
+    )
+    assert rule_ids(result) == []
+    assert [finding.rule for finding in result.suppressed] == ["TPU008"]

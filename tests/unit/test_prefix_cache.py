@@ -138,8 +138,13 @@ def test_cached_prefix_streams_match_cold_and_sequential(tiny_gen):
         stats = batcher.stats()["prefix_cache"]
         assert stats["hits"] == len(PROMPTS_SHARED) - 1  # all but the first
         assert stats["misses"] == 1
-        assert stats["tokens_avoided"] == 16 * (len(PROMPTS_SHARED) - 1)  # 2 full blocks each
-        assert batcher.cached_prefix_tokens(PROMPTS_SHARED[0]) == 16
+        # decode-side insertion publishes the first stream's prompt+generated
+        # run, so later prompts match their WHOLE 20-token shared prefix (the
+        # partial third block rides CoW), not just the 2 fully-shared blocks
+        assert stats["tokens_avoided"] == 20 * (len(PROMPTS_SHARED) - 1)
+        # a finished prompt's own full sequence is cached: the probe caps at
+        # total-1 (the last token always prefills)
+        assert batcher.cached_prefix_tokens(PROMPTS_SHARED[0]) == len(PROMPTS_SHARED[0]) - 1
     finally:
         batcher.close()
 

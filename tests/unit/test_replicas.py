@@ -180,7 +180,7 @@ def test_stats_aggregates_across_replicas():
     assert len(stats["per_replica"]) == 2
     loads = replica_set.replica_loads()
     assert loads[1] == {
-        "replica": 1, "resident": 4, "waiting": 1, "free_slots": 0,
+        "replica": 1, "role": "mixed", "resident": 4, "waiting": 1, "free_slots": 0,
         "prefill_backlog_tokens": 0, "shed_queue_full": 0, "shed_deadline": 0,
     }
 

@@ -16,6 +16,7 @@ from unionml_tpu.analysis.rules.tpu004_blocking import BlockingCallInServingLoop
 from unionml_tpu.analysis.rules.tpu005_env import BareEnvNumericParse
 from unionml_tpu.analysis.rules.tpu006_wall_clock import WallClockDuration
 from unionml_tpu.analysis.rules.tpu007_locked_callers import UnlockedLockedHelperCall
+from unionml_tpu.analysis.rules.tpu008_thread_leak import LeakedEngineThread
 
 __all__ = ["RULES"]
 
@@ -29,5 +30,6 @@ RULES = {
         BareEnvNumericParse,
         WallClockDuration,
         UnlockedLockedHelperCall,
+        LeakedEngineThread,
     )
 }

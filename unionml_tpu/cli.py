@@ -95,13 +95,14 @@ def templates() -> None:
 def lint(
     paths: "tuple[str, ...]", format_: str, select: Optional[str], ignore: Optional[str], show_suppressed: bool
 ) -> None:
-    """Run tpu-lint, the TPU/concurrency-aware static analyzer (TPU001-TPU007).
+    """Run tpu-lint, the TPU/concurrency-aware static analyzer (TPU001-TPU008).
 
     Checks for host syncs inside jit-compiled functions, use-after-donate,
     unlocked mutation of lock-guarded state, blocking calls in serving
     handlers/engine loops, bare env-var numeric parses, wall-clock
-    time.time() in duration/deadline arithmetic, and *_locked helpers called
-    without holding the lock. PATHS defaults to ``unionml_tpu``; exits 0 when
+    time.time() in duration/deadline arithmetic, *_locked helpers called
+    without holding the lock, and threads started in closeable classes but
+    never joined. PATHS defaults to ``unionml_tpu``; exits 0 when
     clean, 1 on findings, 2 on usage/parse errors. Also runnable as
     ``python -m unionml_tpu.analysis``.
     """
@@ -261,6 +262,42 @@ def fetch_model(
     "data/fsdp axes)",
 )
 @click.option(
+    "--replica-roles", default=None,
+    help="disaggregated serving: per-role replica counts, e.g. 'prefill=1,decode=3' — "
+    "prompts above --prefill-threshold prefill on a prefill-role replica and their KV "
+    "blocks hand off to a decode replica at admission-complete (token-identical to a "
+    "mixed replica, but resident decode streams never stall behind the prefill); "
+    "implies the fleet size when --dp-replicas is unset",
+)
+@click.option(
+    "--prefill-threshold", default=None, type=int,
+    help="prompt length (tokens) at which an admission takes the prefill→decode "
+    "handoff path (0 = every admission, once --replica-roles is set)",
+)
+@click.option(
+    "--autoscale-high", default=None, type=float,
+    help="elastic resize: per-replica load watermark above which the fleet adds a "
+    "replica on a spare submesh at runtime (also triggered while any replica's SLO "
+    "state is breach); 0/unset = autoscaler off",
+)
+@click.option(
+    "--autoscale-low", default=None, type=float,
+    help="per-replica load watermark below which the fleet drains one replica "
+    "(zero in-flight streams lost); 0 = never scale down",
+)
+@click.option(
+    "--autoscale-interval", default=None, type=float,
+    help="seconds between autoscaler evaluations of the fleet's windowed rates",
+)
+@click.option(
+    "--min-replicas", default=None, type=int,
+    help="fleet-size floor the autoscaler may never drain below",
+)
+@click.option(
+    "--max-replicas", default=None, type=int,
+    help="fleet-size ceiling for the autoscaler (0 = bounded by spare submeshes/devices)",
+)
+@click.option(
     "--admit-chunk", default=None, type=int,
     help="stall-free admission: slice each generation admission's prefill into this many "
     "tokens per chunk, interleaved with decode dispatches so long prompts never freeze "
@@ -346,6 +383,13 @@ def serve(
     max_deadline_ms: Optional[float],
     drain_timeout: Optional[float],
     dp_replicas: Optional[int],
+    replica_roles: Optional[str],
+    prefill_threshold: Optional[int],
+    autoscale_high: Optional[float],
+    autoscale_low: Optional[float],
+    autoscale_interval: Optional[float],
+    min_replicas: Optional[int],
+    max_replicas: Optional[int],
     admit_chunk: Optional[int],
     prefill_budget: Optional[int],
     max_admissions: Optional[int],
@@ -382,6 +426,19 @@ def serve(
     per replica, least-loaded routing, per-replica occupancy on ``/metrics``.
     Exported as an env var BEFORE the app module imports, so engines built at
     import time replicate too.
+
+    ``--replica-roles`` (docs/serving.md "Disaggregated and elastic serving")
+    splits the fleet DistServe-style: prompts at least ``--prefill-threshold``
+    tokens long prefill on a prefill-role replica and their finished KV
+    blocks hand off to a decode replica — token-identical to a mixed replica,
+    with resident decode streams never stalling behind a long prefill.
+    ``--autoscale-high``/``--autoscale-low`` arm the elastic resize loop:
+    above the high watermark (or while any replica's SLO state is breach) a
+    replica is added on a spare submesh at runtime, below the low watermark
+    one drains with zero in-flight streams lost, bounded by
+    ``--min-replicas``/``--max-replicas`` and evaluated every
+    ``--autoscale-interval`` seconds. All exported before the app module
+    imports, like ``--dp-replicas``.
 
     ``--admit-chunk`` / ``--prefill-budget`` / ``--max-admissions``
     (docs/serving.md "Stall-free admission") chunk the continuous engine's
@@ -431,6 +488,36 @@ def serve(
         from unionml_tpu.defaults import SERVE_DP_REPLICAS_ENV_VAR
 
         os.environ[SERVE_DP_REPLICAS_ENV_VAR] = str(dp_replicas)
+    if replica_roles is not None:
+        # validate NOW (a typo'd explicit flag is a usage error, unlike an
+        # inherited env, which the ReplicaSet degrades on with a warning),
+        # then export before the app module imports — the --dp-replicas
+        # contract
+        from unionml_tpu import defaults as _defaults
+
+        try:
+            _defaults.parse_replica_roles(replica_roles)
+        except ValueError as exc:
+            raise click.ClickException(f"--replica-roles: {exc}")
+        os.environ[_defaults.SERVE_REPLICA_ROLES_ENV_VAR] = replica_roles
+    disagg_knobs = (
+        ("--prefill-threshold", prefill_threshold, "SERVE_PREFILL_THRESHOLD_ENV_VAR", int),
+        ("--autoscale-high", autoscale_high, "SERVE_AUTOSCALE_HIGH_ENV_VAR", float),
+        ("--autoscale-low", autoscale_low, "SERVE_AUTOSCALE_LOW_ENV_VAR", float),
+        ("--autoscale-interval", autoscale_interval, "SERVE_AUTOSCALE_INTERVAL_S_ENV_VAR", float),
+        ("--min-replicas", min_replicas, "SERVE_MIN_REPLICAS_ENV_VAR", int),
+        ("--max-replicas", max_replicas, "SERVE_MAX_REPLICAS_ENV_VAR", int),
+    )
+    if any(value is not None for _, value, _, _ in disagg_knobs):
+        from unionml_tpu import defaults as _defaults
+
+        for flag, value, env_name, cast in disagg_knobs:
+            if value is None:
+                continue
+            floor = 1 if flag == "--min-replicas" else 0
+            if value < floor:
+                raise click.ClickException(f"{flag} must be >= {floor}")
+            os.environ[getattr(_defaults, env_name)] = repr(cast(value))
     if prefix_cache is not None:
         # same early-export contract as --dp-replicas: paged engines built at
         # app-module import time must see the knob
@@ -530,7 +617,9 @@ def serve(
         default_deadline_ms=deadline_ms,
         max_deadline_ms=max_deadline_ms,
         drain_timeout_s=drain_timeout,
-    ).configure_replicas(dp_replicas).configure_quantization(
+    ).configure_replicas(
+        dp_replicas, replica_roles=replica_roles, prefill_threshold=prefill_threshold
+    ).configure_quantization(
         quantize=quantize, kv_cache_dtype=kv_cache_dtype
     ).configure_observability(
         trace=trace,

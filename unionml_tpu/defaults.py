@@ -97,6 +97,40 @@ SERVE_MAX_ADMISSIONS_ENV_VAR = "UNIONML_TPU_MAX_ADMISSIONS"
 #: early-export contract as the admission knobs.
 SERVE_PREFIX_CACHE_ENV_VAR = "UNIONML_TPU_PREFIX_CACHE"
 
+# ------------------------------------------------------- disaggregated serving
+# Prefill/decode role split + elastic resize for the replica fleet
+# (serving/replicas.py, docs/serving.md "Disaggregated and elastic serving").
+# Same early-export contract as SERVE_DP_REPLICAS_ENV_VAR: the serve CLI sets
+# these before the app module imports, and the ReplicaSet resolves them at
+# construction — existing apps disaggregate with zero code changes.
+
+#: replica role assignment, e.g. ``prefill=1,decode=3`` (roles: prefill /
+#: decode / mixed; counts sum to the fleet size). Unset/empty = every replica
+#: mixed (today's symmetric fleet). Garbage warns and falls back to symmetric.
+SERVE_REPLICA_ROLES_ENV_VAR = "UNIONML_TPU_REPLICA_ROLES"
+
+#: prompt-length threshold (tokens) above which an admission routes to a
+#: prefill-role replica and its finished KV hands off to a decode replica;
+#: 0 (the default) disaggregates every admission once roles are configured.
+SERVE_PREFILL_THRESHOLD_ENV_VAR = "UNIONML_TPU_PREFILL_THRESHOLD"
+
+#: autoscaler high watermark on per-replica scheduling load (the engine's
+#: token-weighted ``load()`` averaged over the fleet); 0 = autoscaler off.
+SERVE_AUTOSCALE_HIGH_ENV_VAR = "UNIONML_TPU_AUTOSCALE_HIGH"
+
+#: autoscaler low watermark (scale down below it); 0 = never scale down.
+SERVE_AUTOSCALE_LOW_ENV_VAR = "UNIONML_TPU_AUTOSCALE_LOW"
+
+#: seconds between autoscaler evaluations of the windowed rates.
+SERVE_AUTOSCALE_INTERVAL_S_ENV_VAR = "UNIONML_TPU_AUTOSCALE_INTERVAL_S"
+SERVE_AUTOSCALE_INTERVAL_S = 10.0
+
+#: fleet-size floor the autoscaler may never drain below.
+SERVE_MIN_REPLICAS_ENV_VAR = "UNIONML_TPU_MIN_REPLICAS"
+
+#: fleet-size ceiling; 0 = bounded by the spare submeshes/devices available.
+SERVE_MAX_REPLICAS_ENV_VAR = "UNIONML_TPU_MAX_REPLICAS"
+
 # ------------------------------------------------------------ quantized serving
 # Serve-time quantization knobs (docs/serving.md "Quantized serving"). Decode is
 # HBM-bandwidth bound and the KV cache dominates resident memory at scale:
@@ -285,6 +319,88 @@ def serve_prefix_cache() -> bool:
     (``UNIONML_TPU_PREFIX_CACHE=1``); read at engine construction, after the
     CLI's early export, same contract as :func:`serve_admit_chunk`."""
     return env_int(SERVE_PREFIX_CACHE_ENV_VAR, 0, minimum=0) > 0
+
+
+#: roles a replica may carry (serving/replicas.py); "mixed" is today's
+#: prefill-and-decode-in-one behavior and the default for every replica.
+REPLICA_ROLES = ("prefill", "decode", "mixed")
+
+
+def parse_replica_roles(raw: str) -> "dict[str, int]":
+    """Parse a ``prefill=1,decode=3`` role spec into ``{role: count}``.
+    Raises ``ValueError`` naming the offending entry — the CLI surfaces it as
+    a usage error; the env reader below degrades instead."""
+    out: "dict[str, int]" = {}
+    for entry in raw.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        role, sep, count = entry.partition("=")
+        role = role.strip().lower()
+        if not sep or role not in REPLICA_ROLES:
+            raise ValueError(
+                f"bad replica-role entry {entry!r}; expected role=count with role in "
+                f"{REPLICA_ROLES} (e.g. 'prefill=1,decode=3')"
+            )
+        try:
+            n = int(count.strip())
+        except ValueError:
+            raise ValueError(f"bad replica-role count in {entry!r}; expected an integer")
+        if n < 0:
+            raise ValueError(f"replica-role count must be >= 0 in {entry!r}")
+        out[role] = out.get(role, 0) + n
+    return {role: n for role, n in out.items() if n > 0}
+
+
+def serve_replica_roles() -> "dict[str, int]":
+    """The serve-time ``--replica-roles`` export parsed to ``{role: count}``;
+    ``{}`` = unset (a symmetric, all-mixed fleet). Read at ReplicaSet
+    construction, after the CLI's early export — garbage warns and falls back
+    to symmetric rather than crashing serve at app-import time."""
+    raw = os.environ.get(SERVE_REPLICA_ROLES_ENV_VAR)
+    if raw is None or not raw.strip():
+        return {}
+    try:
+        return parse_replica_roles(raw)
+    except ValueError as exc:
+        logger.warning(
+            f"ignoring {SERVE_REPLICA_ROLES_ENV_VAR}={raw!r} ({exc}); "
+            "falling back to a symmetric (all-mixed) fleet"
+        )
+        return {}
+
+
+def serve_prefill_threshold() -> int:
+    """Prompt-length threshold (tokens) for routing to prefill-role replicas;
+    0 = every admission disaggregates once roles are configured."""
+    return env_int(SERVE_PREFILL_THRESHOLD_ENV_VAR, 0, minimum=0)
+
+
+def serve_autoscale_high() -> float:
+    """Autoscaler high watermark on per-replica load; 0.0 = autoscaler off."""
+    return env_float(SERVE_AUTOSCALE_HIGH_ENV_VAR, 0.0, minimum=0.0)
+
+
+def serve_autoscale_low() -> float:
+    """Autoscaler low watermark; 0.0 = never scale down."""
+    return env_float(SERVE_AUTOSCALE_LOW_ENV_VAR, 0.0, minimum=0.0)
+
+
+def serve_autoscale_interval_s() -> float:
+    """Seconds between autoscaler evaluations."""
+    return env_float(
+        SERVE_AUTOSCALE_INTERVAL_S_ENV_VAR, SERVE_AUTOSCALE_INTERVAL_S, minimum=0.05
+    )
+
+
+def serve_min_replicas() -> int:
+    """Fleet-size floor for the autoscaler."""
+    return env_int(SERVE_MIN_REPLICAS_ENV_VAR, 1, minimum=1)
+
+
+def serve_max_replicas() -> int:
+    """Fleet-size ceiling for the autoscaler; 0 = spare-capacity bound."""
+    return env_int(SERVE_MAX_REPLICAS_ENV_VAR, 0, minimum=0)
 
 
 def serve_trace() -> bool:

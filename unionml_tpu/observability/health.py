@@ -118,7 +118,14 @@ def _engines(batcher: Any) -> "List[Any]":
 def _replica_health(engine: Any, index: int) -> Dict[str, Any]:
     health_fn = getattr(engine, "health", None)
     health = health_fn() if callable(health_fn) else dict(DISABLED)
-    return {"replica": index, **health}
+    entry = {"replica": index, **health}
+    role = getattr(engine, "role", None)
+    if role is not None:
+        # disaggregated fleets: the replica's role rides every health entry
+        # (a string — the Prometheus exposition skips it by design; the
+        # numeric series stay score/state_code)
+        entry["role"] = role
+    return entry
 
 
 def fleet_health(batcher: Optional[Any]) -> Dict[str, Any]:
@@ -158,4 +165,27 @@ def fleet_debug(batcher: Optional[Any]) -> Dict[str, Any]:
     breach_avoided = getattr(batcher, "breach_avoided", None)
     if breach_avoided is not None:
         out["breach_avoided"] = int(breach_avoided)
+    roles = getattr(batcher, "roles", None)
+    if isinstance(roles, list) and any(role != "mixed" for role in roles):
+        # disaggregated fleets: the role census and handoff telemetry in the
+        # same debug fetch — "who is prefill, who is decode, and how much
+        # work crossed between them" (cheap attribute reads, not a full
+        # stats() walk)
+        out["roles"] = list(roles)
+        out["handoffs"] = {
+            "routed": int(getattr(batcher, "handoff_routes", 0)),
+            "shortcuts": int(getattr(batcher, "handoff_shortcuts", 0)),
+            "exported": sum(
+                int(getattr(engine, "handoffs_exported", 0)) for engine in _engines(batcher)
+            ),
+            "imported": sum(
+                int(getattr(engine, "handoffs_imported", 0)) for engine in _engines(batcher)
+            ),
+        }
+    scaled = int(getattr(batcher, "scaled_up", 0)) + int(getattr(batcher, "scaled_down", 0))
+    if scaled:
+        out["resize"] = {
+            "scaled_up": int(getattr(batcher, "scaled_up", 0)),
+            "scaled_down": int(getattr(batcher, "scaled_down", 0)),
+        }
     return out

@@ -174,6 +174,7 @@ class ServingApp:
         self.server.route("GET", "/debug/requests", self._debug_requests)
         self.server.route_prefix("GET", "/debug/requests/", self._debug_request_by_id)
         self.server.route("GET", "/debug/fleet", self._debug_fleet)
+        self.server.route("POST", "/debug/scale", self._debug_scale)
         self.server.route("POST", "/debug/profile", self._debug_profile)
 
     # ------------------------------------------------------------------ lifecycle
@@ -232,17 +233,37 @@ class ServingApp:
             self.server.access_log = bool(access_log)
         return self
 
-    def configure_replicas(self, dp_replicas: Optional[int] = None) -> "ServingApp":
-        """Record the serve-time ``--dp-replicas`` override and export it so
-        generation engines built after startup (warmup hooks, first-request
-        construction) replicate: ``ContinuousBatcher(...)`` consults the env
-        var and transparently builds a
-        :class:`~unionml_tpu.serving.replicas.ReplicaSet`."""
+    def configure_replicas(
+        self,
+        dp_replicas: Optional[int] = None,
+        *,
+        replica_roles: Optional[str] = None,
+        prefill_threshold: Optional[int] = None,
+    ) -> "ServingApp":
+        """Record the serve-time ``--dp-replicas`` / ``--replica-roles`` /
+        ``--prefill-threshold`` overrides and export them so generation
+        engines built after startup (warmup hooks, first-request
+        construction) replicate — and disaggregate:
+        ``ContinuousBatcher(...)`` consults the env vars and transparently
+        builds a :class:`~unionml_tpu.serving.replicas.ReplicaSet` with the
+        requested prefill/decode role split (docs/serving.md "Disaggregated
+        and elastic serving")."""
         if dp_replicas is not None:
             if dp_replicas < 0:
                 raise ValueError("dp_replicas must be >= 0 (0 = derive from the mesh)")
             self.dp_replicas = dp_replicas
             os.environ[SERVE_DP_REPLICAS_ENV_VAR] = str(dp_replicas)
+        if replica_roles is not None:
+            from unionml_tpu.defaults import SERVE_REPLICA_ROLES_ENV_VAR, parse_replica_roles
+
+            parse_replica_roles(replica_roles)  # explicit config must not degrade silently
+            os.environ[SERVE_REPLICA_ROLES_ENV_VAR] = replica_roles
+        if prefill_threshold is not None:
+            from unionml_tpu.defaults import SERVE_PREFILL_THRESHOLD_ENV_VAR
+
+            if prefill_threshold < 0:
+                raise ValueError("prefill_threshold must be >= 0")
+            os.environ[SERVE_PREFILL_THRESHOLD_ENV_VAR] = str(prefill_threshold)
         return self
 
     def configure_quantization(
@@ -421,6 +442,39 @@ class ServingApp:
         payload["tracing"] = self.tracer.enabled
         payload["exemplars"] = self.recorder.exemplar_count
         return 200, payload, "application/json"
+
+    async def _debug_scale(self, body: bytes):
+        """Operator-driven elastic resize: ``POST /debug/scale`` with
+        ``{"replicas": N}`` (optional ``"role"`` for added replicas) calls the
+        generation fleet's ``scale_to`` — scale-up places params on a spare
+        submesh and warms before joining the scheduler; scale-down drains the
+        tail replica with zero in-flight streams lost. The resize (warmup
+        included) runs in the default executor so the event loop keeps
+        serving while it completes; the response reports the new fleet
+        health, which ``/healthz``/``/metrics`` already reflect."""
+        batcher = getattr(self.model, "generation_batcher", None)
+        scale = getattr(batcher, "scale_to", None)
+        if not callable(scale):
+            raise HTTPError(
+                400,
+                "no elastic generation fleet to scale; serve a ReplicaSet "
+                "(e.g. --dp-replicas/--replica-roles) and set model.generation_batcher",
+            )
+        payload = self._parse_json_object(body)
+        replicas = payload.get("replicas")
+        if not isinstance(replicas, int) or isinstance(replicas, bool) or replicas < 1:
+            raise HTTPError(400, f"replicas must be a positive integer, got {replicas!r}")
+        role = payload.get("role")
+        if role is not None and role not in ("prefill", "decode", "mixed"):
+            raise HTTPError(400, f"role must be prefill/decode/mixed, got {role!r}")
+        loop = asyncio.get_running_loop()
+        try:
+            count = await loop.run_in_executor(None, lambda: scale(replicas, role=role))
+        except (ValueError, RuntimeError) as exc:
+            raise HTTPError(400, f"scale_to failed: {exc}")
+        from unionml_tpu.observability.health import fleet_health
+
+        return 200, {"replicas": count, "health": fleet_health(batcher)}, "application/json"
 
     async def _metrics(self, body: bytes):
         """Request counters and latency percentiles per route (SURVEY.md §5.5 —

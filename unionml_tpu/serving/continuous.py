@@ -69,6 +69,7 @@ from unionml_tpu.defaults import (
     serve_max_admissions,
     serve_prefill_budget,
     serve_prefix_cache,
+    serve_replica_roles,
 )
 from unionml_tpu.observability.trace import current_trace
 from unionml_tpu.observability.slo import SLOConfig, SLOTracker
@@ -156,6 +157,24 @@ class _Session:
     #: eviction while its table references them); released on
     #: finish/cancel/preempt via ``_release_blocks_locked``
     pins: "List[int]" = dataclasses.field(default_factory=list)
+    #: the ACTUAL block ids behind the first ``table_len`` table entries, in
+    #: table order (paged mode only) — the decode-side radix publish needs the
+    #: ids covering the finished stream's prompt + generated tokens, which
+    #: ``_slot_blocks`` alone cannot reconstruct once ownership of prompt
+    #: blocks moved to the tree
+    table: "List[int]" = dataclasses.field(default_factory=list)
+    #: disaggregated serving (docs/serving.md): an EXPORT session runs its
+    #: prefill here but never takes residency — at admission-complete the
+    #: first token is emitted and the prefilled row is packaged as ``handoff``
+    #: for a decode-role replica to import
+    export: bool = False
+    #: the export payload (set just before the sentinel); the replica layer's
+    #: relay reads it off the finished stream and imports it elsewhere
+    handoff: "Optional[Dict[str, Any]]" = None
+    #: an IMPORT session's inbound payload (a sibling replica's export): the
+    #: admission skips prefill entirely — the row is placed onto this engine's
+    #: submesh and scattered into freshly allocated blocks
+    pending_import: "Optional[Dict[str, Any]]" = None
 
 
 @dataclasses.dataclass(eq=False)  # identity semantics: fields hold device arrays
@@ -226,6 +245,14 @@ class _TokenStream:
 
     def close(self) -> None:
         self._batcher._cancel(self._session)
+
+    @property
+    def handoff(self) -> "Optional[Dict[str, Any]]":
+        """The export payload of a ``submit(..., export_handoff=True)`` stream
+        once it has finished (None while in flight, or when the stream
+        completed outright — eos/budget at the prompt-sampled token, a shed, or
+        a cancel). The replica layer imports it into a decode-role replica."""
+        return self._session.handoff
 
     def __del__(self):  # pragma: no cover - refcount backstop
         try:
@@ -298,6 +325,17 @@ class ContinuousBatcher:
     :class:`~unionml_tpu.observability.slo.SLOConfig` overrides them, and
     ``False`` disables the layer entirely (the pre-health engine, byte for
     byte). ``stats()`` gains ``rates`` (and ``slo`` when targets are armed).
+
+    ``role`` (disaggregated serving, docs/serving.md "Disaggregated and
+    elastic serving") tags the engine ``prefill``/``decode``/``mixed`` for the
+    replica layer and unlocks the KV handoff pair:
+    ``submit(..., export_handoff=True)`` runs ONLY the prefill here — the
+    stream emits the prompt-sampled token, ends, and carries the prefilled
+    dense KV row on its ``handoff`` attribute — and :meth:`import_handoff` on
+    a sibling engine adopts that row into freshly allocated blocks without
+    re-running any prefill. Output across the pair is bit-identical to a
+    single mixed engine serving the same request. ``None`` (the default)
+    keeps ``stats()`` byte-for-byte the role-less ones.
     """
 
     def __new__(cls, generator: Optional[Generator] = None, **engine_kwargs: Any):
@@ -317,10 +355,22 @@ class ContinuousBatcher:
                 for axis in ("dcn_data", "data", "fsdp"):
                     dp *= int(mesh.shape.get(axis, 1))
             env = serve_dp_replicas()
-            if dp > 1 or env > 1:
+            # a role spec implies its own fleet size (prefill=1,decode=3 is a
+            # 4-replica fleet) — `serve --replica-roles` alone must replicate,
+            # exactly like --dp-replicas; an explicit roles= kwarg does too
+            roles_kw = engine_kwargs.get("roles")
+            if isinstance(roles_kw, dict):
+                role_total = sum(roles_kw.values())
+            elif isinstance(roles_kw, (list, tuple)):
+                role_total = len(roles_kw)
+            else:
+                role_total = sum(serve_replica_roles().values())
+            if dp > 1 or env > 1 or role_total > 1:
                 from unionml_tpu.serving.replicas import ReplicaSet
 
-                return ReplicaSet.from_generator(generator, replicas=env or None, **engine_kwargs)
+                return ReplicaSet.from_generator(
+                    generator, replicas=env or (role_total or None), **engine_kwargs
+                )
         return super().__new__(cls)
 
     @classmethod
@@ -349,9 +399,14 @@ class ContinuousBatcher:
         trace: Optional[bool] = None,
         prefix_cache: Optional[bool] = None,
         slo: Optional[Any] = None,
+        role: Optional[str] = None,
     ):
         if slots < 1:
             raise ValueError("slots must be >= 1")
+        if role is not None and role not in ("prefill", "decode", "mixed"):
+            raise ValueError(
+                f"role must be one of 'prefill'/'decode'/'mixed' (or None), got {role!r}"
+            )
         if decode_chunk < 1:
             raise ValueError("decode_chunk must be >= 1")
         if block_size is not None and block_size < 1:
@@ -404,6 +459,18 @@ class ContinuousBatcher:
         #: and budgets), so concurrent streams share draft+verify dispatches
         #: and each greedy stream still equals its solo target-only run
         self._spec = generator._speculative() if cfg.draft is not None else None
+        #: disaggregated-serving role (informational except for the guards
+        #: below; None = a role-less engine whose stats() stay byte-for-byte
+        #: the historical ones). The replica scheduler routes long-prompt
+        #: admissions to prefill-role engines and hands their finished KV off
+        #: to decode-role engines (docs/serving.md "Disaggregated and elastic
+        #: serving").
+        self.role = role
+        if role == "prefill" and self._spec is not None:
+            raise ValueError(
+                "a prefill-role engine does not compose with speculative decoding "
+                "(config.draft) yet: the draft's row cannot ride the KV handoff"
+            )
         if prefix is not None and not isinstance(prefix, PrefixCache):
             raise TypeError(f"prefix must be a PrefixCache (from generator.cache_prefix), got {type(prefix).__name__}")
         #: speculative × prefix: the draft model needs the system prompt in ITS
@@ -584,6 +651,11 @@ class ContinuousBatcher:
         self._free = list(range(slots))
         self._cancelled: "List[_Session]" = []  # resident sessions whose consumer went away
         self._closed = False
+        #: scale-down quiesce (replicas.py): a quiesced engine sheds NEW
+        #: submits with QueueFullError — the replica scheduler walks past it —
+        #: while its pending queue and residents drain to completion, so a
+        #: resize never truncates a stream a stale routing snapshot sent here
+        self._quiesced = False
         self._carry: Optional[tuple] = None  # (cache, tok, lengths, done, key)
         self._seed = 0
         self._thread: Optional[threading.Thread] = None
@@ -651,6 +723,13 @@ class ContinuousBatcher:
         self.prefix_cache_misses = 0
         self.prefix_cache_tokens_avoided = 0
         self.prefix_cache_cow = 0
+        #: disaggregated-serving telemetry: prefilled rows exported to a
+        #: sibling replica, rows imported from one, and the export→resident
+        #: transfer latency (zero/empty — and absent from stats() — on
+        #: role-less engines)
+        self.handoffs_exported = 0
+        self.handoffs_imported = 0
+        self._handoff_ms = LatencyWindow()
         #: overload counters: waiting-queue-full sheds and deadline sheds
         self.shed_queue_full = 0
         self.shed_deadline = 0
@@ -983,6 +1062,7 @@ class ContinuousBatcher:
     def submit(
         self, prompt: Sequence[int], *, max_new_tokens: Optional[int] = None,
         constraint: Optional[int] = None, deadline: Optional[float] = None,
+        export_handoff: bool = False,
     ) -> Iterator[np.ndarray]:
         """Enqueue a prompt; returns an iterator of 1-D int32 arrays of new
         tokens (first item is the prompt-sampled token). Blocks-free: the
@@ -996,9 +1076,19 @@ class ContinuousBatcher:
         ``time.monotonic()``) sheds the request if it is still WAITING for a
         slot past that instant; when the waiting queue already holds
         ``max_waiting`` live requests, submit sheds immediately with
-        :class:`QueueFullError` (HTTP 429) instead of queueing unboundedly."""
+        :class:`QueueFullError` (HTTP 429) instead of queueing unboundedly.
+
+        ``export_handoff`` (disaggregated serving, the prefill-role path) runs
+        ONLY the prefill here: the prompt-sampled first token is emitted and
+        the stream then ends with the prefilled KV row packaged on the
+        stream's ``handoff`` attribute for :meth:`import_handoff` on a decode
+        replica — this engine never spends a decode slot on the request."""
         if len(prompt) == 0:
             raise ValueError("prompt must be non-empty")
+        if export_handoff and self._spec is not None:
+            raise ValueError(
+                "export_handoff does not compose with speculative decoding (config.draft)"
+            )
         req_trace = current_trace() if self.trace_requests else None
         if expired(deadline):
             # under the lock: submit runs on arbitrary executor threads, and the
@@ -1025,13 +1115,18 @@ class ContinuousBatcher:
             grammar = int(constraint)
         session = _Session(
             slot=-1, out=queue.Queue(), max_new=budget, grammar=grammar, deadline=deadline,
-            created_at=time.monotonic(), trace=req_trace,
+            created_at=time.monotonic(), trace=req_trace, export=export_handoff,
             # the original prompt is retained only where preemption can resume it
             prompt=list(prompt) if self.block_size is not None else [],
         )
         with self._lock:
             if self._closed:
                 raise RuntimeError("ContinuousBatcher is closed")
+            if self._quiesced:
+                # draining for a scale-down: bounce the request back to the
+                # replica scheduler (which walks to a live sibling) without
+                # polluting the overload counters — this is routing, not load
+                raise QueueFullError("replica is quiescing for a fleet resize")
             # admission control: count LIVE waiters (cancelled heads awaiting
             # reap don't hold capacity against new arrivals)
             waiting = sum(1 for _, s in self._pending if not s.finished)
@@ -1056,6 +1151,46 @@ class ContinuousBatcher:
             req_trace.event(
                 "engine.submit", prompt_tokens=len(prompt), queued_behind=waiting
             )
+        return _TokenStream(self, session)
+
+    def import_handoff(self, payload: Dict[str, Any]) -> Iterator[np.ndarray]:
+        """Adopt a sibling replica's exported prefill (disaggregated serving,
+        the decode-role path): the payload's dense KV row is ``device_put``
+        onto this engine's submesh and scattered into freshly allocated blocks
+        at admission time — no prefill runs here, so the import costs one
+        paste dispatch. The returned stream carries every token AFTER the
+        prompt-sampled one (which the exporting replica already emitted); the
+        next sampled token is bit-identical to the one a no-handoff run on a
+        single mixed replica would produce, because the handed-off KV is
+        bit-identical to what this engine's own prefill would have written.
+
+        Imports bypass ``max_waiting``: the prefill cost is already paid and
+        the volume is bounded by the exporting replicas' slot pools, so
+        shedding here would waste finished work."""
+        trace = payload.get("trace") if self.trace_requests else None
+        session = _Session(
+            slot=-1,
+            out=queue.Queue(),
+            max_new=int(payload["max_new"]),
+            produced=int(payload["produced"]),
+            grammar=int(payload.get("grammar", 0)),
+            deadline=payload.get("deadline"),
+            created_at=payload.get("created_at", time.monotonic()),
+            trace=trace,
+            prompt=list(payload["prompt"]) if self.block_size is not None else [],
+            echo=list(payload["echo"]) if self.block_size is not None else [],
+        )
+        session.pending_import = dict(payload)
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("ContinuousBatcher is closed")
+            if self._quiesced:
+                raise QueueFullError("replica is quiescing for a fleet resize")
+            self._pending.append((list(payload["prompt"]), session))
+            if self._thread is None:
+                self._thread = threading.Thread(target=self._engine_loop, daemon=True)
+                self._thread.start()
+            self._lock.notify_all()
         return _TokenStream(self, session)
 
     def _cancel(self, session: _Session) -> None:
@@ -1137,6 +1272,9 @@ class ContinuousBatcher:
                 self._radix_reset_locked()
             self._ttft.clear()  # warmup probes must not skew the percentiles
             self._tbt.clear()
+            self.handoffs_exported = 0
+            self.handoffs_imported = 0
+            self._handoff_ms.clear()
             if self.timeseries is not None:
                 # probe tokens/admissions must not read as real traffic rates
                 self.timeseries.clear()
@@ -1215,7 +1353,10 @@ class ContinuousBatcher:
         remainder once stepping started, else the prompt minus its radix-
         cached run (``adm.start`` still holds the static prefix length before
         :meth:`_admission_begin` runs) — a cache hit is backlog the scheduler
-        must not route around."""
+        must not route around. An imported handoff owes NO prefill (the row
+        arrives finished), so it contributes nothing."""
+        if adm.session.pending_import is not None:
+            return 0
         if adm.tokens is not None:
             return max(adm.width - adm.pos, 0)
         remaining = max(len(adm.prompt), 1)
@@ -1334,6 +1475,16 @@ class ContinuousBatcher:
                     "pinned_blocks": self._radix.pinned_blocks(),
                     "nodes": self._radix.nodes(),
                 }
+            if self.role is not None:
+                # disaggregated serving: the engine's role plus its handoff
+                # counters (ints only; the transfer-latency window rides the
+                # post-lock section below) — absent on role-less engines, so
+                # their stats stay byte-for-byte the historical ones
+                snapshot["role"] = self.role
+                snapshot["handoff"] = {
+                    "exported": self.handoffs_exported,
+                    "imported": self.handoffs_imported,
+                }
             if self._spec is not None and self._spec.rounds:
                 snapshot["acceptance_rate"] = round(
                     self._spec.accepted_tokens / (self._spec.rounds * self._spec.gamma), 3
@@ -1349,6 +1500,10 @@ class ContinuousBatcher:
         # window reports {"window": 0}, never a None gauge
         snapshot["ttft_ms"] = self._ttft.snapshot()
         snapshot["tbt_ms"] = self._tbt.snapshot()
+        if self.role is not None:
+            # export→resident transfer latency (decode-role replicas observe
+            # it at import finalize); {"window": 0} until a handoff lands
+            snapshot["handoff"]["transfer_ms"] = self._handoff_ms.snapshot()
         if self.timeseries is not None:
             # windowed rates over the SLO fast window (the autoscaling signal,
             # rendered as gauges in the Prometheus exposition); backlog reuses
@@ -1361,6 +1516,15 @@ class ContinuousBatcher:
         if self.slo is not None and self.slo.armed:
             snapshot["slo"] = self.slo.evaluate(self.timeseries)
         return snapshot
+
+    def quiesce(self) -> None:
+        """Stop ACCEPTING new submissions (they shed with
+        :class:`QueueFullError`, which the replica scheduler routes around)
+        while everything already queued or resident keeps running to
+        completion — the first phase of a zero-loss scale-down; :meth:`close`
+        is the second, once :meth:`occupancy` reads empty."""
+        with self._lock:
+            self._quiesced = True
 
     def close(self, wait: bool = True, timeout: float = 120.0) -> None:
         """Stop admitting new requests, DRAIN resident streams — and
@@ -1481,7 +1645,10 @@ class ContinuousBatcher:
                             adm.session.out.put(exc)
                     raise
                 if adm.done:
-                    self._finalize_admission(adm)
+                    if adm.session.export:
+                        self._export_admission(adm)
+                    else:
+                        self._finalize_admission(adm)
                 if budget is not None and spent >= budget:
                     return
 
@@ -1543,7 +1710,10 @@ class ContinuousBatcher:
                     # seeded leading table entries: the static prefix's full
                     # blocks, or (on a radix hit) the matched cached run
                     seeded = list(self._shared_prefix_blocks)
-                    if self._radix is not None:
+                    # imported handoffs skip the radix match: their row arrives
+                    # complete, so there is no prefill to skip — matching would
+                    # only pin blocks the gather path never reads
+                    if self._radix is not None and head_session.pending_import is None:
                         total = p0 + max(len(head_prompt), 1)
                         # cap at total - 1: the last prompt token always
                         # prefills so the first sampled token has its hidden
@@ -1579,6 +1749,7 @@ class ContinuousBatcher:
                     session.shared_blocks = len(seeded)
                     session.table_len = len(seeded) + len(alloc)
                     session.pins = pins
+                    session.table = list(seeded) + list(alloc)
                     blocks_row = np.full((self.max_blocks,), self._scratch_block, np.int32)
                     blocks_row[: len(seeded)] = seeded
                     blocks_row[len(seeded) : len(seeded) + len(alloc)] = alloc
@@ -1653,6 +1824,8 @@ class ContinuousBatcher:
         cfg = self.gen.config
         gen = self.gen
         prompt, session = adm.prompt, adm.session
+        if session.pending_import is not None:
+            return self._import_begin(adm)
         dfa_state = None
         if gen._cs is not None:
             # the DFA state is a pure function of (grammar, emitted tokens):
@@ -1739,6 +1912,45 @@ class ContinuousBatcher:
             if self._draft_prefix is not None:
                 d_row = _paste_prefix_rows(d_row, self._draft_prefix.layers)
             adm.d_row_cache = d_row
+        return 0
+
+    def _import_begin(self, adm: _Admission) -> int:
+        """Set up an imported-handoff admission (engine thread): place the
+        exported dense row onto THIS engine's submesh and mark the admission
+        complete — no prefill runs, so the cost is one ``device_put``. The
+        grammar state is recovered from the payload's emitted tokens exactly
+        as a preemption resume recovers it (the DFA is a pure function of the
+        emissions), stopping one short so :meth:`_finalize_admission`'s
+        standard advance past the first token lands on the right state."""
+        payload = adm.session.pending_import
+        row = payload["row"]
+        width = int(jax.tree_util.tree_leaves(row)[0].shape[1])
+        if width != self.cache_len:
+            raise ValueError(
+                f"handoff row width {width} != this engine's cache_len {self.cache_len}; "
+                "disaggregated replicas must be built with identical engine knobs"
+            )
+        # cross-submesh transfer: the exporting replica's [1, cache_len] row is
+        # re-placed under this engine's mesh (device_put copies between
+        # disjoint device sets; a meshless engine keeps the row where it is)
+        adm.row_cache = self.gen._place_cache(row)
+        adm.tok0 = jnp.asarray([int(payload["first"])], jnp.int32)
+        adm.row_len = jnp.asarray([int(payload["lengths"])], jnp.int32)
+        if self.gen._cs is not None:
+            cs = self.gen._cs
+            state = int(cs.starts[adm.session.grammar])
+            for t in list(payload["echo"])[:-1]:
+                state = int(cs.trans[state, int(t)])
+            adm.dfa_state = state
+            adm.cstate = (jnp.asarray([state], jnp.int32),)
+        adm.done = True
+        exported_at = payload.get("exported_at")
+        if exported_at is not None:
+            self._handoff_ms.observe(time.monotonic() - exported_at)
+        _tev(
+            adm.session, "engine.handoff_import",
+            tokens=int(payload["lengths"]), produced=adm.session.produced,
+        )
         return 0
 
     def _begin_cached(self, adm: _Admission) -> bool:
@@ -1835,6 +2047,73 @@ class ContinuousBatcher:
             adm.done = True
         return adm.chunk
 
+    def _export_admission(self, adm: _Admission) -> None:
+        """Complete an EXPORT admission (the prefill-role path): emit the
+        prompt-sampled first token, free the slot/blocks — the row never
+        pastes into this engine's pool — and package the prefilled dense row
+        as the session's handoff payload for a decode replica's
+        :meth:`import_handoff`. A request whose first token already ends the
+        stream (eos, or a budget of 1) finishes right here with no handoff —
+        there is nothing left to decode anywhere."""
+        cfg = self.gen.config
+        session, slot = adm.session, adm.slot
+        first = np.asarray(adm.tok0)
+        hit_eos = cfg.eos_id is not None and int(first[0]) == cfg.eos_id
+        done_now = hit_eos or session.produced + 1 >= session.max_new
+        row_cache, row_len = adm.row_cache, adm.row_len
+        adm.row_cache = adm.last = None
+        with self._lock:
+            if adm in self._admissions:
+                self._admissions.remove(adm)
+            self._free.append(slot)
+            self._release_blocks_locked(slot, session)
+            if session.finished:
+                # cancelled (or deadline-shed) during the unlocked prefill:
+                # the consumer already holds its sentinel — drop the row
+                return
+            session.out.put(first)
+            now = time.monotonic()
+            if session.produced == 0:
+                self._ttft.observe(now - session.created_at)
+                if self.slo is not None:
+                    self.slo.note_ttft(session.trace, (now - session.created_at) * 1e3)
+                _tev(
+                    session, "engine.first_token",
+                    ttft_ms=round((now - session.created_at) * 1e3, 3),
+                )
+            _tev(session, "engine.emit", tokens=1, produced=session.produced + 1)
+            session.last_emit = now
+            if self.block_size is not None:
+                session.echo.append(int(first[0]))
+            session.produced += 1
+            if self.timeseries is not None:
+                self.timeseries.admissions.add()
+                self.timeseries.tokens.add()
+            session.finished = True
+            if done_now:
+                _tev(session, "engine.finish", produced=session.produced)
+            else:
+                self.handoffs_exported += 1
+                session.handoff = {
+                    "prompt": list(adm.prompt),
+                    "first": int(first[0]),
+                    "row": row_cache,
+                    "lengths": int(np.asarray(row_len)[0]),
+                    "max_new": session.max_new,
+                    "produced": session.produced,
+                    "echo": [int(first[0])],
+                    "grammar": session.grammar,
+                    "deadline": session.deadline,
+                    "created_at": session.created_at,
+                    "trace": session.trace,
+                    "exported_at": now,
+                }
+                _tev(
+                    session, "engine.handoff_export",
+                    tokens=int(np.asarray(row_len)[0]), produced=session.produced,
+                )
+            session.out.put(_SENTINEL)
+
     def _finalize_admission(self, adm: _Admission) -> None:
         """Paste a completed admission's row(s) into the pool and activate its
         session — the donating admit dispatches plus carry/session
@@ -1850,8 +2129,11 @@ class ContinuousBatcher:
                 self._carry = self._init_carry()
             first = np.asarray(adm.tok0)
             hit_eos = cfg.eos_id is not None and int(first[0]) == cfg.eos_id
-            # produced carries across preemptions; this residency adds one token
-            start_done = hit_eos or session.produced + 1 >= session.max_new
+            # produced carries across preemptions; this residency adds one token.
+            # An imported handoff's first token was emitted (and its eos/budget
+            # endings handled) by the EXPORTING replica — it is never start-done
+            imported = session.pending_import is not None
+            start_done = not imported and (hit_eos or session.produced + 1 >= session.max_new)
             blocks_row = adm.blocks_row
             if self._spec is None:
                 cache, tok, lengths, done, key, *cst = self._carry
@@ -1919,31 +2201,43 @@ class ContinuousBatcher:
                 self._release_blocks_locked(slot, session)
                 self._mask_slot_done(slot)
                 return
-            session.out.put(first)
-            now = time.monotonic()
-            if session.produced == 0:
-                # first token EVER for this stream; a preemption resume is a
-                # later residency, not a first token
-                self._ttft.observe(now - session.created_at)
-                if self.slo is not None:
-                    self.slo.note_ttft(session.trace, (now - session.created_at) * 1e3)
-                _tev(
-                    session, "engine.first_token",
-                    ttft_ms=round((now - session.created_at) * 1e3, 3),
-                )
-            _tev(session, "engine.emit", tokens=1, produced=session.produced + 1)
-            if session.last_emit is not None:
-                self._tbt.observe(now - session.last_emit)
-                if self.slo is not None:
-                    self.slo.note_tbt(session.trace, (now - session.last_emit) * 1e3)
-            session.last_emit = now
-            if self.timeseries is not None:
-                self.timeseries.admissions.add()
-                self.timeseries.tokens.add()
-            if self.block_size is not None:  # echo exists only for preemption resume
-                session.echo.append(int(first[0]))
-            session.resident_base = session.produced
-            session.produced += 1
+            if imported:
+                # the exporting replica already emitted the first token and
+                # recorded TTFT; this residency only picks up decoding from
+                # produced=1 — exactly the device state a mixed replica holds
+                # right after its own finalize
+                session.pending_import = None
+                session.resident_base = 0
+                session.last_emit = time.monotonic()
+                if self.timeseries is not None:
+                    self.timeseries.admissions.add()
+                self.handoffs_imported += 1
+            else:
+                session.out.put(first)
+                now = time.monotonic()
+                if session.produced == 0:
+                    # first token EVER for this stream; a preemption resume is a
+                    # later residency, not a first token
+                    self._ttft.observe(now - session.created_at)
+                    if self.slo is not None:
+                        self.slo.note_ttft(session.trace, (now - session.created_at) * 1e3)
+                    _tev(
+                        session, "engine.first_token",
+                        ttft_ms=round((now - session.created_at) * 1e3, 3),
+                    )
+                _tev(session, "engine.emit", tokens=1, produced=session.produced + 1)
+                if session.last_emit is not None:
+                    self._tbt.observe(now - session.last_emit)
+                    if self.slo is not None:
+                        self.slo.note_tbt(session.trace, (now - session.last_emit) * 1e3)
+                session.last_emit = now
+                if self.timeseries is not None:
+                    self.timeseries.admissions.add()
+                    self.timeseries.tokens.add()
+                if self.block_size is not None:  # echo exists only for preemption resume
+                    session.echo.append(int(first[0]))
+                session.resident_base = session.produced
+                session.produced += 1
             self._sessions[slot] = session
             if start_done:
                 # speculative mode already marked the row done on device
@@ -2021,6 +2315,37 @@ class ContinuousBatcher:
         self._radix.pin(transferred)
         session.pins.extend(transferred)
 
+    def _radix_publish_finished_locked(self, slot: int, session: _Session) -> None:
+        """Decode-side insertion (caller holds the lock): publish a FINISHED
+        stream's prompt + generated tokens into the radix tree — block-aligned
+        only, and one token short of the emissions, because the last sampled
+        token was never fed back so its K/V was never written. The leading
+        blocks (static prefix, radix-matched runs, the prompt publish at
+        finalize) are already in the tree, so :meth:`RadixPrefixCache.insert`
+        keeps them and only the generated tail's blocks transfer; transferred
+        blocks leave the slot's private allocation unpinned — cached and
+        immediately evictable, like any idle prefix."""
+        if not session.table or not session.prompt:
+            return
+        p0 = self.prefix.length if self.prefix is not None else 0
+        # K/V is on device for every position before the LAST emitted token
+        total = p0 + len(session.prompt) + len(session.echo) - 1
+        full = total // self.block_size
+        if full <= 0 or full > len(session.table):
+            return
+        key = self._radix_key(list(session.prompt) + list(session.echo))[: full * self.block_size]
+        entries = [int(b) for b in session.table[:full]]
+        kept = self._radix.insert(key, entries)
+        alloc = self._slot_blocks.get(slot)
+        if alloc is None:
+            return
+        for b in entries[kept:full]:
+            # ownership of the transferred tail moves to the tree; blocks the
+            # session never owned privately (tree/prefix-seeded leads) are
+            # covered by kept and never reach this loop
+            if b in alloc:
+                alloc.remove(b)
+
     def _radix_reset_locked(self) -> None:
         """Drop every cached run and zero the cache counters (caller holds the
         lock; no streams may be live): warmup's junk probes must not leave
@@ -2092,6 +2417,7 @@ class ContinuousBatcher:
         self._release_blocks_locked(slot, session)
         self._mask_slot_done(slot)
         session.slot = -1
+        session.table = []
         if not session.finished:
             # a cancelled-but-not-yet-reaped victim is simply dropped — its
             # consumer already has the sentinel, and requeuing it would waste a
@@ -2134,6 +2460,7 @@ class ContinuousBatcher:
                     self._slot_blocks[slot].extend(alloc)
                     self._extend_tables(slot, session.table_len, alloc)
                     session.table_len += extra
+                    session.table.extend(alloc)
                 return
             victim = max(self._sessions, key=lambda s: self._sessions[s].admit_seq)
             self._preempt_locked(victim)
@@ -2143,6 +2470,11 @@ class ContinuousBatcher:
         session.finished = True
         _tev(session, "engine.finish", produced=session.produced)
         self._free.append(slot)
+        if self._radix is not None:
+            # decode-side insertion: the finished stream's prompt + generated
+            # tokens become cacheable prefix, so the next turn of a multi-turn
+            # conversation cache-hits the whole prior exchange
+            self._radix_publish_finished_locked(slot, session)
         self._release_blocks_locked(slot, session)
         if not device_done or self.block_size is not None:
             # finished without the device knowing (budget exhausted, or the
