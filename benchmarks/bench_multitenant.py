@@ -1,0 +1,239 @@
+"""Multi-tenant QoS benchmark: hostile-burst isolation, QoS on vs off.
+
+The question this lane pins (docs/serving.md "Multi-tenant QoS"): when one
+hostile tenant offers 10x the load of everyone else, does the tenancy layer —
+deficit-round-robin admission across tenants — actually protect the
+well-behaved tenants' token cadence, without buying it with aggregate
+throughput?
+
+Both arms run the SAME single-engine shape over the SAME offered load: the
+hostile tenant bursts its whole backlog first, then 3 well-behaved tenants
+each run a closed loop of short requests:
+
+- **QoS off** (no registry): admission is FIFO, so every well-behaved request
+  queues behind whatever remains of the hostile burst — the stall its user
+  feels is the hostile tenant's queue, not their own work;
+- **QoS on** (equal-weight registry): the waiting queue drains
+  deficit-round-robin across the four tenants, so a well-behaved request
+  admits within ~one round no matter how deep the hostile backlog is.
+
+The engine is the DISPATCH-BOUND SYNTHETIC the replica/disagg lanes use:
+decode dispatches and admission prefills are wrapped with GIL-releasing
+sleeps, so the clock measures WHERE requests queue — the scheduling property
+QoS changes — not how fast the host multiplies tiny matrices.
+
+Well-behaved TBT is measured CLIENT-side per request with the gap clock
+starting at submit, so admission queueing lands in the first gap — exactly
+the stall a streaming user sees. The headline is the well-behaved-tenant
+TBT-p99 ratio (QoS-off / QoS-on, higher = better, bar >= 3x), scored jointly
+with the aggregate tok/s ratio (bar >= 0.95x) so the isolation is never
+bought with throughput; run_all's keep-best accretion applies.
+
+CPU-substrate by design (run_all pins it CPU_ONLY). Every printed line goes
+to stderr except the final JSON metric line (stdout).
+Usage: ``python benchmarks/bench_multitenant.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+from benchmarks.common import emit, log
+from unionml_tpu.defaults import env_int
+
+_SMALL = os.environ.get("BENCH_SMALL") == "1"
+WELL_BEHAVED = 3
+WB_REQUESTS = 3 if _SMALL else 5  # closed-loop requests per well-behaved tenant
+HOSTILE_FACTOR = 10  # the hostile tenant's offered-load multiple
+BUDGET = 8
+DECODE_CHUNK = 4
+SLOTS = 2
+#: synthetic dispatch costs (seconds): a decode chunk, and one admission
+#: prefill — sized so queueing position dominates the clock
+DISPATCH_S = 0.008
+PREFILL_S = 0.004
+
+
+def _percentile(ordered, q):
+    rank = min(len(ordered) - 1, max(0, round(q * (len(ordered) - 1))))
+    return ordered[rank]
+
+
+def _install_dispatch_costs(engine) -> None:
+    real_decode, real_prefill = engine.gen._decode, engine._prefill_row
+
+    def slow_decode(*args, _real=real_decode, **kwargs):
+        time.sleep(DISPATCH_S)
+        return _real(*args, **kwargs)
+
+    def slow_prefill(prompt, *args, _real=real_prefill, **kwargs):
+        time.sleep(PREFILL_S)
+        return _real(prompt, *args, **kwargs)
+
+    engine.gen._decode = slow_decode
+    engine._prefill_row = slow_prefill
+
+
+def _measure(module, params, cfg, registry, hostile_requests):
+    """One arm: hostile burst first, then 3 well-behaved closed loops.
+    Returns (well-behaved TBT stats ms, aggregate tok/s)."""
+    import numpy as np
+
+    from unionml_tpu.serving import ContinuousBatcher
+
+    engine = ContinuousBatcher(
+        _generator(module, params, cfg), slots=SLOTS, decode_chunk=DECODE_CHUNK,
+        max_waiting=hostile_requests + WELL_BEHAVED * 2 + 8, tenancy=registry,
+    )
+    try:
+        engine.warmup()
+        _install_dispatch_costs(engine)
+        rng = np.random.default_rng(7)
+        hostile_prompts = [
+            list(rng.integers(1, 90, size=6)) for _ in range(hostile_requests)
+        ]
+        wb_prompts = [
+            [list(rng.integers(1, 90, size=5)) for _ in range(WB_REQUESTS)]
+            for _ in range(WELL_BEHAVED)
+        ]
+        gaps = [[] for _ in range(WELL_BEHAVED)]
+        totals = [0] * (WELL_BEHAVED + 1)
+
+        # QoS off = today's anonymous engine: no identity, FIFO admission.
+        # (Tenant labels alone would arm the fair queue — identity IS the
+        # QoS opt-in — so the off arm submits without them.)
+        qos = registry is not None
+        t0 = time.perf_counter()
+        # the hostile tenant lands its whole 10x backlog before anyone else
+        hostile_streams = [
+            engine.submit(p, tenant="hostile" if qos else None)
+            for p in hostile_prompts
+        ]
+
+        def hostile_drain():
+            total = 0
+            for stream in hostile_streams:
+                for chunk in stream:
+                    total += int(np.asarray(chunk).size)
+            totals[WELL_BEHAVED] = total
+
+        def well_behaved(i):
+            total = 0
+            for prompt in wb_prompts[i]:
+                last = time.perf_counter()  # gap clock starts AT SUBMIT:
+                stream = engine.submit(prompt, tenant=f"wb-{i}" if qos else None)
+                for chunk in stream:  # admission queueing is the first gap
+                    now = time.perf_counter()
+                    gaps[i].append(now - last)
+                    last = now
+                    total += int(np.asarray(chunk).size)
+            totals[i] = total
+
+        threads = [threading.Thread(target=hostile_drain)] + [
+            threading.Thread(target=well_behaved, args=(i,)) for i in range(WELL_BEHAVED)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        ordered = sorted(g * 1e3 for series in gaps for g in series)
+        tbt = {
+            "p50_ms": _percentile(ordered, 0.50),
+            "p99_ms": _percentile(ordered, 0.99),
+            "max_ms": ordered[-1],
+        }
+        return tbt, sum(totals) / elapsed
+    finally:
+        engine.close()
+
+
+def _generator(module, params, cfg):
+    from unionml_tpu.models import Generator
+
+    return Generator(module, params, cfg)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from unionml_tpu.models import GenerationConfig, Llama, LlamaConfig
+    from unionml_tpu.serving import TenantRegistry, TenantSpec
+
+    log(f"devices: {len(jax.devices())} ({jax.devices()[0].platform})")
+    config = LlamaConfig.tiny()
+    module = Llama(config)
+    params = jax.jit(
+        lambda key: module.init(key, jnp.zeros((1, 8), jnp.int32))["params"]
+    )(jax.random.PRNGKey(0))
+    cfg = GenerationConfig(max_new_tokens=BUDGET, temperature=0.0, prompt_buckets=(16,))
+    hostile_requests = HOSTILE_FACTOR * WELL_BEHAVED * WB_REQUESTS // 5
+    attempts = env_int("BENCH_MULTITENANT_ATTEMPTS", 3, minimum=1)
+
+    def registry():
+        # equal fair shares: the isolation comes from round-robin admission,
+        # not from throttling the hostile tenant's buckets (rates stay 0 =
+        # unlimited, so both arms serve the identical total workload)
+        return TenantRegistry(
+            {"hostile": TenantSpec(), **{f"wb-{i}": TenantSpec() for i in range(WELL_BEHAVED)}}
+        )
+
+    best = None
+    for attempt in range(attempts):
+        results = {}
+        for label, reg in (("qos_off", None), ("qos_on", registry())):
+            tbt, rate = _measure(module, params, cfg, reg, hostile_requests)
+            results[label] = {"tbt": tbt, "rate": rate}
+            log(
+                f"[{attempt + 1}/{attempts}] {label}: well-behaved TBT p99 "
+                f"{tbt['p99_ms']:.1f} ms (max {tbt['max_ms']:.1f} ms), "
+                f"{rate:.0f} tok/s aggregate"
+            )
+        off, on = results["qos_off"], results["qos_on"]
+        ratio = off["tbt"]["p99_ms"] / on["tbt"]["p99_ms"] if on["tbt"]["p99_ms"] else 0.0
+        throughput_ratio = on["rate"] / off["rate"] if off["rate"] else 0.0
+        log(
+            f"[{attempt + 1}/{attempts}] well-behaved TBT-p99 isolation (off/on): "
+            f"{ratio:.2f}x; aggregate tok/s ratio on/off: {throughput_ratio:.3f}"
+        )
+        # paired score: isolation bought with throughput scores lower — every
+        # emitted field comes from one coherent attempt
+        score = ratio * min(throughput_ratio / 0.95, 1.0)
+        if best is None or score > best[0]:
+            best = (score, off, on, ratio, throughput_ratio)
+
+    _, off, on, ratio, throughput_ratio = best
+    emit(
+        # headline is the isolation RATIO (higher = better) so run_all's
+        # keep-best accretion retains the best capture; bar >= 3x at
+        # throughput_ratio >= 0.95
+        "multitenant_tbt_isolation",
+        round(ratio, 3),
+        "x",
+        ratio,  # vs_baseline: the QoS-off arm IS the baseline
+        qos_on_tbt_p99_ms=on["tbt"]["p99_ms"],
+        qos_on_tbt_max_ms=on["tbt"]["max_ms"],
+        qos_off_tbt_p99_ms=off["tbt"]["p99_ms"],
+        qos_off_tbt_max_ms=off["tbt"]["max_ms"],
+        qos_on_tokens_per_s=round(on["rate"], 1),
+        qos_off_tokens_per_s=round(off["rate"], 1),
+        throughput_ratio=round(throughput_ratio, 3),
+        hostile_requests=hostile_requests,
+        well_behaved_tenants=WELL_BEHAVED,
+        requests_per_tenant=WB_REQUESTS,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -41,6 +41,7 @@ SCRIPTS = {
     "continuous_stall": "bench_continuous.py",
     "prefix_cache": "bench_prefix_cache.py",
     "disagg_serving": "bench_disagg_serving.py",
+    "multitenant_qos": "bench_multitenant.py",
     "quantized_serving": "bench_quantized_serving.py",
     "replica_serving": "bench_replica_serving.py",
     "observability": "bench_observability.py",
@@ -77,10 +78,13 @@ if _cpu_extra - set(SCRIPTS):
 #: byte budget — a memory/scheduling property, same-substrate by construction;
 #: disagg_serving pins role-split vs symmetric resident TBT-p99 through the
 #: same dispatch-bound synthetic regime as replica_serving (fleet topology,
-#: not chip speed)
+#: not chip speed); multitenant_qos pins the well-behaved-tenant TBT-p99
+#: isolation ratio QoS-on vs QoS-off under a hostile 10x burst — a
+#: same-substrate scheduling property, by construction
 CPU_ONLY = {
     "digits", "serving", "replica_serving", "continuous_stall", "prefix_cache",
     "quantized_serving", "observability", "fleet_health", "lint", "disagg_serving",
+    "multitenant_qos",
 } | _cpu_extra
 
 #: per-lane env overrides: lanes that reuse a script in a different mode

@@ -55,10 +55,12 @@ MODULES = [
     "unionml_tpu.serving.continuous",
     "unionml_tpu.serving.http",
     "unionml_tpu.serving.metrics",
+    "unionml_tpu.serving.openai_api",
     "unionml_tpu.serving.overload",
     "unionml_tpu.serving.prefix_cache",
     "unionml_tpu.serving.replicas",
     "unionml_tpu.serving.serverless",
+    "unionml_tpu.serving.tenancy",
     "unionml_tpu.observability.trace",
     "unionml_tpu.observability.recorder",
     "unionml_tpu.observability.prometheus",

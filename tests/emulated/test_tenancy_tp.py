@@ -1,0 +1,179 @@
+"""Multi-tenant QoS over a dp=2×tp=2 replica fleet on the emulated mesh.
+
+The acceptance leg for docs/serving.md "Multi-tenant QoS": with two tenants at
+EQUAL weight offering the same load, the fleet serves them to (exactly) equal
+token share and their streams stay token-identical to a solo reference — the
+QoS layer redirects scheduling, never tokens — while a ZERO-weight burst
+tenant is held at its request bucket's rate: its admitted count equals the
+bucket capacity (frozen clock: no refill), the rest shed 429 with a
+refill-derived Retry-After, and the weighted tenants' service is unaffected.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from unionml_tpu.models import GenerationConfig, Generator, Llama, LlamaConfig, llama_partition_rules
+from unionml_tpu.parallel import MeshSpec
+from unionml_tpu.serving import ReplicaSet, TenantRegistry, TenantSpec
+from unionml_tpu.serving.overload import TenantThrottled
+
+pytestmark = pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 emulated devices")
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    config = LlamaConfig.tiny(
+        vocab_size=96, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, hidden_dim=128,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    module = Llama(config)
+    params = module.init(jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32))["params"]
+    return module, params
+
+
+def _cfg(**overrides):
+    kwargs = dict(max_new_tokens=8, temperature=0.0, prompt_buckets=(16,))
+    kwargs.update(overrides)
+    return GenerationConfig(**kwargs)
+
+
+def _drain(stream):
+    return [int(t) for chunk in stream for t in np.asarray(chunk).ravel()]
+
+
+def _slow_decode(engine, dispatch_s=0.02):
+    real = engine.gen._decode
+
+    def slow(*args, _real=real, **kwargs):
+        import time
+
+        time.sleep(dispatch_s)
+        return _real(*args, **kwargs)
+
+    engine.gen._decode = slow
+
+
+def test_tp2_priority_preemption_resumes_token_identical(tiny):
+    """A high-priority admission on a full tp=2 paged engine preempts exactly
+    one lowest-priority resident, and the victim's resumed stream is
+    token-identical to an unpreempted run — the exact-width-resume contract
+    held under TP sharding."""
+    import time
+
+    from unionml_tpu.serving import ContinuousBatcher
+
+    module, params = tiny
+    cfg = _cfg(max_new_tokens=24)
+    mesh = MeshSpec(model=2).build(devices=jax.devices()[:2])
+    gen = Generator(module, params, cfg, mesh=mesh, partition_rules=llama_partition_rules())
+    reference = {
+        tuple(p): list(map(int, gen([p])[0]))
+        for p in ([3, 1, 4, 1, 5], [7, 7, 1])
+    }
+    engine = ContinuousBatcher(gen, slots=1, decode_chunk=2, block_size=16, pool_blocks=16)
+    try:
+        engine.warmup()
+        _slow_decode(engine)
+        results = {}
+
+        def consume(name, stream):
+            results[name] = _drain(stream)
+
+        batch = engine.submit([3, 1, 4, 1, 5], priority=2)
+        thread = threading.Thread(target=consume, args=("batch", batch))
+        thread.start()
+        deadline = time.monotonic() + 10.0
+        while engine.occupancy()[0] < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        high_out = _drain(engine.submit([7, 7, 1], priority=0))
+        thread.join(timeout=120)
+        assert engine.priority_preemptions == 1
+        assert high_out == reference[(7, 7, 1)]
+        assert results["batch"] == reference[(3, 1, 4, 1, 5)]
+    finally:
+        engine.close()
+
+
+A_PROMPTS = [[3, 1, 4, 1, 5], [2, 7, 1, 8], [9, 3, 9], [6, 2, 6, 4, 3]]
+B_PROMPTS = [[5, 5, 5], [1, 2, 3, 4, 5, 6], [8, 1], [4, 4, 7, 2]]
+
+
+def test_equal_weight_share_and_zero_weight_bucket_hold(tiny):
+    module, params = tiny
+    cfg = _cfg()
+    mesh = MeshSpec(data=2, model=2).build(devices=jax.devices()[:4])
+    clk = [0.0]  # frozen registry clock: the burst bucket never refills
+    registry = TenantRegistry(
+        {
+            "alpha": TenantSpec(weight=1),
+            "beta": TenantSpec(weight=1),
+            "burst": TenantSpec(weight=0, req_per_s=2.0, burst_s=2.0),  # cap = 4
+        },
+        clock=lambda: clk[0],
+    )
+    reference = {
+        tuple(p): list(map(int, Generator(module, params, cfg)([p])[0]))
+        for p in A_PROMPTS + B_PROMPTS
+    }
+    fleet = ReplicaSet.build(
+        module, params, cfg, mesh=mesh, partition_rules=llama_partition_rules(),
+        slots=2, decode_chunk=4, max_waiting=64, tenancy=registry,
+    )
+    try:
+        assert fleet.replicas == 2
+        streams = []
+        labels = []
+        # interleaved offered load: alpha and beta compete for every slot
+        for a, b in zip(A_PROMPTS, B_PROMPTS):
+            streams.append(fleet.submit(a, tenant="alpha"))
+            labels.append("alpha")
+            streams.append(fleet.submit(b, tenant="beta"))
+            labels.append("beta")
+        # the zero-weight burst tenant floods 10 requests: exactly the bucket
+        # capacity (4 at a frozen clock) admit, the rest shed with the
+        # bucket's own retry hint
+        burst_admitted, retries = [], []
+        for i in range(10):
+            try:
+                burst_admitted.append(fleet.submit([10 + i], tenant="burst", max_new_tokens=2))
+            except TenantThrottled as exc:
+                retries.append(exc.retry_after_s)
+        assert len(burst_admitted) == 4
+        assert len(retries) == 6 and all(r == pytest.approx(0.5, rel=0.05) for r in retries)
+
+        results = [None] * len(streams)
+
+        def worker(i):
+            results[i] = _drain(streams[i])
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(len(streams))]
+        for t in threads:
+            t.start()
+        burst_tokens = sum(len(_drain(s)) for s in burst_admitted)
+        for t in threads:
+            t.join(timeout=180)
+
+        # equal weight -> equal service: every stream of both tenants
+        # completes, token-identical to the solo reference
+        for label, prompt, out in zip(
+            labels, [p for pair in zip(A_PROMPTS, B_PROMPTS) for p in pair], results
+        ):
+            assert out == reference[tuple(prompt)], (label, prompt)
+        per_tenant = registry.stats()["per_tenant"]
+        assert per_tenant["alpha"]["generated_tokens"] == per_tenant["beta"]["generated_tokens"]
+        assert per_tenant["alpha"]["admitted"] == per_tenant["beta"]["admitted"] == 4
+        # the burst tenant was held at its bucket: 4 admitted, 6 shed, and its
+        # served tokens are bounded by its own budget — not by crowding out
+        # the weighted tenants
+        assert per_tenant["burst"]["admitted"] == 4
+        assert per_tenant["burst"]["shed"] == 6
+        assert burst_tokens == 4 * 2
+        fleet_stats = fleet.stats()
+        assert fleet_stats["tenancy"]["shed_tenant_limit"] == 6
+    finally:
+        fleet.close()

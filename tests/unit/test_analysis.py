@@ -856,3 +856,97 @@ def test_tpu008_suppression_comment(tmp_path):
     )
     assert rule_ids(result) == []
     assert [finding.rule for finding in result.suppressed] == ["TPU008"]
+
+
+# --------------------------------------------------------------------- TPU009
+
+
+def test_tpu009_flags_request_keyed_dict_without_eviction(tmp_path):
+    result = lint_source(
+        tmp_path,
+        """
+        class Registry:
+            def __init__(self):
+                self._states = {}
+
+            def admit(self, tenant):
+                self._states[tenant] = 1
+        """,
+    )
+    assert rule_ids(result) == ["TPU009"]
+    assert "self._states" in result.findings[0].message
+
+
+def test_tpu009_flags_setdefault_and_attribute_keys(tmp_path):
+    result = lint_source(
+        tmp_path,
+        """
+        class Recorder:
+            def record(self, trace):
+                self._inflight.setdefault(trace.request_id, []).append(trace)
+
+        class Census:
+            def note(self, session):
+                self._counts[session.tenant] = self._counts.get(session.tenant, 0) + 1
+        """,
+    )
+    assert rule_ids(result) == ["TPU009", "TPU009"]
+
+
+def test_tpu009_near_misses_stay_clean(tmp_path):
+    # pop-based eviction, popitem-bounded LRU, del-based pruning, a len()
+    # bound check, the filtered-rebuild idiom, server-chosen keys (slot
+    # indices), and module-level dicts — none may flag
+    result = lint_source(
+        tmp_path,
+        """
+        class PerRequest:
+            def start(self, request_id):
+                self._inflight[request_id] = 1
+
+            def finish(self, request_id):
+                self._inflight.pop(request_id, None)
+
+        class BoundedLRU:
+            def note(self, key):
+                self._affinity[key] = 1
+                while len(self._affinity) > self._capacity:
+                    self._affinity.popitem(last=False)
+
+        class Pruned:
+            def select(self, tenant):
+                self._deficit[tenant] = 0.0
+                for tenant in list(self._deficit):
+                    del self._deficit[tenant]
+
+        class Rebuilt:
+            def note(self, key):
+                self._affinity[key] = 1
+
+            def resize(self, n):
+                self._affinity = {k: v for k, v in self._affinity.items() if v < n}
+
+        class SlotKeyed:
+            def admit(self, slot, session):
+                self._sessions[slot] = session
+
+        _MODULE_LEVEL = {}
+
+        def module_insert(tenant):
+            _MODULE_LEVEL[tenant] = 1
+        """,
+    )
+    assert rule_ids(result) == []
+
+
+def test_tpu009_suppression_comment(tmp_path):
+    result = lint_source(
+        tmp_path,
+        """
+        class Registry:
+            def admit(self, tenant):
+                self._states[tenant] = 1  # tpu-lint: disable=TPU009
+        """,
+    )
+    assert rule_ids(result) == []
+    assert [finding.rule for finding in result.suppressed] == ["TPU009"]

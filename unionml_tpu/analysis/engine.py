@@ -234,7 +234,7 @@ def main(argv: "Optional[Sequence[str]]" = None) -> int:
     ``unionml-tpu lint`` CLI command)."""
     parser = argparse.ArgumentParser(
         prog="tpu-lint",
-        description="TPU/concurrency-aware static analyzer (rules TPU001-TPU005)",
+        description="TPU/concurrency-aware static analyzer (rules TPU001-TPU009)",
     )
     parser.add_argument(
         "paths",

@@ -17,6 +17,7 @@ from unionml_tpu.analysis.rules.tpu005_env import BareEnvNumericParse
 from unionml_tpu.analysis.rules.tpu006_wall_clock import WallClockDuration
 from unionml_tpu.analysis.rules.tpu007_locked_callers import UnlockedLockedHelperCall
 from unionml_tpu.analysis.rules.tpu008_thread_leak import LeakedEngineThread
+from unionml_tpu.analysis.rules.tpu009_registry import UnboundedPerKeyRegistry
 
 __all__ = ["RULES"]
 
@@ -31,5 +32,6 @@ RULES = {
         WallClockDuration,
         UnlockedLockedHelperCall,
         LeakedEngineThread,
+        UnboundedPerKeyRegistry,
     )
 }

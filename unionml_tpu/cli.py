@@ -367,6 +367,19 @@ def fetch_model(
     help="SLO: tolerated fraction of arrivals shed with 429/503 over the burn-rate "
     "windows, e.g. 0.01 (0 = disarmed)",
 )
+@click.option(
+    "--tenant-config", default=None, type=click.Path(path_type=Path),
+    help="multi-tenant QoS: tenants.json with per-tenant fair-share weights, "
+    "req/s + generated-tokens/s bucket rates, default priority tiers, and "
+    "api-key -> tenant mappings; identified tenants are admitted "
+    "deficit-round-robin and bucket-limited (429 + Retry-After from the "
+    "bucket's refill time)",
+)
+@click.option(
+    "--default-tenant-rate", default=None, type=float,
+    help="req/s bucket rate for identified tenants not named in --tenant-config "
+    "(anonymous traffic is never bucket-limited); 0 = unlimited",
+)
 def serve(
     app_ref: str,
     model_path: Optional[Path],
@@ -403,6 +416,8 @@ def serve(
     slo_ttft_p95_ms: Optional[float],
     slo_tbt_p99_ms: Optional[float],
     slo_shed_ratio: Optional[float],
+    tenant_config: Optional[Path],
+    default_tenant_rate: Optional[float],
 ) -> None:
     """Start the HTTP prediction service (reference cli.py:172-205).
 
@@ -480,6 +495,17 @@ def serve(
     as exemplars at ``/debug/requests?slo=breach``, and the replica scheduler
     routes new work around a breaching replica. Same early-export contract as
     the other knobs (``UNIONML_TPU_SLO_*``).
+
+    Multi-tenant QoS (docs/serving.md "Multi-tenant QoS"):
+    ``--tenant-config tenants.json`` / ``--default-tenant-rate R`` arm the
+    tenancy subsystem — tenant identity from ``X-Tenant-Id`` or the
+    ``Authorization`` bearer key, per-tenant token buckets shedding 429 with
+    a refill-derived ``Retry-After``, weighted-fair (deficit-round-robin)
+    admission in the continuous engine, and ``X-Priority: high`` admissions
+    that may preempt a lowest-priority resident (which resumes
+    token-identically). The OpenAI-compatible ``POST /v1/completions`` /
+    ``/v1/chat/completions`` routes are always served; the tenancy knobs
+    make them multi-tenant. Same early-export contract as ``--dp-replicas``.
     """
     if dp_replicas is not None:
         if dp_replicas < 0:
@@ -567,6 +593,20 @@ def serve(
             # engine's SLO tracker reads the env at construction, so engines
             # built at app-module import time get the targets too
             os.environ[getattr(_defaults, env_name)] = repr(value)
+    if tenant_config is not None or default_tenant_rate is not None:
+        # same early-export contract as --dp-replicas: the serving app builds
+        # its TenantRegistry from the env at construction, and reload/fork
+        # children inherit the knobs
+        from unionml_tpu import defaults as _defaults
+
+        if tenant_config is not None:
+            if not tenant_config.exists():
+                raise click.ClickException(f"--tenant-config {tenant_config} does not exist")
+            os.environ[_defaults.SERVE_TENANT_CONFIG_ENV_VAR] = str(tenant_config)
+        if default_tenant_rate is not None:
+            if default_tenant_rate < 0:
+                raise click.ClickException("--default-tenant-rate must be >= 0 (0 = unlimited)")
+            os.environ[_defaults.SERVE_DEFAULT_TENANT_RATE_ENV_VAR] = repr(default_tenant_rate)
     # observability knobs: same early-export contract as --dp-replicas (the
     # serving app reads them at construction; reload/fork children inherit)
     if trace is not None or flight_recorder_size is not None or profile_dir is not None:
@@ -626,6 +666,9 @@ def serve(
         flight_recorder_size=flight_recorder_size,
         log_format=log_format,
         profile_dir=str(profile_dir) if profile_dir is not None else None,
+    ).configure_tenancy(
+        tenant_config=str(tenant_config) if tenant_config is not None else None,
+        default_tenant_rate=default_tenant_rate,
     )
 
     if workers > 1:

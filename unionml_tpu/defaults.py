@@ -152,6 +152,22 @@ SERVE_QUANTIZE_ENV_VAR = "UNIONML_TPU_QUANTIZE"
 #: "none"/unset = the compute dtype. Same warn-and-fall-back contract.
 SERVE_KV_CACHE_DTYPE_ENV_VAR = "UNIONML_TPU_KV_CACHE_DTYPE"
 
+# ------------------------------------------------------------- multi-tenant QoS
+# Tenancy knobs (serving/tenancy.py, docs/serving.md "Multi-tenant QoS"). Same
+# early-export contract as SERVE_DP_REPLICAS_ENV_VAR: the serve CLI sets these
+# before the app module imports, and the serving app builds its TenantRegistry
+# from them at construction. Neither set = tenancy off (byte-for-byte today's
+# anonymous-and-equal serving stack).
+
+#: path to a tenants.json (per-tenant weights, req/s + generated-tokens/s
+#: bucket rates, default priority tier, api-key -> tenant mapping). A missing
+#: or malformed file warns and degrades to --default-tenant-rate only.
+SERVE_TENANT_CONFIG_ENV_VAR = "UNIONML_TPU_TENANT_CONFIG"
+
+#: requests/s bucket rate for identified tenants NOT named in the config file
+#: (anonymous traffic is never bucket-limited); 0/unset = unlimited.
+SERVE_DEFAULT_TENANT_RATE_ENV_VAR = "UNIONML_TPU_DEFAULT_TENANT_RATE"
+
 # --------------------------------------------------------------- observability
 # Request-tracing / flight-recorder / profiler knobs (unionml_tpu/observability,
 # docs/observability.md). Same export pattern as the admission knobs above: the
@@ -285,6 +301,24 @@ def serve_kv_cache_dtype() -> "str | None":
     """The serve-time KV-cache storage dtype ("int8" or None = compute dtype);
     read at Generator construction, same contract as :func:`serve_quantize`."""
     return env_choice(SERVE_KV_CACHE_DTYPE_ENV_VAR, ("int8",), "kv_cache_dtype")
+
+
+def serve_tenant_config() -> "str | None":
+    """Path to the serve-time tenants.json (``UNIONML_TPU_TENANT_CONFIG``);
+    None = unset. Existence/validity is the registry's concern — it warns and
+    degrades on a bad file (the serve-export contract), so a stale path in a
+    fleet-wide env never crashes serve at app-import time."""
+    raw = os.environ.get(SERVE_TENANT_CONFIG_ENV_VAR)
+    if raw is None or not raw.strip():
+        return None
+    return raw.strip()
+
+
+def serve_default_tenant_rate() -> float:
+    """Requests/s bucket rate for identified-but-unconfigured tenants; 0 =
+    unlimited (and, with no config file either, tenancy entirely off). Same
+    warn-and-fall-back contract as every serve reader."""
+    return env_float(SERVE_DEFAULT_TENANT_RATE_ENV_VAR, 0.0, minimum=0.0)
 
 
 def serve_dp_replicas() -> int:

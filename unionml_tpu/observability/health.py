@@ -182,6 +182,22 @@ def fleet_debug(batcher: Optional[Any]) -> Dict[str, Any]:
                 int(getattr(engine, "handoffs_imported", 0)) for engine in _engines(batcher)
             ),
         }
+    census_fn = getattr(batcher, "tenant_census", None)
+    if callable(census_fn):
+        census = census_fn()
+        if census:
+            # multi-tenant QoS: per-tenant in-flight counts, bounded top-K by
+            # live streams (resident + waiting) so unbounded tenant-id
+            # cardinality can never grow the debug payload — omitted entirely
+            # with no identified-tenant traffic, the QoS-off contract
+            top_k = 16
+            ranked = sorted(
+                census.items(),
+                key=lambda item: (-(item[1].get("resident", 0) + item[1].get("waiting", 0)), item[0]),
+            )
+            out["tenants"] = {tenant: counts for tenant, counts in ranked[:top_k]}
+            if len(ranked) > top_k:
+                out["tenants_omitted"] = len(ranked) - top_k
     scaled = int(getattr(batcher, "scaled_up", 0)) + int(getattr(batcher, "scaled_down", 0))
     if scaled:
         out["resize"] = {

@@ -135,6 +135,7 @@ class FlightRecorder:
         limit: Optional[int] = None,
         min_ms: Optional[float] = None,
         slo_breach: bool = False,
+        tenant: Optional[str] = None,
     ) -> "Dict[str, Any]":
         """The ``/debug/requests`` payload: in-flight table (oldest first) and
         completed ring (newest first), optionally filtered by route substring
@@ -144,7 +145,9 @@ class FlightRecorder:
         triage without dumping the whole ring — in-flight entries count their
         live duration so a currently stalled request still surfaces).
         ``slo_breach`` draws the completed list from the exemplar ring instead
-        and keeps only in-flight requests already marked breaching."""
+        and keeps only in-flight requests already marked breaching. ``tenant``
+        keeps only timelines stamped with that tenant id (multi-tenant QoS —
+        "show me what tenant X's requests are doing")."""
         with self._lock:
             inflight = list(self._inflight.values())
             completed = list(reversed(self._exemplars if slo_breach else self._completed))
@@ -157,6 +160,8 @@ class FlightRecorder:
             if min_ms is not None and snap["duration_ms"] < min_ms:
                 return False
             if slo_breach and "slo_breach" not in snap:
+                return False
+            if tenant is not None and snap.get("tenant") != tenant:
                 return False
             return True
 

@@ -140,13 +140,18 @@ class RequestTrace:
     __slots__ = (
         "request_id", "method", "path", "created_at", "t0",
         "status", "detail", "duration_ms", "dropped_events",
-        "slo_breach", "_events", "_lock", "_finished",
+        "slo_breach", "tenant", "priority", "_events", "_lock", "_finished",
     )
 
     def __init__(self, request_id: str, method: str, path: str):
         self.request_id = request_id
         self.method = method
         self.path = path
+        #: multi-tenant QoS (serving/tenancy.py): the requesting tenant id and
+        #: priority tier, stamped by the HTTP layer when the request carried
+        #: them — None/absent otherwise, so anonymous timelines are unchanged
+        self.tenant: Optional[str] = None
+        self.priority: Optional[str] = None
         self.created_at = time.time()  # wall clock, display only — never subtracted
         self.t0 = time.monotonic()
         self.status: Optional[int] = None
@@ -246,6 +251,10 @@ class RequestTrace:
                 "in_flight": not self._finished,
                 "events": events,
             }
+            if self.tenant is not None:
+                out["tenant"] = self.tenant
+            if self.priority is not None:
+                out["priority"] = self.priority
             if self.detail:
                 out["detail"] = self.detail
             if self.dropped_events:

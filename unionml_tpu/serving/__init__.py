@@ -17,5 +17,12 @@ from unionml_tpu.serving.replicas import ReplicaScheduler, ReplicaSet, slice_mes
 from unionml_tpu.serving.overload import (  # noqa: F401
     DeadlineExceeded,
     QueueFullError,
+    TenantThrottled,
     current_deadline,
+)
+from unionml_tpu.serving.tenancy import (  # noqa: F401
+    TenantRegistry,
+    TenantSpec,
+    current_priority,
+    current_tenant,
 )

@@ -112,6 +112,15 @@ class ServingApp:
         #: jax.profiler capture directory for POST /debug/profile (None = off)
         self.profile_dir: Optional[str] = serve_profile_dir()
         self._profiling = False
+        # ---- multi-tenant QoS (docs/serving.md "Multi-tenant QoS"): the
+        # tenant registry from the serve --tenant-config/--default-tenant-rate
+        # env exports (None = tenancy off — the anonymous-and-equal stack,
+        # byte for byte). Installed process-wide like the flight recorder, so
+        # generation engines built by app code consult it with no wiring.
+        from unionml_tpu.serving.tenancy import TenantRegistry, set_active_registry
+
+        self.tenancy = TenantRegistry.from_env()
+        set_active_registry(self.tenancy)
         # correlated access logs come free once either correlation signal is
         # on: tracing (timeline ids) or JSON log lines (request_id field)
         self.server.access_log = (
@@ -172,6 +181,12 @@ class ServingApp:
         self.server.route("POST", "/predict", self._predict)
         self.server.route("POST", "/predict-stream", self._predict_stream)
         self.server.route("GET", "/debug/requests", self._debug_requests)
+        # the OpenAI-compatible surface (serving/openai_api.py): always
+        # routed — without a generation engine the handlers answer a clear
+        # 404, mirroring /predict-stream's no-stream-predictor contract
+        from unionml_tpu.serving.openai_api import register_openai_routes
+
+        register_openai_routes(self)
         self.server.route_prefix("GET", "/debug/requests/", self._debug_request_by_id)
         self.server.route("GET", "/debug/fleet", self._debug_fleet)
         self.server.route("POST", "/debug/scale", self._debug_scale)
@@ -292,6 +307,36 @@ class ServingApp:
             self.quantize = None if quantize == "none" else quantize
         if kv_cache_dtype is not None:
             self.kv_cache_dtype = None if kv_cache_dtype == "none" else kv_cache_dtype
+        return self
+
+    def configure_tenancy(
+        self,
+        tenant_config: Optional[str] = None,
+        default_tenant_rate: Optional[float] = None,
+    ) -> "ServingApp":
+        """Record the serve-time ``--tenant-config``/``--default-tenant-rate``
+        overrides, export them (the :meth:`configure_replicas` env contract),
+        and rebuild + reinstall the process-wide
+        :class:`~unionml_tpu.serving.tenancy.TenantRegistry`. ``None`` leaves
+        a knob alone; an empty string path clears the config."""
+        from unionml_tpu.defaults import (
+            SERVE_DEFAULT_TENANT_RATE_ENV_VAR,
+            SERVE_TENANT_CONFIG_ENV_VAR,
+        )
+        from unionml_tpu.serving.tenancy import TenantRegistry, set_active_registry
+
+        if tenant_config is not None:
+            if tenant_config:
+                os.environ[SERVE_TENANT_CONFIG_ENV_VAR] = str(tenant_config)
+            else:
+                os.environ.pop(SERVE_TENANT_CONFIG_ENV_VAR, None)
+        if default_tenant_rate is not None:
+            if default_tenant_rate < 0:
+                raise ValueError("default_tenant_rate must be >= 0 (0 = unlimited)")
+            os.environ[SERVE_DEFAULT_TENANT_RATE_ENV_VAR] = repr(float(default_tenant_rate))
+        if tenant_config is not None or default_tenant_rate is not None:
+            self.tenancy = TenantRegistry.from_env()
+            set_active_registry(self.tenancy)
         return self
 
     def _replica_gauge(self) -> Optional[Any]:
@@ -501,6 +546,12 @@ class ServingApp:
             # it observable (avg rows per dispatch -> how much of the
             # vectorization win concurrency is actually realizing)
             snapshot["micro_batcher"] = self.batcher.stats()
+        if self.tenancy is not None:
+            # multi-tenant QoS: per-tenant admission/shed/generated-token
+            # counters and fair-share weights — the registry's state map is
+            # bounded, so the label cardinality this mints is too. Absent
+            # entirely when tenancy is off (the byte-for-byte contract).
+            snapshot["tenants"] = self.tenancy.stats()
         if fmt == "prometheus":
             return 200, render_prometheus(snapshot), "text/plain; version=0.0.4"
         return 200, snapshot, "application/json"
@@ -512,8 +563,9 @@ class ServingApp:
         the ring of recently completed ones. Filters: ``?route=`` (substring
         of ``METHOD /path``), ``?status=`` (exact), ``?limit=`` (per table,
         default 100), ``?min_ms=`` (only timelines at least that long —
-        slow-request triage without dumping the whole ring), and
-        ``?slo=breach`` (the pinned SLO-breach exemplar ring)."""
+        slow-request triage without dumping the whole ring), ``?slo=breach``
+        (the pinned SLO-breach exemplar ring), and ``?tenant=`` (only
+        timelines stamped with that tenant id — multi-tenant QoS triage)."""
         query = current_query()
         status: Optional[int] = None
         if query.get("status"):
@@ -539,6 +591,7 @@ class ServingApp:
         snapshot = self.recorder.snapshot(
             route=query.get("route") or None, status=status, limit=limit,
             min_ms=min_ms, slo_breach=slo == "breach",
+            tenant=query.get("tenant") or None,
         )
         snapshot["tracing"] = self.tracer.enabled
         return 200, snapshot, "application/json"

@@ -75,8 +75,21 @@ from unionml_tpu.observability.trace import current_trace
 from unionml_tpu.observability.slo import SLOConfig, SLOTracker
 from unionml_tpu.observability.timeseries import EngineTimeseries
 from unionml_tpu.serving.metrics import LatencyWindow
-from unionml_tpu.serving.overload import DeadlineExceeded, QueueFullError, expired
+from unionml_tpu.serving.overload import (
+    DeadlineExceeded,
+    QueueFullError,
+    TenantThrottled,
+    expired,
+)
 from unionml_tpu.serving.prefix_cache import RadixPrefixCache
+from unionml_tpu.serving.tenancy import (
+    PRIORITY_HIGH,
+    PRIORITY_NORMAL,
+    active_registry,
+    current_priority,
+    current_tenant,
+    priority_name,
+)
 from unionml_tpu.models.generate import (
     Generator,
     PrefixCache,
@@ -175,6 +188,12 @@ class _Session:
     #: admission skips prefill entirely — the row is placed onto this engine's
     #: submesh and scattered into freshly allocated blocks
     pending_import: "Optional[Dict[str, Any]]" = None
+    #: multi-tenant QoS (serving/tenancy.py): the submitting request's tenant
+    #: id (None = anonymous) and priority tier — the deficit-round-robin
+    #: admission and priority preemption key on these; all-default values
+    #: keep the engine on its historical FIFO path exactly
+    tenant: Optional[str] = None
+    priority: int = PRIORITY_NORMAL
 
 
 @dataclasses.dataclass(eq=False)  # identity semantics: fields hold device arrays
@@ -400,6 +419,7 @@ class ContinuousBatcher:
         prefix_cache: Optional[bool] = None,
         slo: Optional[Any] = None,
         role: Optional[str] = None,
+        tenancy: Optional[Any] = None,
     ):
         if slots < 1:
             raise ValueError("slots must be >= 1")
@@ -733,6 +753,24 @@ class ContinuousBatcher:
         #: overload counters: waiting-queue-full sheds and deadline sheds
         self.shed_queue_full = 0
         self.shed_deadline = 0
+        #: multi-tenant QoS (serving/tenancy.py, docs/serving.md "Multi-tenant
+        #: QoS"). ``tenancy=`` pins a TenantRegistry for this engine (tests,
+        #: bespoke embeddings); None consults the process-wide registry the
+        #: serving app installs, at submit time — so with no registry AND no
+        #: tenant/priority on any waiting request the engine is byte-for-byte
+        #: the historical FIFO one (stats() included).
+        self._tenancy = tenancy
+        #: per-tenant sheds (empty bucket at submit) and admissions that
+        #: preempted a lower-priority resident to take its slot
+        self.shed_tenant_limit = 0
+        self.priority_preemptions = 0
+        #: deficit-round-robin state over WAITING tenants: deficits accrue
+        #: quantum x weight per round and pay per-prompt token costs; pruned to
+        #: the currently waiting tenant set every selection pass, so request-
+        #: derived keys cannot grow it beyond max_waiting entries (the TPU009
+        #: contract this engine dogfoods)
+        self._drr_deficit: "Dict[str, float]" = {}
+        self._drr_last: Optional[str] = None
         self._admit_counter = 0
         #: submissions per grammar id (constrained engines): /metrics telemetry
         self._grammar_counts: Dict[int, int] = {}
@@ -1059,10 +1097,18 @@ class ContinuousBatcher:
 
     # ------------------------------------------------------------------ public API
 
+    def _registry(self) -> Optional[Any]:
+        """The tenancy registry in effect: the engine's pinned one, else the
+        process-wide active registry (installed by the serving app); None =
+        tenancy off. Resolved per call so a registry installed after engine
+        construction — the serve startup order — still applies."""
+        return self._tenancy if self._tenancy is not None else active_registry()
+
     def submit(
         self, prompt: Sequence[int], *, max_new_tokens: Optional[int] = None,
         constraint: Optional[int] = None, deadline: Optional[float] = None,
-        export_handoff: bool = False,
+        export_handoff: bool = False, tenant: Optional[str] = None,
+        priority: Optional[int] = None,
     ) -> Iterator[np.ndarray]:
         """Enqueue a prompt; returns an iterator of 1-D int32 arrays of new
         tokens (first item is the prompt-sampled token). Blocks-free: the
@@ -1082,7 +1128,15 @@ class ContinuousBatcher:
         ONLY the prefill here: the prompt-sampled first token is emitted and
         the stream then ends with the prefilled KV row packaged on the
         stream's ``handoff`` attribute for :meth:`import_handoff` on a decode
-        replica — this engine never spends a decode slot on the request."""
+        replica — this engine never spends a decode slot on the request.
+
+        ``tenant``/``priority`` (multi-tenant QoS, docs/serving.md) default to
+        the request contextvars the HTTP layer binds: a tenant with an empty
+        token bucket is shed with :class:`TenantThrottled` (HTTP 429 whose
+        ``Retry-After`` is the bucket's actual refill time), waiting prompts
+        are admitted deficit-round-robin across tenants within strict priority
+        tiers, and a high-priority admission on a full paged engine preempts
+        the lowest-priority resident (which resumes token-identically)."""
         if len(prompt) == 0:
             raise ValueError("prompt must be non-empty")
         if export_handoff and self._spec is not None:
@@ -1113,9 +1167,31 @@ class ContinuousBatcher:
                 raise ValueError("constraint= requires GenerationConfig.constraints on the Generator")
             self.gen._cs.start_states([constraint])  # range check
             grammar = int(constraint)
+        # multi-tenant QoS: explicit kwargs win, else the contextvars the HTTP
+        # layer bound; priority falls back to the tenant's configured default
+        # tier, then normal (the historical behavior — all-default requests
+        # keep the engine on its FIFO fast path exactly)
+        registry = self._registry()
+        if tenant is None:
+            tenant = current_tenant()
+        if priority is None:
+            priority = current_priority()
+        if isinstance(priority, str):
+            from unionml_tpu.serving.tenancy import parse_priority
+
+            priority = parse_priority(priority)
+        if priority is None:
+            priority = (
+                registry.default_priority(tenant)
+                if registry is not None and tenant is not None
+                else PRIORITY_NORMAL
+            )
+        if not (PRIORITY_HIGH <= priority <= 2):
+            raise ValueError(f"priority must be in [0, 2] (high/normal/batch), got {priority!r}")
         session = _Session(
             slot=-1, out=queue.Queue(), max_new=budget, grammar=grammar, deadline=deadline,
             created_at=time.monotonic(), trace=req_trace, export=export_handoff,
+            tenant=tenant, priority=priority,
             # the original prompt is retained only where preemption can resume it
             prompt=list(prompt) if self.block_size is not None else [],
         )
@@ -1140,6 +1216,25 @@ class ContinuousBatcher:
                     f"continuous-batching waiting queue full ({self.max_waiting} prompts queued "
                     f"ahead of {self.slots} slots)"
                 )
+            if registry is not None:
+                # AFTER the capacity checks, so a full-queue shed never charges
+                # the bucket (a replica-walk retry lands on a sibling sharing
+                # this registry); a failed try_admit leaves the buckets
+                # untouched, so the walk is not double-charged either
+                retry_after = registry.try_admit(tenant)
+                if retry_after is not None:
+                    self.shed_tenant_limit += 1
+                    if self.timeseries is not None:
+                        self.timeseries.sheds.add()
+                    if req_trace is not None:
+                        req_trace.event(
+                            "engine.shed_tenant_limit", tenant=tenant,
+                            retry_after_s=round(retry_after, 3),
+                        )
+                    raise TenantThrottled(
+                        f"tenant {tenant!r} is over its rate limit",
+                        retry_after_s=round(retry_after, 3), tenant=tenant,
+                    )
             if self.gen._cs is not None:
                 self._grammar_counts[grammar] = self._grammar_counts.get(grammar, 0) + 1
             self._pending.append((list(prompt), session))
@@ -1149,7 +1244,8 @@ class ContinuousBatcher:
             self._lock.notify_all()
         if req_trace is not None:
             req_trace.event(
-                "engine.submit", prompt_tokens=len(prompt), queued_behind=waiting
+                "engine.submit", prompt_tokens=len(prompt), queued_behind=waiting,
+                **({"tenant": tenant, "priority": priority_name(priority)} if tenant is not None or priority != PRIORITY_NORMAL else {}),
             )
         return _TokenStream(self, session)
 
@@ -1177,6 +1273,8 @@ class ContinuousBatcher:
             deadline=payload.get("deadline"),
             created_at=payload.get("created_at", time.monotonic()),
             trace=trace,
+            tenant=payload.get("tenant"),
+            priority=int(payload.get("priority", PRIORITY_NORMAL)),
             prompt=list(payload["prompt"]) if self.block_size is not None else [],
             echo=list(payload["echo"]) if self.block_size is not None else [],
         )
@@ -1485,6 +1583,19 @@ class ContinuousBatcher:
                     "exported": self.handoffs_exported,
                     "imported": self.handoffs_imported,
                 }
+            if (
+                self._registry() is not None
+                or self.shed_tenant_limit
+                or self.priority_preemptions
+            ):
+                # multi-tenant QoS: per-engine counters (per-tenant detail —
+                # buckets, admitted/shed/generated — lives on the registry's
+                # own stats, surfaced by the app's /metrics snapshot); absent
+                # entirely when QoS is off, the byte-for-byte contract
+                snapshot["tenancy"] = {
+                    "shed_tenant_limit": self.shed_tenant_limit,
+                    "priority_preemptions": self.priority_preemptions,
+                }
             if self._spec is not None and self._spec.rounds:
                 snapshot["acceptance_rate"] = round(
                     self._spec.accepted_tokens / (self._spec.rounds * self._spec.gamma), 3
@@ -1686,7 +1797,14 @@ class ContinuousBatcher:
             # when chunking is off preserves the historical one-at-a-time
             # pop-prefill-paste order
             limit = self.max_admissions if self.admit_chunk else 1
-            while self._pending and self._free and len(self._admissions) < limit:
+            while self._pending and len(self._admissions) < limit:
+                self._select_pending_locked()
+                if not self._free:
+                    if self._preempt_for_priority_locked():
+                        # the victim requeued at the head; re-select so the
+                        # high-priority prompt rotates back in front of it
+                        continue
+                    break
                 blocks_row = None
                 gather_row = None
                 cached = 0
@@ -1774,6 +1892,141 @@ class ContinuousBatcher:
                     cached=cached,
                     gather_row=gather_row,
                 ))
+
+    def _select_pending_locked(self) -> None:
+        """Rotate the QoS-chosen waiting session to the head of ``_pending``
+        (caller holds the lock). FIFO fast path: with every live waiter at
+        default tenant/priority — tenancy off — nothing moves and the
+        per-tenant deficit map stays empty, so the engine is byte-for-byte the
+        historical one. With QoS traffic: strict priority tiers (high > normal
+        > batch), and within the winning tier **deficit round robin** across
+        tenants — each tenant's deficit accrues ``quantum x weight`` per round
+        (quantum = the token-weighted load normalizer, one admission chunk or
+        one widest bucket) and selection pays the head prompt's token cost, so
+        a hostile burst drains at its fair share while the other tenants'
+        requests interleave instead of queueing behind it. Zero-weight tenants
+        are best-effort: they round only when no weighted tenant waits in the
+        tier (their throughput is whatever their bucket rate leaves)."""
+        live = [(idx, s) for idx, (_, s) in enumerate(self._pending) if not s.finished]
+        if not live or all(
+            s.tenant is None and s.priority == PRIORITY_NORMAL for _, s in live
+        ):
+            if self._drr_deficit:
+                self._drr_deficit.clear()  # QoS traffic drained: drop tenant state
+            return
+        best_tier = min(s.priority for _, s in live)
+        queues: "Dict[str, List[int]]" = {}
+        for idx, s in live:
+            if s.priority == best_tier:
+                queues.setdefault(s.tenant or "", []).append(idx)
+        for tenant in list(self._drr_deficit):
+            if tenant not in queues:
+                # deficits exist only for WAITING tenants: request-derived keys
+                # can never grow this map past max_waiting entries
+                del self._drr_deficit[tenant]
+        registry = self._registry()
+        weights = {
+            tenant: (registry.weight(tenant) if registry is not None else 1.0)
+            for tenant in queues
+        }
+        # zero-weight tenants are best-effort: they compete only when no
+        # weighted tenant is waiting in the tier (then as plain round-robin)
+        candidates = [t for t in queues if weights[t] > 0] or list(queues)
+
+        def head_cost(tenant: str) -> float:
+            return float(max(len(self._pending[queues[tenant][0]][0]), 1))
+
+        chosen: Optional[str] = None
+        if len(candidates) == 1:
+            chosen = candidates[0]
+        elif (
+            self._drr_last in candidates
+            and self._drr_deficit.get(self._drr_last, 0.0) >= head_cost(self._drr_last)
+        ):
+            # classic DRR: KEEP serving the pointer tenant while its banked
+            # deficit covers the next head — this consecutive-service rule is
+            # what makes throughput proportional to weight, not to visit count
+            chosen = self._drr_last
+            self._drr_deficit[chosen] -= head_cost(chosen)
+        else:
+            start = 0
+            if self._drr_last in candidates:
+                start = (candidates.index(self._drr_last) + 1) % len(candidates)
+            order = candidates[start:] + candidates[:start]
+            quantum = self._load_norm
+            for _ in range(64):  # each full round accrues quantum x weight -> terminates
+                for tenant in order:
+                    # one quantum x weight granted per visit; an insufficient
+                    # deficit is BANKED (the "deficit" in DRR) for next round
+                    deficit = self._drr_deficit.get(tenant, 0.0) + quantum * max(
+                        weights[tenant], 0.0
+                    )
+                    if deficit >= head_cost(tenant):
+                        self._drr_deficit[tenant] = deficit - head_cost(tenant)
+                        chosen = tenant
+                        break
+                    self._drr_deficit[tenant] = deficit
+                if chosen is not None:
+                    break
+                if all(weights[t] <= 0 for t in order):
+                    break  # nothing accrues: degrade to plain round-robin
+            if chosen is None:
+                chosen = order[0]
+        self._drr_last = chosen
+        head = queues[chosen][0]
+        if head != 0:
+            self._pending.insert(0, self._pending.pop(head))
+
+    def _preempt_for_priority_locked(self) -> bool:
+        """With no free slot and a HIGH-priority prompt heading the queue,
+        preempt exactly one lowest-priority resident (ties: youngest — the
+        block-pressure victim rule) through the engine's existing paged
+        preempt/exact-width-resume path: the victim requeues at the FIFO head
+        and later resumes token-identically, never truncated. Paged mode only
+        — dense sessions do not retain the prompt a resume needs. Returns True
+        when a slot was freed (caller re-selects)."""
+        if self.block_size is None or not self._pending:
+            return False
+        head = self._pending[0][1]
+        if head.finished or head.priority != PRIORITY_HIGH:
+            return False
+        victims = [
+            slot for slot, s in self._sessions.items() if s.priority > head.priority
+        ]
+        if not victims:
+            return False
+        victim = max(
+            victims,
+            key=lambda slot: (self._sessions[slot].priority, self._sessions[slot].admit_seq),
+        )
+        self.priority_preemptions += 1
+        self._preempt_locked(victim, reason="priority")
+        return True
+
+    def tenant_census(self) -> "Dict[str, Dict[str, int]]":
+        """Live per-tenant stream counts (resident + waiting, in-flight
+        admissions included) for ``/debug/fleet`` — computed on demand by
+        scanning the bounded session/queue tables, so there is no per-tenant
+        counter to leak or to forget to decrement. Anonymous traffic is
+        omitted; the result is bounded by slots + max_waiting."""
+        census: "Dict[str, Dict[str, int]]" = {}
+
+        def bump(tenant: Optional[str], kind: str) -> None:
+            if tenant is None:
+                return
+            entry = census.setdefault(tenant, {"resident": 0, "waiting": 0})
+            entry[kind] += 1
+
+        with self._lock:
+            for session in self._sessions.values():
+                bump(session.tenant, "resident")
+            for _, session in self._pending:
+                if not session.finished:
+                    bump(session.tenant, "waiting")
+            for adm in self._admissions:
+                if not adm.session.finished:
+                    bump(adm.session.tenant, "waiting")
+        return census
 
     def _admission_alive(self, adm: _Admission) -> bool:
         """Drop an in-flight admission whose consumer went away (cancel) or
@@ -2089,6 +2342,9 @@ class ContinuousBatcher:
             if self.timeseries is not None:
                 self.timeseries.admissions.add()
                 self.timeseries.tokens.add()
+            registry = self._registry()
+            if registry is not None:
+                registry.charge_tokens(session.tenant, 1)
             session.finished = True
             if done_now:
                 _tev(session, "engine.finish", produced=session.produced)
@@ -2106,6 +2362,8 @@ class ContinuousBatcher:
                     "deadline": session.deadline,
                     "created_at": session.created_at,
                     "trace": session.trace,
+                    "tenant": session.tenant,
+                    "priority": session.priority,
                     "exported_at": now,
                 }
                 _tev(
@@ -2234,6 +2492,9 @@ class ContinuousBatcher:
                 if self.timeseries is not None:
                     self.timeseries.admissions.add()
                     self.timeseries.tokens.add()
+                registry = self._registry()
+                if registry is not None:
+                    registry.charge_tokens(session.tenant, 1)
                 if self.block_size is not None:  # echo exists only for preemption resume
                     session.echo.append(int(first[0]))
                 session.resident_base = session.produced
@@ -2403,16 +2664,20 @@ class ContinuousBatcher:
             )
         self._carry = tuple(state)
 
-    def _preempt_locked(self, slot: int) -> None:
-        """Evict a resident under pool exhaustion: free its slot/blocks, mask
-        its row, and requeue it at the FIFO head as (original prompt + every
-        token already emitted) — the resumed prefill's greedy continuation is
+    def _preempt_locked(self, slot: int, reason: str = "capacity") -> None:
+        """Evict a resident under pool exhaustion (or for a higher-priority
+        admission, ``reason="priority"``): free its slot/blocks, mask its row,
+        and requeue it at the FIFO head as (original prompt + every token
+        already emitted) — the resumed prefill's greedy continuation is
         token-identical, so the consumer never notices beyond latency. The
         cost is recomputing the evicted context once (vLLM's recompute
         preemption)."""
         session = self._sessions.pop(slot)
         self.preemptions += 1
-        _tev(session, "engine.preempt", produced=session.produced)
+        _tev(
+            session, "engine.preempt", produced=session.produced,
+            **({"reason": reason} if reason != "capacity" else {}),
+        )
         self._free.append(slot)
         self._release_blocks_locked(slot, session)
         self._mask_slot_done(slot)
@@ -2462,7 +2727,13 @@ class ContinuousBatcher:
                     session.table_len += extra
                     session.table.extend(alloc)
                 return
-            victim = max(self._sessions, key=lambda s: self._sessions[s].admit_seq)
+            # lowest-priority first, youngest within a tier — with priorities
+            # unset every session ties at normal and this is exactly the
+            # historical LIFO (max admit_seq) victim choice
+            victim = max(
+                self._sessions,
+                key=lambda s: (self._sessions[s].priority, self._sessions[s].admit_seq),
+            )
             self._preempt_locked(victim)
 
     def _finish_locked(self, slot: int, *, device_done: bool) -> None:
@@ -2497,6 +2768,7 @@ class ContinuousBatcher:
         self._carry = carry
         toks_np = np.asarray(toks)  # [S, chunk]; also fences the dispatch
         done_np = np.asarray(carry[3])
+        registry = self._registry()
         with self._lock:
             self.decode_dispatches += 1
             self.decoded_rows += len(self._sessions)
@@ -2511,6 +2783,11 @@ class ContinuousBatcher:
                         take = min(take, int(hits[0]) + 1)  # emit the eos, stop after
                 if take > 0:
                     session.out.put(row[:take].copy())
+                    if registry is not None:
+                        # post-charge the tenant's generated-tokens bucket:
+                        # stream length is unknown at admission, so emissions
+                        # debit (possibly into debt) and new admissions wait
+                        registry.charge_tokens(session.tenant, take)
                     if session.last_emit is not None:
                         self._tbt.observe(now - session.last_emit)
                         if self.slo is not None:
@@ -2553,6 +2830,7 @@ class ContinuousBatcher:
         prod_np = np.asarray(state[5])
         done_np = np.asarray(state[4])
         rounds_total, accepted_total = int(state[7]), int(state[8])
+        registry = self._registry()
         with self._lock:
             # fold the ride-along counters into the engine's acceptance
             # telemetry under the lock, so a concurrent stats() snapshot never
@@ -2568,6 +2846,8 @@ class ContinuousBatcher:
                 new = out_np[slot, session.produced - session.resident_base : prod_np[slot]]
                 if new.size:
                     session.out.put(new.copy())
+                    if registry is not None:
+                        registry.charge_tokens(session.tenant, int(new.size))
                     if session.last_emit is not None:
                         self._tbt.observe(now - session.last_emit)
                         if self.slo is not None:

@@ -35,8 +35,20 @@ from unionml_tpu.observability.trace import (
 from unionml_tpu.serving.overload import (
     DeadlineExceeded,
     QueueFullError,
+    TenantThrottled,
     remaining_s,
     request_deadline,
+)
+from unionml_tpu.serving.tenancy import (
+    AUTHORIZATION_HEADER,
+    PRIORITY_HEADER,
+    TENANT_HEADER,
+    active_registry,
+    bind_tenant as _bind_tenant,
+    parse_priority,
+    priority_name,
+    resolve_tenant,
+    unbind_tenant as _unbind_tenant,
 )
 
 Handler = Callable[[bytes], Awaitable[Tuple[int, Any, str]]]
@@ -353,9 +365,33 @@ class HTTPServer:
         # header-injection vector), generate otherwise, echo on EVERY response
         # — errors and sheds included
         rid = sanitize_request_id(headers.get(REQUEST_ID_HEADER)) or new_request_id()
+        # multi-tenant QoS (serving/tenancy.py): tenant identity + priority
+        # tier ride contextvars like the request id. Requests with none of the
+        # three headers skip all of it — the zero-cost-off contract.
+        tenant: Optional[str] = None
+        priority: Optional[int] = None
+        priority_error: Optional[str] = None
+        if (
+            TENANT_HEADER in headers
+            or AUTHORIZATION_HEADER in headers
+            or PRIORITY_HEADER in headers
+        ):
+            tenant = resolve_tenant(headers, active_registry())
+            raw_priority = headers.get(PRIORITY_HEADER)
+            if raw_priority is not None:
+                try:
+                    priority = parse_priority(raw_priority)
+                except ValueError as exc:
+                    priority_error = str(exc)
         tracer = self.tracer
         trace = tracer.start(method, path, rid) if tracer is not None else None
+        if trace is not None:
+            if tenant is not None:
+                trace.tenant = tenant
+            if priority is not None:
+                trace.priority = priority_name(priority)
         bind_tokens = _bind_request(rid, trace)
+        tenant_tokens = _bind_tenant(tenant, priority)
         query_token = request_query.set(query)
         extra: Dict[str, str] = {"X-Request-Id": rid}
         stream_deadline: Optional[float] = None
@@ -376,6 +412,10 @@ class HTTPServer:
                     # a scanner grow the route table (and snapshot) without bound
                     metrics_route = "<unmatched>"
                     result = 404, {"detail": f"no route for {path}"}, "application/json"
+            elif priority_error is not None:
+                # an explicit bad X-Priority is a usage error, not something
+                # to silently serve at the wrong tier
+                result = 400, {"detail": priority_error}, "application/json"
             elif self.draining and (method, path) not in self._drain_exempt:
                 # readiness is off: the load balancer should already be routing
                 # around us, so anything still arriving gets a fast 503 + hint
@@ -419,11 +459,20 @@ class HTTPServer:
                         result = exc.status, {"detail": exc.detail}, "application/json"
                     except QueueFullError as exc:
                         # an admission queue deeper in the stack (micro-batcher or
-                        # continuous engine) is full — same shed contract as ours
-                        self._inc("shed_queue_full")
+                        # continuous engine) is full — same shed contract as ours.
+                        # A TENANT-bucket shed is stamped distinctly and its
+                        # Retry-After is the bucket's actual refill time, not
+                        # the server's fixed hint (docs/serving.md
+                        # "Multi-tenant QoS")
+                        if isinstance(exc, TenantThrottled):
+                            self._inc("shed_tenant_limit")
+                            shed_reason = "tenant_limit"
+                        else:
+                            self._inc("shed_queue_full")
+                            shed_reason = "queue_full"
                         extra.update({"Retry-After": str(exc.retry_after_s)})
                         if trace is not None:
-                            trace.event("http.shed", reason="queue_full")
+                            trace.event("http.shed", reason=shed_reason)
                         result = 429, {"detail": exc.detail}, "application/json"
                     except (asyncio.TimeoutError, DeadlineExceeded) as exc:
                         # the deadline fired: wait_for has cancelled the handler (its
@@ -461,6 +510,7 @@ class HTTPServer:
             return (*result, extra, stream_deadline)
         finally:
             request_query.reset(query_token)
+            _unbind_tenant(tenant_tokens)
             _unbind_request(bind_tokens)
 
     def _traced_stream(self, payload: Any, trace: Any, status: int):

@@ -1,0 +1,341 @@
+"""OpenAI-compatible completions surface over the generation serving stack.
+
+``POST /v1/completions`` and ``POST /v1/chat/completions`` map the OpenAI wire
+schema — ``stream=true`` server-sent events terminated by ``data: [DONE]``,
+``usage`` token accounting, ``finish_reason`` semantics — onto the existing
+continuous-batching engine (``model.generation_batcher``), the same
+compatibility move vLLM and SGLang made to become drop-in servers: any client
+built on the OpenAI SDK can point its ``base_url`` here and drive the stack,
+its ``api_key`` doubling as the tenant identity (serving/tenancy.py). The
+routes are registered on every :class:`~unionml_tpu.serving.app.ServingApp`;
+without a generation engine they answer a clear 404, mirroring
+``/predict-stream``'s no-stream-predictor contract.
+
+Compatibility matrix (docs/serving.md "Multi-tenant QoS" carries the table):
+
+- supported: ``prompt`` (string with a tokenizer, or a token-id list),
+  ``messages``, ``max_tokens`` (clipped to the engine's configured budget),
+  ``stream``, ``model`` (echoed), per-request deadlines via the stack's
+  ``X-Request-Deadline-Ms``, 429 + ``Retry-After`` sheds, ``X-Tenant-Id`` /
+  ``X-Priority`` QoS headers;
+- accepted but inert: ``temperature``/``top_p``/seeds — the sampling policy is
+  fixed server-side by the engine's :class:`GenerationConfig` (every resident
+  stream shares one compiled decode program);
+- rejected with 400: ``n``/``best_of`` > 1, ``logprobs``, ``echo``,
+  ``suffix``, ``stop`` (use the grammar-constraint machinery instead), string
+  prompts without a ``model.tokenizer``.
+
+Tokenizer contract: ``model.tokenizer`` with ``encode(str) -> list[int]`` and
+``decode(list[int]) -> str`` (``apply_chat_template(messages) -> str``
+honored when present). Without one, prompts must be token-id lists and
+completion ``text`` falls back to space-joined token ids — enough for tests
+and id-level clients, stated in the matrix.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextvars
+import json
+import time
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from unionml_tpu.observability.trace import current_request_id
+from unionml_tpu.serving.http import HTTPError
+from unionml_tpu.serving.overload import DeadlineExceeded, QueueFullError, current_deadline
+
+__all__ = ["register_openai_routes"]
+
+#: OpenAI's documented default when max_tokens is omitted
+_DEFAULT_MAX_TOKENS = 16
+
+#: request knobs we cannot honor silently — a client that sets them gets a
+#: clear 400 instead of subtly different completions
+_UNSUPPORTED = ("n", "best_of", "logprobs", "echo", "suffix", "stop", "tools", "functions")
+
+
+def register_openai_routes(app: Any) -> None:
+    """Attach the ``/v1`` routes to a serving app's HTTP server."""
+    server = app.server
+
+    async def completions(body: bytes):
+        return await _completions(app, body, chat=False)
+
+    async def chat_completions(body: bytes):
+        return await _completions(app, body, chat=True)
+
+    async def models(body: bytes):
+        name = _model_name(app, None)
+        return 200, {
+            "object": "list",
+            "data": [{"id": name, "object": "model", "owned_by": "unionml-tpu"}],
+        }, "application/json"
+
+    server.route("POST", "/v1/completions", completions)
+    server.route("POST", "/v1/chat/completions", chat_completions)
+    server.route("GET", "/v1/models", models)
+
+
+def _model_name(app: Any, requested: Optional[str]) -> str:
+    if requested:
+        return str(requested)
+    return str(getattr(app.model, "name", None) or "unionml-tpu")
+
+
+def _engine(app: Any) -> Any:
+    engine = getattr(app.model, "generation_batcher", None)
+    if engine is None or not hasattr(engine, "submit"):
+        raise HTTPError(
+            404,
+            "no generation engine; the /v1 completions surface needs "
+            "model.generation_batcher (a ContinuousBatcher or ReplicaSet)",
+        )
+    return engine
+
+
+def _gen_config(engine: Any) -> Any:
+    gen = getattr(engine, "gen", None)
+    if gen is None:
+        batchers = getattr(engine, "batchers", None)  # a ReplicaSet
+        if batchers:
+            gen = getattr(batchers[0], "gen", None)
+    if gen is None:
+        raise HTTPError(500, "generation engine exposes no Generator config")
+    return gen.config
+
+
+def _tokenizer(app: Any) -> Optional[Any]:
+    return getattr(app.model, "tokenizer", None)
+
+
+def _encode_prompt(app: Any, prompt: Any) -> "List[int]":
+    """A request ``prompt`` to token ids: id lists pass through, strings need
+    the model's tokenizer. Everything else (including OpenAI's
+    list-of-strings batch form) is a documented 400."""
+    if isinstance(prompt, str):
+        tok = _tokenizer(app)
+        if tok is None or not hasattr(tok, "encode"):
+            raise HTTPError(
+                400,
+                "string prompts need a tokenizer (set model.tokenizer with "
+                "encode/decode); pass a token-id list instead",
+            )
+        ids = [int(t) for t in tok.encode(prompt)]
+    elif isinstance(prompt, (list, tuple)) and all(
+        isinstance(t, int) and not isinstance(t, bool) for t in prompt
+    ):
+        ids = [int(t) for t in prompt]
+    else:
+        raise HTTPError(
+            400,
+            "prompt must be a string or a list of token ids (prompt batches "
+            "are not supported; send one request per prompt)",
+        )
+    if not ids:
+        raise HTTPError(400, "prompt must be non-empty")
+    return ids
+
+
+def _decode_tokens(app: Any, ids: "List[int]") -> str:
+    tok = _tokenizer(app)
+    if tok is not None and hasattr(tok, "decode"):
+        return str(tok.decode(list(ids)))
+    # the documented no-tokenizer fallback: space-joined token ids — exact,
+    # reversible, and honest about what the server actually produced
+    return " ".join(str(i) for i in ids)
+
+
+def _chat_to_prompt(app: Any, messages: Any) -> Any:
+    """OpenAI ``messages`` to a single prompt: the tokenizer's own
+    ``apply_chat_template`` when it has one, else a plain role-prefixed
+    transcript ending with the assistant cue (documented in the matrix)."""
+    if not isinstance(messages, list) or not messages:
+        raise HTTPError(400, "messages must be a non-empty list of {role, content} objects")
+    for message in messages:
+        if (
+            not isinstance(message, dict)
+            or not isinstance(message.get("role"), str)
+            or not isinstance(message.get("content"), str)
+        ):
+            raise HTTPError(400, "each message needs string 'role' and 'content' fields")
+    tok = _tokenizer(app)
+    if tok is not None and hasattr(tok, "apply_chat_template"):
+        return tok.apply_chat_template(messages)
+    return "\n".join(f"{m['role']}: {m['content']}" for m in messages) + "\nassistant:"
+
+
+def _parse_request(app: Any, body: bytes, *, chat: bool) -> "Tuple[Dict[str, Any], List[int], int, bool, str]":
+    payload = app._parse_json_object(body)
+    for knob in _UNSUPPORTED:
+        value = payload.get(knob)
+        allowed = (None, 1) if knob in ("n", "best_of") else (None,)
+        if value not in allowed:
+            raise HTTPError(
+                400,
+                f"unsupported parameter {knob!r} (see the compatibility matrix "
+                "in docs/serving.md)",
+            )
+    if chat:
+        prompt = _chat_to_prompt(app, payload.get("messages"))
+    else:
+        prompt = payload.get("prompt")
+        if prompt is None:
+            raise HTTPError(400, "prompt must be supplied")
+    ids = _encode_prompt(app, prompt)
+    cfg = _gen_config(_engine(app))
+    raw_max = payload.get("max_tokens", _DEFAULT_MAX_TOKENS)
+    if not isinstance(raw_max, int) or isinstance(raw_max, bool) or raw_max < 1:
+        raise HTTPError(400, f"max_tokens must be a positive integer, got {raw_max!r}")
+    # clip to the budget the engine's cache is sized for (OpenAI clients
+    # routinely send large max_tokens; a hard reject would break drop-in use)
+    max_new = min(raw_max, int(cfg.max_new_tokens))
+    stream = bool(payload.get("stream", False))
+    return payload, ids, max_new, stream, _model_name(app, payload.get("model"))
+
+
+async def _completions(app: Any, body: bytes, *, chat: bool):
+    payload, ids, max_new, stream, model_name = _parse_request(app, body, chat=chat)
+    engine = _engine(app)
+    cfg = _gen_config(engine)
+    rid = current_request_id() or "req"
+    created = int(time.time())  # wall clock, display only — never subtracted
+    completion_id = f"{'chatcmpl' if chat else 'cmpl'}-{rid}"
+    try:
+        token_stream = engine.submit(ids, max_new_tokens=max_new, deadline=current_deadline())
+    except (QueueFullError, DeadlineExceeded):
+        raise  # the HTTP layer maps these to 429 (+ Retry-After) / 503
+    except ValueError as exc:
+        raise HTTPError(400, f"generation rejected the request: {exc}")
+    loop = asyncio.get_running_loop()
+    iterator = iter(token_stream)
+    sentinel = object()
+    # run_in_executor does not propagate contextvars; the engine thread reads
+    # nothing, but close/tracing paths do — same carry as /predict-stream
+    ctx = contextvars.copy_context()
+
+    def pull() -> Any:
+        return next(iterator, sentinel)
+
+    eos_id = cfg.eos_id
+
+    if not stream:
+        emitted: "List[int]" = []
+        try:
+            while True:
+                chunk = await loop.run_in_executor(None, ctx.run, pull)
+                if chunk is sentinel:
+                    break
+                emitted.extend(int(t) for t in np.asarray(chunk).ravel())
+        except (QueueFullError, DeadlineExceeded):
+            raise
+        except Exception as exc:
+            raise HTTPError(500, f"generation failed: {type(exc).__name__}: {exc}")
+        finally:
+            token_stream.close()
+        return 200, _final_payload(
+            app, chat, completion_id, created, model_name, emitted, max_new, len(ids), eos_id
+        ), "application/json"
+
+    # ---- stream=true: server-sent events, one data: line per engine chunk,
+    # a final chunk carrying finish_reason + usage, then data: [DONE]
+    try:
+        first = await loop.run_in_executor(None, ctx.run, pull)
+    except (QueueFullError, DeadlineExceeded):
+        raise
+    except Exception as exc:
+        token_stream.close()
+        raise HTTPError(500, f"generation failed: {type(exc).__name__}: {exc}")
+
+    object_name = "chat.completion.chunk" if chat else "text_completion"
+
+    def sse(obj: "Dict[str, Any]") -> bytes:
+        return b"data: " + json.dumps(obj).encode() + b"\n\n"
+
+    def chunk_payload(piece: "List[int]", finish: Optional[str]) -> "Dict[str, Any]":
+        text = _decode_tokens(app, piece) if piece else ""
+        if chat:
+            delta: "Dict[str, Any]" = {}
+            if piece:
+                delta["content"] = text
+            choice = {"index": 0, "delta": delta, "finish_reason": finish}
+        else:
+            choice = {"index": 0, "text": text, "logprobs": None, "finish_reason": finish}
+        return {
+            "id": completion_id, "object": object_name, "created": created,
+            "model": model_name, "choices": [choice],
+        }
+
+    async def events():
+        emitted = 0
+        last_token: Optional[int] = None
+        try:
+            if chat:
+                # the OpenAI stream opener: role first, content deltas after
+                yield sse({
+                    "id": completion_id, "object": object_name, "created": created,
+                    "model": model_name,
+                    "choices": [{"index": 0, "delta": {"role": "assistant"}, "finish_reason": None}],
+                })
+            chunk = first
+            while chunk is not sentinel:
+                piece = [int(t) for t in np.asarray(chunk).ravel()]
+                if piece:
+                    emitted += len(piece)
+                    last_token = piece[-1]
+                    yield sse(chunk_payload(piece, None))
+                chunk = await loop.run_in_executor(None, ctx.run, pull)
+            finish = "stop" if (eos_id is not None and last_token == eos_id) else "length"
+            final = chunk_payload([], finish)
+            final["usage"] = _usage(len(ids), emitted)
+            yield sse(final)
+            yield b"data: [DONE]\n\n"
+        finally:
+            # the server acloses this generator on client disconnect; closing
+            # the token stream releases the engine slot promptly (plain-object
+            # close — safe from any thread, no generator re-entrancy hazard)
+            token_stream.close()
+
+    return 200, events(), "text/event-stream"
+
+
+def _usage(prompt_tokens: int, completion_tokens: int) -> "Dict[str, int]":
+    return {
+        "prompt_tokens": prompt_tokens,
+        "completion_tokens": completion_tokens,
+        "total_tokens": prompt_tokens + completion_tokens,
+    }
+
+
+def _final_payload(
+    app: Any,
+    chat: bool,
+    completion_id: str,
+    created: int,
+    model_name: str,
+    emitted: "List[int]",
+    max_new: int,
+    prompt_tokens: int,
+    eos_id: Optional[int],
+) -> "Dict[str, Any]":
+    text = _decode_tokens(app, emitted) if emitted else ""
+    finish = "stop" if (eos_id is not None and emitted and emitted[-1] == eos_id) else "length"
+    if chat:
+        choice: "Dict[str, Any]" = {
+            "index": 0,
+            "message": {"role": "assistant", "content": text},
+            "finish_reason": finish,
+        }
+        object_name = "chat.completion"
+    else:
+        choice = {"index": 0, "text": text, "logprobs": None, "finish_reason": finish}
+        object_name = "text_completion"
+    return {
+        "id": completion_id,
+        "object": object_name,
+        "created": created,
+        "model": model_name,
+        "choices": [choice],
+        "usage": _usage(prompt_tokens, len(emitted)),
+    }

@@ -39,6 +39,24 @@ class QueueFullError(Exception):
         self.retry_after_s = retry_after_s
 
 
+class TenantThrottled(QueueFullError):
+    """A per-tenant token bucket (serving/tenancy.py) is empty — shed with 429.
+
+    A :class:`QueueFullError` subclass so every existing 429 path (HTTP
+    mapping, ``Retry-After`` from ``retry_after_s``) applies unchanged, but
+    distinguishable: the HTTP layer stamps ``shed_tenant_limit`` (not
+    ``shed_queue_full``) on the metrics and the trace, and ``retry_after_s``
+    is computed from the limiting bucket's ACTUAL refill time rather than the
+    server's fixed hint — a well-behaved client backs off exactly as long as
+    the limit requires, no longer. The replica scheduler re-raises it
+    immediately instead of walking the fleet: every replica shares the same
+    registry, so the walk could only re-shed."""
+
+    def __init__(self, detail: str, retry_after_s: float = 1.0, tenant: Optional[str] = None):
+        super().__init__(detail, retry_after_s=retry_after_s)
+        self.tenant = tenant
+
+
 class DeadlineExceeded(Exception):
     """The request's deadline passed before (or while) its work ran — shed with 503."""
 

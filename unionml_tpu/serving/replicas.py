@@ -86,7 +86,12 @@ from unionml_tpu.defaults import (
 from unionml_tpu.observability.trace import current_trace
 from unionml_tpu.parallel.mesh import BATCH_AXES
 from unionml_tpu.serving.continuous import ContinuousBatcher
-from unionml_tpu.serving.overload import DeadlineExceeded, QueueFullError, expired
+from unionml_tpu.serving.overload import (
+    DeadlineExceeded,
+    QueueFullError,
+    TenantThrottled,
+    expired,
+)
 
 __all__ = ["ReplicaScheduler", "ReplicaSet", "dp_extent", "slice_mesh"]
 
@@ -387,6 +392,7 @@ class ReplicaSet:
         roles: Optional[Any] = None,
         prefill_threshold: Optional[int] = None,
         autoscale: Optional[Any] = None,
+        tenancy: Optional[Any] = None,
     ):
         if (generators is None) == (engines is None):
             raise ValueError("pass exactly one of generators= or engines=")
@@ -400,7 +406,7 @@ class ReplicaSet:
             slots=slots, decode_chunk=decode_chunk, block_size=block_size,
             pool_blocks=pool_blocks, max_waiting=max_waiting, admit_chunk=admit_chunk,
             prefill_budget=prefill_budget, max_admissions=max_admissions,
-            trace=trace, prefix_cache=prefix_cache, slo=slo,
+            trace=trace, prefix_cache=prefix_cache, slo=slo, tenancy=tenancy,
         )
         self._prefix_tokens_saved = prefix_tokens
         if engines is not None:
@@ -711,6 +717,8 @@ class ReplicaSet:
         max_new_tokens: Optional[int] = None,
         constraint: Optional[int] = None,
         deadline: Optional[float] = None,
+        tenant: Optional[str] = None,
+        priority: Optional[int] = None,
     ) -> "Iterator[np.ndarray]":
         """Route a prompt to the least-loaded replica (prefix affinity
         permitting) and return its engine's token stream. Sheds with
@@ -743,14 +751,14 @@ class ReplicaSet:
             stream = self._submit_disaggregated(
                 batchers, roles, prompt,
                 max_new_tokens=max_new_tokens, constraint=constraint, deadline=deadline,
-                req_trace=req_trace,
+                req_trace=req_trace, tenant=tenant, priority=priority,
             )
             if stream is not None:
                 return stream
         return self._submit_routed(
             batchers, roles, prompt,
             max_new_tokens=max_new_tokens, constraint=constraint, deadline=deadline,
-            req_trace=req_trace,
+            req_trace=req_trace, tenant=tenant, priority=priority,
         )
 
     def _submit_routed(
@@ -763,6 +771,8 @@ class ReplicaSet:
         constraint: Optional[int],
         deadline: Optional[float],
         req_trace: Any,
+        tenant: Optional[str] = None,
+        priority: Optional[int] = None,
     ) -> "Iterator[np.ndarray]":
         """The classic least-loaded walk (PR 2), over a resize-stable snapshot.
         In a role-split fleet, prefill-role replicas are deprioritized — they
@@ -811,8 +821,14 @@ class ReplicaSet:
                 )
             try:
                 stream = batchers[replica].submit(
-                    prompt, max_new_tokens=max_new_tokens, constraint=constraint, deadline=deadline
+                    prompt, max_new_tokens=max_new_tokens, constraint=constraint,
+                    deadline=deadline, tenant=tenant, priority=priority,
                 )
+            except TenantThrottled:
+                # every replica shares the same tenant registry, so walking the
+                # fleet could only re-shed — propagate the bucket's Retry-After
+                # (and the tenant-limit shed reason) to the HTTP layer intact
+                raise
             except QueueFullError as exc:
                 last_exc = exc
                 continue
@@ -838,6 +854,8 @@ class ReplicaSet:
         constraint: Optional[int],
         deadline: Optional[float],
         req_trace: Any,
+        tenant: Optional[str] = None,
+        priority: Optional[int] = None,
     ) -> "Optional[Iterator[np.ndarray]]":
         """The prefill→decode handoff path; None = not applicable (short
         prompt, no viable pair, or every prefill replica's queue full — the
@@ -870,7 +888,10 @@ class ReplicaSet:
                     stream = batchers[warm_t].submit(
                         prompt, max_new_tokens=max_new_tokens,
                         constraint=constraint, deadline=deadline,
+                        tenant=tenant, priority=priority,
                     )
+                except TenantThrottled:
+                    raise  # the bucket sheds fleet-wide; see _submit_routed
                 except QueueFullError:
                     pass
                 else:
@@ -892,7 +913,10 @@ class ReplicaSet:
                 pstream = batchers[p].submit(
                     prompt, max_new_tokens=max_new_tokens, constraint=constraint,
                     deadline=deadline, export_handoff=True,
+                    tenant=tenant, priority=priority,
                 )
+            except TenantThrottled:
+                raise
             except QueueFullError:
                 continue
             self._scheduler.note(p, prompt)
@@ -1215,6 +1239,21 @@ class ReplicaSet:
             )
             self.scale_to(n - 1)
 
+    def tenant_census(self) -> "Dict[str, Dict[str, int]]":
+        """Fleet-wide live per-tenant stream counts (multi-tenant QoS,
+        ``/debug/fleet``): each replica's bounded census summed — empty when
+        no identified-tenant traffic is in flight."""
+        census: "Dict[str, Dict[str, int]]" = {}
+        for batcher in self.batchers:
+            fn = getattr(batcher, "tenant_census", None)
+            if not callable(fn):
+                continue
+            for tenant, counts in fn().items():
+                entry = census.setdefault(tenant, {"resident": 0, "waiting": 0})
+                for key, value in counts.items():
+                    entry[key] = entry.get(key, 0) + int(value)
+        return census
+
     def queued_prefill_tokens(self) -> int:
         """Fleet-wide prefill backlog in tokens (engines that predate the
         token accounting report 0)."""
@@ -1348,6 +1387,22 @@ class ReplicaSet:
                     }
                 }
                 if any("prefix_cache" in entry for entry in per_replica)
+                else {}
+            ),
+            # fleet-wide multi-tenant QoS totals (present only when some
+            # replica reports a tenancy section — QoS-off fleets keep today's
+            # stats byte-for-byte; per-tenant buckets ride the app's registry)
+            **(
+                {
+                    "tenancy": {
+                        key: sum(
+                            int((entry.get("tenancy") or {}).get(key) or 0)
+                            for entry in per_replica
+                        )
+                        for key in ("shed_tenant_limit", "priority_preemptions")
+                    }
+                }
+                if any("tenancy" in entry for entry in per_replica)
                 else {}
             ),
             # fleet-level sheds (all replicas full / expired before routing) on
