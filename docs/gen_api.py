@@ -69,6 +69,7 @@ MODULES = [
     "unionml_tpu.observability.health",
     "unionml_tpu.analysis",
     "unionml_tpu.analysis.engine",
+    "unionml_tpu.analysis.project",
     "unionml_tpu.artifact",
     "unionml_tpu.remote",
     "unionml_tpu.launcher",

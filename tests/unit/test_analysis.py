@@ -950,3 +950,607 @@ def test_tpu009_suppression_comment(tmp_path):
     )
     assert rule_ids(result) == []
     assert [finding.rule for finding in result.suppressed] == ["TPU009"]
+
+
+# ----------------------------------------------- whole-program project rules
+
+
+def lint_pkg(tmp_path, files, **kwargs):
+    """Write a multi-module package fixture and lint the whole tree — the
+    cross-module rules only exist at this granularity."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "__init__.py").write_text("")
+    for name, source in files.items():
+        (pkg / name).write_text(textwrap.dedent(source))
+    return run_lint([pkg], **kwargs)
+
+
+def test_tpu010_flags_cross_module_lock_cycle(tmp_path):
+    # thread 1: Fleet._scale_lock -> Engine._lock; thread 2: Engine._lock ->
+    # Fleet._scale_lock (through an annotated callback parameter) — the cycle
+    # spans two modules and is invisible to any per-file rule
+    result = lint_pkg(
+        tmp_path,
+        {
+            "fleet.py": """
+            import threading
+
+            from pkg.engine import Engine
+
+
+            class Fleet:
+                def __init__(self):
+                    self._scale_lock = threading.Lock()
+                    self._engine = Engine()
+
+                def scale(self):
+                    with self._scale_lock:
+                        self._engine.drain(self)
+            """,
+            "engine.py": """
+            import threading
+
+            import pkg.fleet
+
+
+            class Engine:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def drain(self, fleet: pkg.fleet.Fleet):
+                    with self._lock:
+                        fleet.scale()
+            """,
+        },
+    )
+    assert rule_ids(result) == ["TPU010"]
+    message = result.findings[0].message
+    assert "lock-order cycle" in message
+    assert "[path 1]" in message and "[path 2]" in message
+    assert "Fleet._scale_lock" in message and "Engine._lock" in message
+
+
+def test_tpu010_near_miss_consistent_order_and_reentry(tmp_path):
+    # one global order (_scale_lock always before _lock) is the FIX and must
+    # not flag; re-entering the same lock through a helper is out of scope
+    result = lint_pkg(
+        tmp_path,
+        {
+            "fleet.py": """
+            import threading
+
+            from pkg.engine import Engine
+
+
+            class Fleet:
+                def __init__(self):
+                    self._scale_lock = threading.Lock()
+                    self._engine = Engine()
+
+                def scale(self):
+                    with self._scale_lock:
+                        self._engine.drain()
+
+                def fast_scale(self):
+                    with self._scale_lock:
+                        self._engine.drain()
+            """,
+            "engine.py": """
+            import threading
+
+
+            class Engine:
+                def __init__(self):
+                    self._lock = threading.Condition()
+
+                def drain(self):
+                    with self._lock:
+                        self._free_locked()
+
+                def _free_locked(self):
+                    pass
+            """,
+        },
+    )
+    assert rule_ids(result) == []
+
+
+def test_tpu010_locked_convention_participates(tmp_path):
+    # a *_locked method runs with its class's lock held by contract: calling
+    # another class's locking method from it is an edge; the reverse direction
+    # in the other module closes the cycle
+    result = lint_pkg(
+        tmp_path,
+        {
+            "cache.py": """
+            import threading
+
+            from pkg.pool import Pool
+
+
+            class Cache:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._pool = Pool()
+
+                def _evict_locked(self):
+                    self._pool.grab()
+            """,
+            "pool.py": """
+            import threading
+
+            import pkg.cache
+
+
+            class Pool:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def grab(self):
+                    with self._lock:
+                        pass
+
+                def rebalance(self, cache: pkg.cache.Cache):
+                    with self._lock:
+                        cache._evict_locked()
+            """,
+        },
+    )
+    assert rule_ids(result) == ["TPU010"]
+
+
+def test_tpu011_flags_varying_static_args_cross_module(tmp_path):
+    result = lint_pkg(
+        tmp_path,
+        {
+            "kernels.py": """
+            import functools
+
+            import jax
+
+
+            @functools.partial(jax.jit, static_argnames=("steps",))
+            def decode(params, carry, steps):
+                return carry
+
+
+            @functools.partial(jax.jit, static_argnums=(1,))
+            def gather(rows, width):
+                return rows
+            """,
+            "serve.py": """
+            from pkg.kernels import decode, gather
+
+
+            def storm(params, carry, prompt):
+                out = carry
+                for n in range(10):
+                    out = decode(params, out, steps=n)
+                return gather(out, len(prompt))
+            """,
+        },
+    )
+    assert rule_ids(result) == ["TPU011", "TPU011"]
+    assert "loop variable 'n'" in result.findings[0].message
+    assert "len() of parameter 'prompt'" in result.findings[1].message
+    assert "recompile" in result.findings[0].message or "trace+compile" in result.findings[0].message
+
+
+def test_tpu011_near_miss_constants_and_forwarded_params(tmp_path):
+    # module constants, config attributes, and plain forwarded parameters are
+    # not provably varying — the classic bucketed-steps call must stay clean
+    result = lint_pkg(
+        tmp_path,
+        {
+            "kernels.py": """
+            import functools
+
+            import jax
+
+
+            @functools.partial(jax.jit, static_argnames=("steps",))
+            def decode(params, carry, steps):
+                return carry
+            """,
+            "serve.py": """
+            from pkg.kernels import decode
+
+            CHUNK = 64
+
+
+            def ok(params, carry, steps):
+                out = decode(params, carry, steps=CHUNK)
+                out = decode(params, out, steps=steps)
+                return out
+            """,
+        },
+    )
+    assert rule_ids(result) == []
+
+
+def test_tpu011_attribute_binding_static_argnums(tmp_path):
+    # the engine idiom: self._fn = jax.jit(impl, static_argnums=...) — the
+    # hazard is at the method's call site, possibly far from the wrap
+    result = lint_pkg(
+        tmp_path,
+        {
+            "engine.py": """
+            import jax
+
+
+            def gather_rows(rows, table, width):
+                return rows
+
+
+            class Engine:
+                def __init__(self):
+                    self._gather = jax.jit(gather_rows, static_argnums=(2,))
+
+                def admit(self, rows, table, lengths):
+                    for length in lengths:
+                        rows = self._gather(rows, table, length)
+                    return rows
+            """,
+        },
+    )
+    assert rule_ids(result) == ["TPU011"]
+    assert "loop variable 'length'" in result.findings[0].message
+
+
+def test_tpu012_flags_executor_and_thread_holes_cross_module(tmp_path):
+    result = lint_pkg(
+        tmp_path,
+        {
+            "tenancy.py": """
+            import contextvars
+
+            _tenant_var = contextvars.ContextVar("tenant", default=None)
+
+
+            def current_tenant():
+                return _tenant_var.get()
+            """,
+            "handler.py": """
+            import threading
+
+            from pkg.tenancy import current_tenant
+
+
+            def bill_stream():
+                return current_tenant()
+
+
+            async def pull(loop):
+                return await loop.run_in_executor(None, bill_stream)
+
+
+            def spawn():
+                threading.Thread(target=bill_stream).start()
+            """,
+        },
+    )
+    assert rule_ids(result) == ["TPU012", "TPU012"]
+    assert "bill_stream" in result.findings[0].message
+    assert "_tenant_var" in result.findings[0].message
+    assert "ctx.run" in result.findings[0].message
+    assert "Thread target" in result.findings[1].message
+
+
+def test_tpu012_near_miss_wrapped_and_no_read(tmp_path):
+    # the PR 5 fix idiom (ctx.run), a partial(ctx.run, fn) wrap, a target that
+    # reads no contextvar, and an unresolvable stored callable — none may flag
+    result = lint_pkg(
+        tmp_path,
+        {
+            "tenancy.py": """
+            import contextvars
+
+            _tenant_var = contextvars.ContextVar("tenant", default=None)
+
+
+            def current_tenant():
+                return _tenant_var.get()
+            """,
+            "handler.py": """
+            import contextvars
+            import functools
+            import threading
+
+            from pkg.tenancy import current_tenant
+
+
+            def bill_stream():
+                return current_tenant()
+
+
+            def plain():
+                return 1
+
+
+            async def wrapped(loop):
+                ctx = contextvars.copy_context()
+                return await loop.run_in_executor(None, ctx.run, bill_stream)
+
+
+            def wrapped_thread():
+                ctx = contextvars.copy_context()
+                threading.Thread(target=functools.partial(ctx.run, bill_stream)).start()
+
+
+            async def no_read(loop):
+                return await loop.run_in_executor(None, plain)
+
+
+            class Batcher:
+                def __init__(self, fn):
+                    self._fn = fn
+
+                async def call(self, loop):
+                    return await loop.run_in_executor(None, self._fn)
+            """,
+        },
+    )
+    assert rule_ids(result) == []
+
+
+def test_tpu001_cross_module_reachability(tmp_path):
+    # the host sync hides in a helper module the jitted entry imports — the
+    # per-file pass cannot see it; the index-backed pass must
+    result = lint_pkg(
+        tmp_path,
+        {
+            "helpers.py": """
+            import numpy as np
+
+
+            def to_host(y):
+                return np.asarray(y)
+            """,
+            "main.py": """
+            import jax
+
+            from pkg.helpers import to_host
+
+
+            @jax.jit
+            def entry(y):
+                return to_host(y)
+            """,
+        },
+    )
+    assert rule_ids(result) == ["TPU001"]
+    assert result.findings[0].path.endswith("helpers.py")
+    assert "np.asarray" in result.findings[0].message
+
+
+def test_tpu001_cross_module_near_miss_unreachable_helper(tmp_path):
+    # same helper, never called from a jit entry: ordinary host code
+    result = lint_pkg(
+        tmp_path,
+        {
+            "helpers.py": """
+            import numpy as np
+
+
+            def to_host(y):
+                return np.asarray(y)
+            """,
+            "main.py": """
+            import jax
+
+            from pkg.helpers import to_host
+
+
+            @jax.jit
+            def entry(y):
+                return y + 1
+
+
+            def host_side(y):
+                return to_host(y)
+            """,
+        },
+    )
+    assert rule_ids(result) == []
+
+
+def test_tpu002_cross_module_donor(tmp_path):
+    # the donor is decorated in kernels.py; train.py imports and misuses it —
+    # reading `state` after its buffer was donated, two modules away
+    result = lint_pkg(
+        tmp_path,
+        {
+            "kernels.py": """
+            from functools import partial
+
+            import jax
+
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def update(carry, x):
+                return carry + x
+            """,
+            "train.py": """
+            from pkg.kernels import update
+
+
+            def train(state, xs):
+                for x in xs:
+                    out = update(state, x)
+                return state
+
+
+            def train_ok(state, xs):
+                for x in xs:
+                    state = update(state, x)
+                return state
+            """,
+        },
+    )
+    assert rule_ids(result) == ["TPU002"]
+    assert result.findings[0].path.endswith("train.py")
+    assert "'state'" in result.findings[0].message
+
+
+def test_project_rule_findings_respect_suppressions(tmp_path):
+    result = lint_pkg(
+        tmp_path,
+        {
+            "helpers.py": """
+            import numpy as np
+
+
+            def to_host(y):
+                return np.asarray(y)  # tpu-lint: disable=TPU001
+            """,
+            "main.py": """
+            import jax
+
+            from pkg.helpers import to_host
+
+
+            @jax.jit
+            def entry(y):
+                return to_host(y)
+            """,
+        },
+    )
+    assert rule_ids(result) == []
+    assert [finding.rule for finding in result.suppressed] == ["TPU001"]
+
+
+# ------------------------------------------------- index cache + incremental
+
+
+def test_index_cache_invalidation_on_edit(tmp_path):
+    snippet = tmp_path / "snippet.py"
+    snippet.write_text("x = 1\n")
+    first = run_lint([snippet])
+    assert first.clean and first.index_stats == {"hits": 0, "misses": 1}
+    warm = run_lint([snippet])
+    assert warm.index_stats == {"hits": 1, "misses": 0}
+    # the edit introduces a violation: the stale cached summary/findings must
+    # be dropped on the content-hash mismatch
+    snippet.write_text("import os\nA = int(os.environ['A'])\n")
+    edited = run_lint([snippet])
+    assert edited.index_stats == {"hits": 0, "misses": 1}
+    assert rule_ids(edited) == ["TPU005"]
+    # and a fix is picked up the same way
+    snippet.write_text("x = 2\n")
+    assert run_lint([snippet]).clean
+
+
+def test_run_lint_only_reports_named_files_with_whole_program_index(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "helpers.py").write_text(
+        textwrap.dedent(
+            """
+            import numpy as np
+
+
+            def to_host(y):
+                return np.asarray(y)
+            """
+        )
+    )
+    (pkg / "main.py").write_text(
+        textwrap.dedent(
+            """
+            import os
+
+            import jax
+
+            from pkg.helpers import to_host
+
+            A = int(os.environ["A"])
+
+
+            @jax.jit
+            def entry(y):
+                return to_host(y)
+            """
+        )
+    )
+    # only= restricts REPORTING, not the index: helpers.py's TPU001 finding
+    # (which needs main.py's jit entry to exist) is filtered out, main.py's
+    # TPU005 stays
+    result = run_lint([pkg], only=[pkg / "main.py"])
+    assert rule_ids(result) == ["TPU005"]
+    assert result.files == 1
+    full = run_lint([pkg])
+    assert sorted(rule_ids(full)) == ["TPU001", "TPU005"]
+
+
+def test_changed_only_cli_against_git(tmp_path, monkeypatch, capsys):
+    import subprocess
+
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    git = lambda *args: subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t", *args],
+        cwd=repo,
+        check=True,
+        capture_output=True,
+    )
+    git("init", "-q")
+    (repo / "stable.py").write_text("import os\nB = int(os.environ['B'])\n")
+    (repo / "touched.py").write_text("x = 1\n")
+    git("add", ".")
+    git("commit", "-q", "-m", "seed")
+    (repo / "touched.py").write_text("import os\nA = int(os.environ['A'])\n")
+    monkeypatch.chdir(repo)
+    # full run sees both findings; --changed-only reports just the edited file
+    assert lint_main([str(repo)]) == 1
+    capsys.readouterr()
+    assert lint_main([str(repo), "--changed-only", "HEAD", "--format", "json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["counts"] == {"TPU005": 1}
+    assert payload["findings"][0]["path"].endswith("touched.py")
+    assert payload["files"] == 1
+
+
+# ----------------------------------------------------------- SARIF reporter
+
+
+def test_sarif_reporter_round_trip(tmp_path):
+    from unionml_tpu.analysis import render_sarif
+
+    result = lint_source(
+        tmp_path,
+        """
+        import os
+
+        A = int(os.environ.get("A", "0"))
+        B = int(os.environ.get("B", "0"))  # tpu-lint: disable=TPU005
+        """,
+    )
+    payload = json.loads(render_sarif(result))
+    assert payload["version"] == "2.1.0"
+    assert "sarif-schema-2.1.0" in payload["$schema"]
+    run = payload["runs"][0]
+    assert run["tool"]["driver"]["name"] == "tpu-lint"
+    rule_index = {rule["id"] for rule in run["tool"]["driver"]["rules"]}
+    assert {"TPU001", "TPU005", "TPU010", "TPU011", "TPU012"} <= rule_index
+    active = [r for r in run["results"] if "suppressions" not in r]
+    suppressed = [r for r in run["results"] if "suppressions" in r]
+    assert len(active) == 1 and len(suppressed) == 1
+    assert active[0]["ruleId"] == "TPU005"
+    region = active[0]["locations"][0]["physicalLocation"]["region"]
+    assert region["startLine"] == 4 and region["startColumn"] >= 1
+    assert suppressed[0]["suppressions"] == [{"kind": "inSource"}]
+    assert run["invocations"][0]["executionSuccessful"] is True
+
+
+def test_sarif_cli_format(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import os\nA = int(os.environ['A'])\n")
+    assert lint_main([str(bad), "--format", "sarif"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["version"] == "2.1.0"
+    assert payload["runs"][0]["results"][0]["ruleId"] == "TPU005"
+    # JSON schema version is untouched by the SARIF addition
+    assert lint_main([str(bad), "--format", "json"]) == 1
+    assert json.loads(capsys.readouterr().out)["version"] == 1

@@ -1,13 +1,20 @@
 """tpu-lint: a TPU/concurrency-aware static analyzer for this codebase.
 
-Five AST rules target the hazard classes the serving/training stack actually
-has (host syncs under jit, use-after-donate, unlocked cross-thread mutation,
-blocking calls in engine loops, bare env-var numeric parses); the engine walks
-files, applies per-line ``# tpu-lint: disable=RULE`` suppressions, and renders
-text or JSON. Run it as ``unionml-tpu lint [paths]`` or
+Twelve rules target the hazard classes the serving/training stack actually
+has. Nine are per-file AST rules (host syncs under jit, use-after-donate,
+unlocked cross-thread mutation, blocking calls in engine loops, bare env-var
+numeric parses, wall-clock durations, unlocked ``*_locked`` calls, leaked
+engine threads, unbounded per-key registries); three are whole-program rules
+over a cross-module project index (lock-order cycles, recompile hazards at
+jit static positions, contextvar reads behind executor/thread hops), and
+TPU001/TPU002 use the same index to follow jit reachability and donation
+across module boundaries. The engine walks files, applies per-line
+``# tpu-lint: disable=RULE`` suppressions, and renders text, JSON, or SARIF
+2.1.0. Run it as ``unionml-tpu lint [paths]`` or
 ``python -m unionml_tpu.analysis``; the tier-1 gate
 (tests/unit/test_syntax.py) asserts ``run_lint(["unionml_tpu"])`` stays clean.
-See docs/static-analysis.md for the rule catalog.
+See docs/static-analysis.md for the rule catalog and the whole-program
+architecture notes.
 """
 
 from __future__ import annotations
@@ -19,17 +26,23 @@ from unionml_tpu.analysis.engine import (
     all_rules,
     main,
     render_json,
+    render_sarif,
     render_text,
     run_lint,
 )
+from unionml_tpu.analysis.project import ProjectIndex, build_index, clear_index_cache
 
 __all__ = [
     "Finding",
     "LintResult",
+    "ProjectIndex",
     "Rule",
     "all_rules",
+    "build_index",
+    "clear_index_cache",
     "main",
     "render_json",
+    "render_sarif",
     "render_text",
     "run_lint",
 ]

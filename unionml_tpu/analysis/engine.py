@@ -11,13 +11,19 @@ the AST; this engine makes them review-time failures instead of TPU-time
 mysteries (the same layering JAX's own lint/pytype gates give the upstream
 stack).
 
-Architecture: one :func:`ast.parse` per file, every selected rule visits the
-same tree (rules are stateless classes with a ``check(tree, path)`` method),
-findings funnel through per-line ``# tpu-lint: disable=RULE`` suppressions
-into a :class:`LintResult`. Reporters render text (``path:line: RULE id:
-message``) or a stable JSON schema (``{"findings": [...], "counts": ...}``)
-that the benchmark lane tracks across rounds. Exit codes: 0 clean (justified
-suppressions included), 1 findings, 2 usage/parse errors.
+Architecture: one parse per file feeds BOTH rule protocols. Per-file rules
+are stateless classes with a ``check(tree, path)`` method that sees one tree;
+interprocedural rules additionally implement ``check_project(index)`` against
+the cross-module :class:`~unionml_tpu.analysis.project.ProjectIndex` (symbol
+table, class hierarchy, call graph, per-function lock/jit/contextvar facts),
+which the engine builds once per run from a content-hash cache — a warm run
+re-summarizes only edited files, keeping the tier-1 gate inside its 5 s
+budget. Findings from both protocols funnel through per-line
+``# tpu-lint: disable=RULE`` suppressions into a :class:`LintResult`.
+Reporters render text (``path:line: RULE id: message``), a stable JSON schema
+(``{"findings": [...], "counts": ...}``, version 1), or SARIF 2.1.0 for CI
+annotation surfaces. Exit codes: 0 clean (justified suppressions included),
+1 findings, 2 usage/parse errors.
 """
 
 from __future__ import annotations
@@ -27,6 +33,7 @@ import ast
 import dataclasses
 import json
 import re
+import subprocess
 import sys
 from pathlib import Path
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
@@ -38,6 +45,7 @@ __all__ = [
     "all_rules",
     "main",
     "render_json",
+    "render_sarif",
     "render_text",
     "run_lint",
 ]
@@ -68,6 +76,12 @@ class Rule:
     stateless across files — the engine instantiates each once per run and
     calls ``check`` per file, so a rule must not carry per-file state between
     calls (everything it needs is derivable from the tree).
+
+    Interprocedural rules additionally override :meth:`check_project` (and may
+    leave :meth:`check` returning nothing): the engine builds one
+    :class:`~unionml_tpu.analysis.project.ProjectIndex` per run and hands it to
+    every selected rule after the per-file pass, so a rule can follow call
+    graphs, lock orders, and contextvar flows across module boundaries.
     """
 
     id: str = ""
@@ -75,6 +89,14 @@ class Rule:
 
     def check(self, tree: ast.Module, path: str) -> "List[Finding]":
         raise NotImplementedError
+
+    def check_project(self, index) -> "List[Finding]":
+        """Whole-program pass over the cross-module index; default: nothing.
+
+        Findings may duplicate :meth:`check`'s (e.g. TPU001's project pass
+        re-walks intra-module reachability on its way across modules) — the
+        engine deduplicates on (rule, path, line, col)."""
+        return []
 
     def finding(self, path: str, node: ast.AST, message: str) -> Finding:
         return Finding(
@@ -103,6 +125,9 @@ class LintResult:
     #: since an unparseable file is a gate failure of its own
     errors: "List[Tuple[str, str]]" = dataclasses.field(default_factory=list)
     files: int = 0
+    #: project-index cache accounting for this run ({"hits": n, "misses": m});
+    #: the benchmark lane reports these to pin the incremental contract
+    index_stats: "Dict[str, int]" = dataclasses.field(default_factory=dict)
 
     @property
     def clean(self) -> bool:
@@ -171,33 +196,84 @@ def run_lint(
     *,
     select: "Optional[Iterable[str]]" = None,
     ignore: "Optional[Iterable[str]]" = None,
+    only: "Optional[Sequence[str | Path]]" = None,
 ) -> LintResult:
     """Lint ``paths`` (files and/or directory trees) with the selected rules.
 
-    This is the library surface the tier-1 gate calls (``run_lint(["unionml_tpu"])``
-    must be clean); the CLI in :func:`main` is a thin reporter over it.
+    The project index is always built over ALL of ``paths`` (interprocedural
+    facts must be whole-program to be true); ``only`` restricts which files'
+    findings are REPORTED — the ``--changed-only`` fast path — without
+    shrinking what the index sees. This is the library surface the tier-1
+    gate calls (``run_lint(["unionml_tpu"])`` must be clean); the CLI in
+    :func:`main` is a thin reporter over it.
     """
+    from unionml_tpu.analysis.project import build_index
+
     rules = _select_rules(select, ignore)
     result = LintResult()
-    for path in iter_py_files(paths):
-        try:
-            source = path.read_text()
-            tree = ast.parse(source, filename=str(path))
-        except (OSError, SyntaxError, ValueError) as exc:
-            result.errors.append((str(path), str(exc)))
+    files = iter_py_files(paths)
+    index, parse_errors, stats = build_index(files)
+    result.errors.extend(parse_errors)
+    result.index_stats = stats
+    only_set: "Optional[set]" = None
+    if only is not None:
+        only_set = {str(Path(p).resolve()) for p in only}
+    summaries = sorted(index.by_path.values(), key=lambda s: s.path)
+
+    def reported(path: str) -> bool:
+        return only_set is None or str(Path(path).resolve()) in only_set
+
+    def place(finding: Finding, disabled: "Dict[int, set]") -> None:
+        ids = disabled.get(finding.line, ())
+        if finding.rule in ids or "ALL" in ids:
+            result.suppressed.append(finding)
+        else:
+            result.findings.append(finding)
+
+    for summary in summaries:
+        if not reported(summary.path):
             continue
         result.files += 1
-        disabled = _suppressions(source)
         for rule in rules:
-            for finding in rule.check(tree, str(path)):
-                ids = disabled.get(finding.line, ())
-                if finding.rule in ids or "ALL" in ids:
-                    result.suppressed.append(finding)
-                else:
-                    result.findings.append(finding)
+            # per-file rules are pure functions of (tree, path): their output
+            # is memoized on the summary, which the index invalidates on any
+            # content change — a warm run re-checks only edited files
+            cached = summary.rule_findings.get(rule.id)
+            if cached is None:
+                cached = rule.check(summary.tree, summary.path)
+                summary.rule_findings[rule.id] = cached
+            for finding in cached:
+                place(finding, summary.suppressions)
+
+    # whole-program pass: every rule gets the index; findings land in the
+    # file they point at, under that file's suppression comments
+    for rule in rules:
+        for finding in rule.check_project(index):
+            if not reported(finding.path):
+                continue
+            owner = index.by_path.get(finding.path)
+            place(finding, owner.suppressions if owner is not None else {})
+
+    result.findings = _dedupe(result.findings)
+    result.suppressed = _dedupe(result.suppressed)
     result.findings.sort(key=lambda f: (f.path, f.line, f.rule))
     result.suppressed.sort(key=lambda f: (f.path, f.line, f.rule))
     return result
+
+
+def _dedupe(findings: "List[Finding]") -> "List[Finding]":
+    """Drop repeats on (rule, path, line, col): a project rule re-deriving an
+    intra-module finding (TPU001/TPU002's upgraded reachability covers the
+    per-file rule's ground on its way across modules) reports it once."""
+    seen: "Dict[Tuple[str, str, int, int], None]" = {}
+    out: "List[Finding]" = []
+    for finding in findings:
+        key = (finding.rule, finding.path, finding.line, finding.col)
+        if key in seen:
+            continue
+        seen[key] = None
+        out.append(finding)
+    return out
 
 
 def render_text(result: LintResult, *, show_suppressed: bool = False) -> str:
@@ -229,23 +305,119 @@ def render_json(result: LintResult) -> str:
     return json.dumps(payload, indent=2)
 
 
+def render_sarif(result: LintResult) -> str:
+    """SARIF 2.1.0 — the interchange schema CI annotation surfaces (GitHub
+    code scanning, VS Code SARIF viewers) render natively. Active findings
+    are ``warning``-level results; suppressed findings are carried with an
+    ``inSource`` suppression record so dashboards can audit the budget; parse
+    errors surface as tool ``notifications``."""
+    from unionml_tpu.analysis.rules import RULES
+
+    def _result(finding: Finding, suppressed: bool) -> "Dict[str, object]":
+        record: "Dict[str, object]" = {
+            "ruleId": finding.rule,
+            "level": "warning",
+            "message": {"text": finding.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": finding.path},
+                        "region": {
+                            "startLine": finding.line,
+                            # SARIF columns are 1-indexed; Finding.col is 0-indexed
+                            "startColumn": finding.col + 1,
+                        },
+                    }
+                }
+            ],
+        }
+        if suppressed:
+            record["suppressions"] = [{"kind": "inSource"}]
+        return record
+
+    payload = {
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "tpu-lint",
+                        "informationUri": "docs/static-analysis.md",
+                        "rules": [
+                            {"id": rule_id, "shortDescription": {"text": cls.title}}
+                            for rule_id, cls in sorted(RULES.items())
+                        ],
+                    }
+                },
+                "results": [_result(f, suppressed=False) for f in result.findings]
+                + [_result(f, suppressed=True) for f in result.suppressed],
+                "invocations": [
+                    {
+                        "executionSuccessful": not result.errors,
+                        "toolExecutionNotifications": [
+                            {"level": "error", "message": {"text": f"{path}: {message}"}}
+                            for path, message in result.errors
+                        ],
+                    }
+                ],
+            }
+        ],
+    }
+    return json.dumps(payload, indent=2)
+
+
+def _changed_files(ref: str) -> "List[Path]":
+    """Files named by ``git diff --name-only <ref>`` plus untracked .py files
+    — the ``--changed-only`` pre-push scope. Git prints paths relative to the
+    repository toplevel, so they are anchored there (the command may run from
+    any subdirectory)."""
+    top = subprocess.run(
+        ["git", "rev-parse", "--show-toplevel"], capture_output=True, text=True
+    )
+    if top.returncode != 0:
+        raise ValueError(
+            f"--changed-only requires a git checkout: {top.stderr.strip() or 'git failed'}"
+        )
+    root = Path(top.stdout.strip())
+    out: "List[Path]" = []
+    for args in (
+        ["git", "diff", "--name-only", ref],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        proc = subprocess.run(args, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise ValueError(f"`{' '.join(args)}` failed: {proc.stderr.strip()}")
+        out.extend(root / line for line in proc.stdout.splitlines() if line.endswith(".py"))
+    return out
+
+
 def main(argv: "Optional[Sequence[str]]" = None) -> int:
     """``python -m unionml_tpu.analysis [paths]`` entry point (also backs the
     ``unionml-tpu lint`` CLI command)."""
     parser = argparse.ArgumentParser(
         prog="tpu-lint",
-        description="TPU/concurrency-aware static analyzer (rules TPU001-TPU009)",
+        description="TPU/concurrency-aware static analyzer (rules TPU001-TPU012)",
     )
     parser.add_argument(
         "paths",
         nargs="*",
         help="files or directories (default: the installed unionml_tpu package tree)",
     )
-    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--format", choices=("text", "json", "sarif"), default="text")
     parser.add_argument("--select", default=None, help="comma-separated rule ids to run")
     parser.add_argument("--ignore", default=None, help="comma-separated rule ids to skip")
     parser.add_argument(
         "--show-suppressed", action="store_true", help="list suppressed findings in text output"
+    )
+    parser.add_argument(
+        "--changed-only",
+        nargs="?",
+        const="HEAD",
+        default=None,
+        metavar="REF",
+        help="report findings only for files in `git diff --name-only REF` (default HEAD) "
+        "plus untracked files; the project index is still built over all PATHS",
     )
     args = parser.parse_args(argv)
     # no paths: lint the package itself, wherever it is installed — so
@@ -253,12 +425,15 @@ def main(argv: "Optional[Sequence[str]]" = None) -> int:
     paths = args.paths or [Path(__file__).resolve().parents[1]]
     split = lambda raw: [part.strip() for part in raw.split(",") if part.strip()] if raw else None
     try:
-        result = run_lint(paths, select=split(args.select), ignore=split(args.ignore))
-    except (FileNotFoundError, ValueError) as exc:
+        only = _changed_files(args.changed_only) if args.changed_only else None
+        result = run_lint(paths, select=split(args.select), ignore=split(args.ignore), only=only)
+    except (FileNotFoundError, ValueError, OSError) as exc:
         print(f"tpu-lint: {exc}", file=sys.stderr)
         return 2
     if args.format == "json":
         print(render_json(result))
+    elif args.format == "sarif":
+        print(render_sarif(result))
     else:
         print(render_text(result, show_suppressed=args.show_suppressed))
     return result.exit_code()

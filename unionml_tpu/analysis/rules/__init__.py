@@ -18,6 +18,9 @@ from unionml_tpu.analysis.rules.tpu006_wall_clock import WallClockDuration
 from unionml_tpu.analysis.rules.tpu007_locked_callers import UnlockedLockedHelperCall
 from unionml_tpu.analysis.rules.tpu008_thread_leak import LeakedEngineThread
 from unionml_tpu.analysis.rules.tpu009_registry import UnboundedPerKeyRegistry
+from unionml_tpu.analysis.rules.tpu010_lock_order import LockOrderCycle
+from unionml_tpu.analysis.rules.tpu011_recompile import RecompileHazard
+from unionml_tpu.analysis.rules.tpu012_contextvar import ContextvarExecutorHole
 
 __all__ = ["RULES"]
 
@@ -33,5 +36,8 @@ RULES = {
         UnlockedLockedHelperCall,
         LeakedEngineThread,
         UnboundedPerKeyRegistry,
+        LockOrderCycle,
+        RecompileHazard,
+        ContextvarExecutorHole,
     )
 }

@@ -9,6 +9,17 @@ from typing import Iterator, List, Optional, Tuple
 #: or called with the function as first argument
 JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit", "jax.experimental.pjit.pjit"}
 
+#: constructors whose result is a mutual-exclusion lock (shared by TPU003,
+#: TPU007, TPU010, and the project index's per-class lock discovery)
+LOCK_FACTORIES = {
+    "threading.Lock",
+    "threading.RLock",
+    "threading.Condition",
+    "Lock",
+    "RLock",
+    "Condition",
+}
+
 _SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
 
 

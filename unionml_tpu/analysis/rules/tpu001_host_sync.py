@@ -12,6 +12,12 @@ step. ``print`` runs at trace time only (usually a debugging leftover; use
 wrapping of a module function, method, or nested function), follows the
 intra-module call graph from those entry points, and flags host-sync
 operations anywhere in the reachable set.
+
+Whole-program upgrade: :meth:`HostSyncInJit.check_project` re-runs the same
+scan over the PROJECT index's cross-module reachable set — a jitted entry in
+``serving/continuous.py`` calling a helper imported from ``ops/`` now carries
+the taint into that helper's module, where the per-file pass could never
+follow. Intra-module duplicates are dropped by the engine's dedupe.
 """
 
 from __future__ import annotations
@@ -60,6 +66,15 @@ class HostSyncInJit(Rule):
             wrap = jit_wrap_call(node)
             if wrap is not None and wrap.args and isinstance(wrap.args[0], ast.Lambda):
                 findings.extend(self._scan(wrap.args[0], path, params=self._params(wrap.args[0])))
+        return findings
+
+    def check_project(self, index) -> "List[Finding]":
+        """Index-backed reachability: BFS from every jit entry point across
+        the resolved cross-module call graph, scanning each reached function
+        with the same host-sync detectors as the per-file pass."""
+        findings: "List[Finding]" = []
+        for facts in index.reachable_from(index.jit_entry_functions()):
+            findings.extend(self._scan(facts.node, facts.path))
         return findings
 
     # ------------------------------------------------------------- collection

@@ -9,7 +9,13 @@ recognizes as safe. Only literal ``donate_argnums`` are analyzed: a variable
 value (e.g. gated on ``debug_disable_donation``) cannot be resolved
 statically and is never guessed.
 
-Scope: same-file dataflow. Donating callables are collected from local
+Scope: same-file dataflow, plus an index-backed cross-module pass
+(:meth:`UseAfterDonate.check_project`): a donor defined in one module
+(``@partial(jax.jit, donate_argnums=...)`` or a module-level
+``step = jax.jit(fn, donate_argnums=...)`` binding) and imported into
+another is invisible to the per-file pass — the project index's donor table
+makes the importing module's call sites subject to the same later-load
+analysis. Donating callables are collected from local
 ``f = jax.jit(g, donate_argnums=...)`` bindings, class-wide
 ``self._f = jax.jit(...)`` attributes, ``@partial(jax.jit, donate_argnums=...)``
 decorators, and immediate ``jax.jit(g, ...)(args)`` invocations; every call
@@ -53,6 +59,28 @@ class UseAfterDonate(Rule):
         scopes += [n for n in ast.walk(tree) if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
         for scope in scopes:
             findings.extend(self._check_scope(scope, path, attr_donors, module_donors))
+        return findings
+
+    def check_project(self, index) -> "List[Finding]":
+        """Cross-module donors: a module that imports a donating callable gets
+        the same later-load analysis, with the import alias as the donor name."""
+        findings: "List[Finding]" = []
+        for summary in index.modules.values():
+            imported: "Dict[str, Tuple[int, ...]]" = {}
+            for alias, fq in summary.imports.items():
+                mod, _, sym = fq.rpartition(".")
+                donor_module = index.modules.get(mod)
+                if donor_module is not None and sym in donor_module.donors:
+                    imported[alias] = donor_module.donors[sym]
+            if not imported:
+                continue
+            tree = summary.tree
+            scopes: "List[ast.AST]" = [tree]
+            scopes += [
+                n for n in ast.walk(tree) if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            ]
+            for scope in scopes:
+                findings.extend(self._check_scope(scope, summary.path, {}, imported))
         return findings
 
     # ------------------------------------------------------------ donor discovery

@@ -30,16 +30,9 @@ import dataclasses
 from typing import List, Optional, Set
 
 from unionml_tpu.analysis.engine import Finding, Rule
-from unionml_tpu.analysis.rules._common import call_target, self_attribute
+from unionml_tpu.analysis.rules._common import LOCK_FACTORIES, call_target, self_attribute
 
-_LOCK_FACTORIES = {
-    "threading.Lock",
-    "threading.RLock",
-    "threading.Condition",
-    "Lock",
-    "RLock",
-    "Condition",
-}
+_LOCK_FACTORIES = LOCK_FACTORIES
 
 #: method calls that mutate their receiver in place
 _MUTATING_METHODS = {

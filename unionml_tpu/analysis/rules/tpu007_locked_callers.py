@@ -35,16 +35,9 @@ import ast
 from typing import List, Set
 
 from unionml_tpu.analysis.engine import Finding, Rule
-from unionml_tpu.analysis.rules._common import call_target, self_attribute
+from unionml_tpu.analysis.rules._common import LOCK_FACTORIES, call_target, self_attribute
 
-_LOCK_FACTORIES = {
-    "threading.Lock",
-    "threading.RLock",
-    "threading.Condition",
-    "Lock",
-    "RLock",
-    "Condition",
-}
+_LOCK_FACTORIES = LOCK_FACTORIES
 
 _EXEMPT_METHODS = {"__init__", "__new__", "__del__", "__post_init__"}
 

@@ -79,10 +79,11 @@ def templates() -> None:
 @click.option(
     "--format",
     "format_",
-    type=click.Choice(["text", "json"]),
+    type=click.Choice(["text", "json", "sarif"]),
     default="text",
     show_default=True,
-    help="report format (json follows the stable schema docs/static-analysis.md describes)",
+    help="report format (json follows the stable schema docs/static-analysis.md describes; "
+    "sarif emits SARIF 2.1.0 for CI/editor annotation surfaces)",
 )
 @click.option("--select", default=None, help="comma-separated rule ids to run (default: all)")
 @click.option("--ignore", default=None, help="comma-separated rule ids to skip")
@@ -92,19 +93,37 @@ def templates() -> None:
     default=False,
     help="also list findings silenced by `# tpu-lint: disable=RULE` comments",
 )
+@click.option(
+    "--changed-only",
+    is_flag=False,
+    flag_value="HEAD",
+    default=None,
+    metavar="[REF]",
+    help="report findings only for files changed vs REF (default HEAD) plus untracked "
+    "files — the fast pre-push path; the whole-program index still covers all PATHS",
+)
 def lint(
-    paths: "tuple[str, ...]", format_: str, select: Optional[str], ignore: Optional[str], show_suppressed: bool
+    paths: "tuple[str, ...]",
+    format_: str,
+    select: Optional[str],
+    ignore: Optional[str],
+    show_suppressed: bool,
+    changed_only: Optional[str],
 ) -> None:
-    """Run tpu-lint, the TPU/concurrency-aware static analyzer (TPU001-TPU008).
+    """Run tpu-lint, the TPU/concurrency-aware static analyzer (TPU001-TPU012).
 
-    Checks for host syncs inside jit-compiled functions, use-after-donate,
-    unlocked mutation of lock-guarded state, blocking calls in serving
-    handlers/engine loops, bare env-var numeric parses, wall-clock
+    Per-file rules check for host syncs inside jit-compiled functions,
+    use-after-donate, unlocked mutation of lock-guarded state, blocking calls
+    in serving handlers/engine loops, bare env-var numeric parses, wall-clock
     time.time() in duration/deadline arithmetic, *_locked helpers called
-    without holding the lock, and threads started in closeable classes but
-    never joined. PATHS defaults to ``unionml_tpu``; exits 0 when
-    clean, 1 on findings, 2 on usage/parse errors. Also runnable as
-    ``python -m unionml_tpu.analysis``.
+    without holding the lock, threads started in closeable classes but never
+    joined, and unbounded per-key registries. Whole-program rules over the
+    cross-module project index detect lock-order cycles (TPU010), recompile
+    hazards at jit static positions (TPU011), and contextvar reads behind
+    executor/thread hops without ctx.run (TPU012); TPU001/TPU002 follow jit
+    reachability and donation across modules through the same index. PATHS
+    defaults to ``unionml_tpu``; exits 0 when clean, 1 on findings, 2 on
+    usage/parse errors. Also runnable as ``python -m unionml_tpu.analysis``.
     """
     from unionml_tpu.analysis.engine import main as lint_main
 
@@ -115,6 +134,8 @@ def lint(
         argv += ["--ignore", ignore]
     if show_suppressed:
         argv.append("--show-suppressed")
+    if changed_only:
+        argv += ["--changed-only", changed_only]
     sys.exit(lint_main(argv))
 
 
