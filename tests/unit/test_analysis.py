@@ -1100,6 +1100,76 @@ def test_tpu010_locked_convention_participates(tmp_path):
     assert rule_ids(result) == ["TPU010"]
 
 
+def test_tpu010_textually_nested_with_statements(tmp_path):
+    # `with self._a:` with `with self._b:` as a SEPARATE nested statement (not
+    # the `with a, b:` single-statement form) — the inner acquisition must be
+    # recorded with the outer lock held, so opposite nesting in two methods is
+    # a cycle
+    result = lint_pkg(
+        tmp_path,
+        {
+            "pair.py": """
+            import threading
+
+
+            class Pair:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def forward(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def backward(self):
+                    with self._b:
+                        with self._a:
+                            pass
+            """,
+        },
+    )
+    assert rule_ids(result) == ["TPU010"]
+    message = result.findings[0].message
+    assert "Pair._a" in message and "Pair._b" in message
+
+
+def test_tpu010_call_under_nested_with_carries_inner_lock(tmp_path):
+    # a call under the INNER of two textually nested withs must carry both
+    # locks in its held-set: the b -> c edge exists only because the
+    # grab_c() call site holds _b, and backward's c -> b closes the cycle
+    result = lint_pkg(
+        tmp_path,
+        {
+            "trio.py": """
+            import threading
+
+
+            class Trio:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+                    self._c = threading.Lock()
+
+                def forward(self):
+                    with self._a:
+                        with self._b:
+                            self.grab_c()
+
+                def grab_c(self):
+                    with self._c:
+                        pass
+
+                def backward(self):
+                    with self._c, self._b:
+                        pass
+            """,
+        },
+    )
+    assert rule_ids(result) == ["TPU010"]
+    assert "Trio._b" in result.findings[0].message and "Trio._c" in result.findings[0].message
+
+
 def test_tpu011_flags_varying_static_args_cross_module(tmp_path):
     result = lint_pkg(
         tmp_path,
@@ -1190,6 +1260,69 @@ def test_tpu011_attribute_binding_static_argnums(tmp_path):
                 def admit(self, rows, table, lengths):
                     for length in lengths:
                         rows = self._gather(rows, table, length)
+                    return rows
+            """,
+        },
+    )
+    assert rule_ids(result) == ["TPU011"]
+    assert "loop variable 'length'" in result.findings[0].message
+
+
+def test_tpu011_nested_for_loops_accumulate_targets(tmp_path):
+    # a for directly inside another for (no intervening statement) must still
+    # register its own target: the inner loop variable in a static position is
+    # the canonical recompile-storm shape
+    result = lint_pkg(
+        tmp_path,
+        {
+            "kernels.py": """
+            import functools
+
+            import jax
+
+
+            @functools.partial(jax.jit, static_argnames=("steps",))
+            def decode(params, carry, steps):
+                return carry
+            """,
+            "serve.py": """
+            from pkg.kernels import decode
+
+
+            def storm(params, carry, batches):
+                out = carry
+                for batch in batches:
+                    for n in range(4):
+                        out = decode(params, out, steps=n)
+                return out
+            """,
+        },
+    )
+    assert rule_ids(result) == ["TPU011"]
+    assert "loop variable 'n'" in result.findings[0].message
+
+
+def test_tpu011_jit_decorated_method_static_argnums(tmp_path):
+    # decorator static_argnums count the unbound `self` (position 2 = width),
+    # but the self.gather(...) call site has no receiver argument — the check
+    # must look at call position 1, not 2
+    result = lint_pkg(
+        tmp_path,
+        {
+            "engine.py": """
+            import functools
+
+            import jax
+
+
+            class Engine:
+                @functools.partial(jax.jit, static_argnums=(2,))
+                def gather(self, rows, width):
+                    return rows
+
+                def admit(self, rows, lengths):
+                    for length in lengths:
+                        rows = self.gather(rows, length)
                     return rows
             """,
         },

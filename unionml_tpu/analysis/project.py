@@ -216,33 +216,39 @@ class _FunctionWalker:
 
     def walk(self, node: ast.AST, held: Tuple[str, ...]) -> None:
         for child in ast.iter_child_nodes(node):
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                self.builder.visit_function(child, cls=None)
-                continue
-            if isinstance(child, (ast.Lambda, ast.ClassDef)):
-                continue
-            if isinstance(child, (ast.Import, ast.ImportFrom)):
-                self.builder.record_import(child)
-                continue
-            if isinstance(child, ast.Assign):
-                self._record_locals(child)
-                self.builder.record_assign(child, self.cls)
-            if isinstance(child, (ast.With, ast.AsyncWith)):
-                inner = held
-                for item in child.items:
-                    raw = self._lock_token(item.context_expr)
-                    if raw is not None:
-                        self.facts.acquisitions.append((raw, child.lineno, inner))
-                        inner = inner + (raw,)
-                for sub in child.items:  # guards/`as` targets may contain calls
-                    self._record(sub.context_expr, held)
-                    self.walk(sub.context_expr, held)
-                for stmt in child.body:
-                    self._record(stmt, inner)
-                    self.walk(stmt, inner)
-                continue
-            self._record(child, held)
-            self.walk(child, held)
+            self._visit(child, held)
+
+    def _visit(self, node: ast.AST, held: Tuple[str, ...]) -> None:
+        """Per-node dispatch, entered both from :meth:`walk` (children) and
+        for each With-body statement — a ``with self._b:`` textually nested
+        inside ``with self._a:`` must re-enter the With branch, or its
+        acquisition (and the held-set under it) is silently lost."""
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.builder.visit_function(node, cls=None)
+            return
+        if isinstance(node, (ast.Lambda, ast.ClassDef)):
+            return
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            self.builder.record_import(node)
+            return
+        if isinstance(node, ast.Assign):
+            self._record_locals(node)
+            self.builder.record_assign(node, self.cls)
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = held
+            for item in node.items:
+                raw = self._lock_token(item.context_expr)
+                if raw is not None:
+                    self.facts.acquisitions.append((raw, node.lineno, inner))
+                    inner = inner + (raw,)
+            for item in node.items:  # guards/`as` targets may contain calls
+                self._record(item.context_expr, held)
+                self.walk(item.context_expr, held)
+            for stmt in node.body:
+                self._visit(stmt, inner)
+            return
+        self._record(node, held)
+        self.walk(node, held)
 
     def _record_locals(self, node: ast.Assign) -> None:
         """Local type hints (``x = ClassName(...)``) and copy_context names
@@ -475,6 +481,12 @@ class _SummaryBuilder:
             donate: Tuple[int, ...] = ()
             if isinstance(dec, ast.Call):
                 nums, names, donate = _static_positions(dec)
+            if cls:
+                # decorator argnums are relative to the UNBOUND function
+                # (position 0 = self), but call sites spell `self.name(...)`
+                # without the receiver — store call-site-relative positions
+                nums = tuple(n - 1 for n in nums if n > 0)
+                donate = tuple(n - 1 for n in donate if n > 0)
             binding = f"self.{facts.name}" if cls else facts.name
             summary.jit_bindings.append(
                 JitBinding(

@@ -108,17 +108,22 @@ class RecompileHazard(Rule):
 
         def walk(node: ast.AST, loop_vars: "Set[str]") -> None:
             for child in ast.iter_child_nodes(node):
-                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)):
-                    continue
-                if isinstance(child, (ast.For, ast.AsyncFor)):
-                    walk(child.iter, loop_vars)
-                    inner = loop_vars | set(assign_target_names(child.target))
-                    for stmt in child.body + child.orelse:
-                        record(stmt, inner)
-                        walk(stmt, inner)
-                    continue
-                record(child, loop_vars)
-                walk(child, loop_vars)
+                visit(child, loop_vars)
+
+        # dispatch per node, entered for walked children AND For-body
+        # statements — a for-loop directly inside another for-loop must
+        # re-enter the For branch so its own target variable accumulates
+        def visit(node: ast.AST, loop_vars: "Set[str]") -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)):
+                return
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                visit(node.iter, loop_vars)  # the iter expr runs outside the loop body
+                inner = loop_vars | set(assign_target_names(node.target))
+                for stmt in node.body + node.orelse:
+                    visit(stmt, inner)
+                return
+            record(node, loop_vars)
+            walk(node, loop_vars)
 
         def record(node: ast.AST, loop_vars: "Set[str]") -> None:
             if isinstance(node, ast.Call):
