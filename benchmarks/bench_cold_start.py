@@ -1,0 +1,168 @@
+"""Cold start: fresh-process time-to-first-token, empty vs populated AOT store.
+
+The production shape this lane models: a server restart, a serverless
+scale-from-zero container, or a ``scale_to`` scale-up replica — a FRESH
+process that must build its continuous engine, warm it, and answer its first
+token. With an empty AOT store every program pays a real XLA compile (87.6 s
+for BERT-base on the TPU substrate, per BENCH_ALL.json); with the store
+populated by a previous process, warmup *deserializes* the same executables
+(serving/aot.py) and cold-start-to-first-token becomes load-bound.
+
+Headline: **cold/warm ratio** of ready-to-first-token wall time (higher is
+better — ``run_all.py``'s keep-best accretion applies). The acceptance bar is
+>= 3x on this workload. Each leg runs in its OWN interpreter (via this same
+script's ``--child`` mode) so jit caches cannot leak between legs, and the
+persistent XLA compilation cache is pinned OFF in the children so the cold
+leg is genuinely compile-bound — the store is the only warm path measured.
+
+CPU-substrate by design (a ratio of two same-substrate fresh processes, like
+the ``prefix_cache`` and ``continuous_stall`` lanes): the win measured is
+compile work avoided, not chip throughput.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from benchmarks.common import emit, log, pin_platform  # noqa: E402
+
+BUCKETS = (32, 64, 128)   # three prefill shapes: each is its own compile
+NEW_TOKENS = 8
+BLOCK = 16
+ADMIT_CHUNK = 32
+ATTEMPTS = 2              # best-of pairs: keep the least noisy ratio
+PROMPT_LEN = 24
+
+
+def _child(store_dir: str) -> None:
+    """One fresh-process leg: build the production-shaped engine, warm it,
+    serve one request, and report ready/first-token wall times as JSON."""
+    pin_platform()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from unionml_tpu.models import GenerationConfig, Generator, Llama, LlamaConfig
+    from unionml_tpu.serving import ContinuousBatcher
+
+    jax.config.update("jax_platforms", "cpu")
+    config = LlamaConfig.tiny(
+        vocab_size=256, dim=128, n_layers=2, n_heads=4, n_kv_heads=2, hidden_dim=256,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+        max_seq_len=max(BUCKETS) + NEW_TOKENS + ADMIT_CHUNK,
+    )
+    module = Llama(config)
+    params = module.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    cfg = GenerationConfig(
+        max_new_tokens=NEW_TOKENS, temperature=0.0, prompt_buckets=BUCKETS,
+    )
+    prompt = list(np.random.default_rng(3).integers(1, config.vocab_size, size=PROMPT_LEN))
+
+    start = time.perf_counter()
+    batcher = ContinuousBatcher(
+        Generator(module, params, cfg), slots=2, decode_chunk=4,
+        block_size=BLOCK, admit_chunk=ADMIT_CHUNK, aot=store_dir,
+    )
+    batcher.warmup()
+    ready = time.perf_counter()
+    stream = batcher.submit(prompt)
+    it = iter(stream)
+    first = int(np.asarray(next(it)).ravel()[0])
+    first_token = time.perf_counter()
+    for _ in it:
+        pass
+    stats = batcher.stats()["aot"]
+    batcher.close()
+    print(json.dumps({
+        "ready_s": ready - start,
+        "ttft_s": first_token - ready,
+        "total_s": first_token - start,
+        "first_token": first,
+        "programs_loaded": stats["programs_loaded"],
+        "programs_compiled": stats["programs_compiled"],
+    }))
+
+
+def _run_leg(store_dir: str) -> dict:
+    env = os.environ.copy()
+    # the persistent XLA cache would quietly warm the "cold" leg (run_all
+    # exports it suite-wide); the AOT store must be the only warm path here
+    env["UNIONML_TPU_COMPILE_CACHE"] = "0"
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child", store_dir],
+        capture_output=True, text=True, timeout=900, env=env,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(f"cold-start child failed:\n{proc.stderr[-2000:]}")
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.startswith("{")]
+    return json.loads(lines[-1])
+
+
+def main() -> None:
+    best = None
+    attempts = []
+    for attempt in range(ATTEMPTS):
+        with tempfile.TemporaryDirectory(prefix="aot_store_") as store:
+            cold = _run_leg(store)   # empty store: compiles + populates
+            warm = _run_leg(store)   # populated store: loads
+        assert cold["programs_compiled"] > 0 and cold["programs_loaded"] == 0
+        if warm["programs_compiled"] or not warm["programs_loaded"]:
+            log(f"[{attempt + 1}/{ATTEMPTS}] warm leg missed the store "
+                f"({warm['programs_compiled']} compiles); discarding attempt")
+            continue
+        # the pinned exactness contract, re-checked where the headline is made
+        assert warm["first_token"] == cold["first_token"], "AOT-loaded first token diverged"
+        ratio = cold["total_s"] / warm["total_s"] if warm["total_s"] else 0.0
+        result = {
+            "ratio": ratio,
+            "cold_s": cold["total_s"],
+            "warm_s": warm["total_s"],
+            "cold_ready_s": cold["ready_s"],
+            "warm_ready_s": warm["ready_s"],
+            "programs": cold["programs_compiled"],
+        }
+        attempts.append(result)
+        log(
+            f"[{attempt + 1}/{ATTEMPTS}] cold {cold['total_s']:.2f}s vs warm "
+            f"{warm['total_s']:.2f}s -> {ratio:.1f}x ({cold['programs_compiled']} programs; "
+            f"first token {warm['first_token']} == cold)"
+        )
+        if best is None or result["ratio"] > best["ratio"]:
+            best = result
+    if best is None:
+        raise SystemExit("every attempt's warm leg missed the store")
+
+    emit(
+        "cold_start_ttft_reduction",
+        round(best["ratio"], 2),
+        "ratio",
+        best["ratio"],  # vs_baseline: the empty-store cold start IS the baseline
+        cold_total_s=round(best["cold_s"], 3),
+        warm_total_s=round(best["warm_s"], 3),
+        cold_ready_s=round(best["cold_ready_s"], 3),
+        warm_ready_s=round(best["warm_ready_s"], 3),
+        programs=best["programs"],
+        median_ratio=round(statistics.median(a["ratio"] for a in attempts), 2),
+        attempts=len(attempts),
+        prompt_buckets=list(BUCKETS),
+        admit_chunk=ADMIT_CHUNK,
+        block_size=BLOCK,
+        platform="cpu",
+    )
+
+
+if __name__ == "__main__":
+    if len(sys.argv) >= 3 and sys.argv[1] == "--child":
+        _child(sys.argv[2])
+    else:
+        main()

@@ -39,6 +39,7 @@ SCRIPTS = {
     "speculative": "bench_speculative.py",
     "continuous": "bench_continuous.py",
     "continuous_stall": "bench_continuous.py",
+    "cold_start": "bench_cold_start.py",
     "prefix_cache": "bench_prefix_cache.py",
     "disagg_serving": "bench_disagg_serving.py",
     "multitenant_qos": "bench_multitenant.py",
@@ -80,11 +81,14 @@ if _cpu_extra - set(SCRIPTS):
 #: same dispatch-bound synthetic regime as replica_serving (fleet topology,
 #: not chip speed); multitenant_qos pins the well-behaved-tenant TBT-p99
 #: isolation ratio QoS-on vs QoS-off under a hostile 10x burst — a
-#: same-substrate scheduling property, by construction
+#: same-substrate scheduling property, by construction; cold_start pins the
+#: empty-vs-populated AOT-store ready-to-first-token ratio across two fresh
+#: interpreters — compile work avoided, same-substrate by construction (its
+#: children pin the persistent XLA cache OFF so the store is the only warm path)
 CPU_ONLY = {
     "digits", "serving", "replica_serving", "continuous_stall", "prefix_cache",
     "quantized_serving", "observability", "fleet_health", "lint", "disagg_serving",
-    "multitenant_qos",
+    "multitenant_qos", "cold_start",
 } | _cpu_extra
 
 #: per-lane env overrides: lanes that reuse a script in a different mode

@@ -49,6 +49,7 @@ MODULES = [
     "unionml_tpu.ops.attention",
     "unionml_tpu.ops.ring_attention",
     "unionml_tpu.ops.quant",
+    "unionml_tpu.serving.aot",
     "unionml_tpu.serving.app",
     "unionml_tpu.serving.batcher",
     "unionml_tpu.serving.compile",

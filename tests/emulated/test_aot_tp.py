@@ -1,0 +1,100 @@
+"""AOT preload on the emulated 8-device mesh.
+
+Oracle: a tp=2 engine warmed from a populated store must serve tokens
+bit-identical to a freshly-compiled tp=2 engine (which itself matches the
+single-device sequential ``Generator`` run) with ZERO fresh XLA traces; a
+``scale_to`` scale-up landing on a submesh the store has seen joins the fleet
+without tracing or compiling anything — the elastic-resize path the ISSUE's
+acceptance criterion pins.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from unionml_tpu.models import GenerationConfig, Generator, Llama, LlamaConfig, llama_partition_rules
+from unionml_tpu.parallel import MeshSpec
+from unionml_tpu.serving import ContinuousBatcher, ReplicaSet
+
+pytestmark = pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 emulated devices")
+
+PROMPT = [3, 1, 4, 1, 5, 9, 2]
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    config = LlamaConfig.tiny(
+        vocab_size=96, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, hidden_dim=128,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    module = Llama(config)
+    params = module.init(jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32))["params"]
+    return module, params
+
+
+def _cfg():
+    return GenerationConfig(max_new_tokens=8, temperature=0.0, prompt_buckets=(16,))
+
+
+def _drain(stream):
+    return [int(t) for chunk in stream for t in np.asarray(chunk).ravel()]
+
+
+def _tp2_engine(module, params, tmp):
+    mesh = MeshSpec(model=2).build(devices=jax.devices()[:2])
+    gen = Generator(module, params, _cfg(), mesh=mesh, partition_rules=llama_partition_rules())
+    return gen, ContinuousBatcher(gen, slots=2, decode_chunk=4, aot=str(tmp))
+
+
+def test_tp2_preload_then_serve_token_identical(tmp_path, tiny):
+    module, params = tiny
+    expected = list(Generator(module, params, _cfg())([PROMPT])[0])
+
+    gen1, b1 = _tp2_engine(module, params, tmp_path)
+    try:
+        b1.warmup()
+        assert _drain(b1.submit(PROMPT)) == expected
+        assert b1.stats()["aot"]["programs_compiled"] > 0
+    finally:
+        b1.close()
+
+    # fresh tp=2 engine over the populated store: loads everything, traces nothing
+    gen2, b2 = _tp2_engine(module, params, tmp_path)
+    try:
+        b2.warmup()
+        aot = b2.stats()["aot"]
+        assert aot["programs_compiled"] == 0 and aot["programs_loaded"] > 0
+        assert (gen2.prefill_traces, gen2.decode_traces) == (0, 0)
+        assert _drain(b2.submit(PROMPT)) == expected  # AOT == JIT, sharded too
+        assert (gen2.prefill_traces, gen2.decode_traces) == (0, 0)
+    finally:
+        b2.close()
+
+
+def test_scale_up_preloads_on_reused_submesh(tmp_path, tiny):
+    """dp=2 x tp=2 fleet: scale down returns the tail submesh to the spare
+    pool; scaling back up re-places onto it and must warm purely from the
+    store — zero new XLA traces on the joining replica."""
+    module, params = tiny
+    expected = list(Generator(module, params, _cfg())([PROMPT])[0])
+    mesh = MeshSpec(data=2, model=2).build(devices=jax.devices()[:4])
+    rs = ReplicaSet.build(
+        module, params, _cfg(), mesh=mesh, partition_rules=llama_partition_rules(),
+        replicas=2, slots=2, decode_chunk=4, aot=str(tmp_path),
+    )
+    try:
+        rs.warmup()  # replica 1 compiles + persists its submesh's programs here
+        assert rs.scale_to(1) == 1
+        assert rs.scale_to(2) == 2
+        joined = rs.batchers[1]
+        assert (joined.gen.prefill_traces, joined.gen.decode_traces) == (0, 0)
+        aot = joined.stats()["aot"]
+        assert aot["programs_compiled"] == 0 and aot["programs_loaded"] > 0
+        # the rejoined replica serves bit-identically, still without a trace
+        assert _drain(joined.submit(PROMPT)) == expected
+        assert (joined.gen.prefill_traces, joined.gen.decode_traces) == (0, 0)
+        assert rs.stats()["aot"]["programs_loaded"] > 0
+    finally:
+        rs.close()

@@ -61,6 +61,33 @@ def test_warmup_precompiles_all_buckets():
     assert cp.traces == 2  # request-path call hits the warm cache
 
 
+def test_single_warmup_call_precompiles_every_bucket():
+    """The off-bucket cold-compile fix: ONE warmup call (no batch_size) warms
+    every configured bucket, so a request landing in a different bucket than
+    the warmed one never pays a lazy compile."""
+    cfg = ServingConfig(bucket_sizes=[2, 4, 8], feature_shape=(3,))
+    cp = CompiledPredictor(_linear_predict, cfg)
+    params = _linear_params()
+    assert cp.warmup(params)
+    assert cp.traces == 3  # every bucket, not just one
+    for n in (1, 3, 7):  # each lands in a different bucket
+        cp(params, np.ones((n, 3), np.float32))
+    assert cp.traces == 3  # nothing compiled lazily on the request path
+
+
+def test_warmup_with_batch_size_still_covers_off_buckets():
+    """A legacy per-bucket warmup call now sweeps the whole set too — the
+    regression this PR fixes was exactly a warmed server compiling on the
+    first off-bucket request."""
+    cfg = ServingConfig(bucket_sizes=[2, 8], feature_shape=(3,))
+    cp = CompiledPredictor(_linear_predict, cfg)
+    params = _linear_params()
+    assert cp.warmup(params, 2)
+    assert cp.traces == 2
+    cp(params, np.ones((5, 3), np.float32))  # the 8-bucket: already warm
+    assert cp.traces == 2
+
+
 def test_warmup_without_feature_shape_is_skipped():
     cp = CompiledPredictor(_linear_predict, ServingConfig(bucket_sizes=[4]))
     assert cp.warmup(_linear_params(), 4) is False

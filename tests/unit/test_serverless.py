@@ -82,6 +82,35 @@ def test_lambda_handler_health_and_404(trained_model):
     assert missing["statusCode"] == 404
 
 
+def test_lambda_handler_warm_reuse_across_invocations(trained_model):
+    """Scale-to-zero contract: one container = one startup. The first
+    invocation pays the (store-accelerated) cold start; the second reuses the
+    warmed engine — ``startups`` must stay at 1 across invocations."""
+    handler = lambda_handler(trained_model.serve())
+    assert handler.stats == {"invocations": 0, "startups": 0, "cold_start_s": None}
+    first = handler(_api_gateway_v1_event({"features": FEATURES}), None)
+    assert first["statusCode"] == 200
+    assert handler.stats["startups"] == 1
+    assert handler.stats["cold_start_s"] is not None
+    cold = handler.stats["cold_start_s"]
+    second = handler(_api_gateway_v1_event({"features": FEATURES}), None)
+    assert second["statusCode"] == 200
+    assert handler.stats["invocations"] == 2
+    assert handler.stats["startups"] == 1  # warm reuse: startup ran exactly once
+    assert handler.stats["cold_start_s"] == cold
+
+
+def test_lambda_handler_preload_moves_startup_to_init(trained_model):
+    """``preload=True`` runs the startup at handler CREATION (the serverless
+    init phase) so even the first invocation sees a warm engine."""
+    handler = lambda_handler(trained_model.serve(), preload=True)
+    assert handler.stats["startups"] == 1  # before any invocation
+    assert handler.stats["invocations"] == 0
+    response = handler(_api_gateway_v1_event({"features": FEATURES}), None)
+    assert response["statusCode"] == 200
+    assert handler.stats["startups"] == 1
+
+
 def test_lambda_handler_base64_body(trained_model):
     import base64
 

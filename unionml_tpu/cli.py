@@ -340,6 +340,22 @@ def fetch_model(
     "blocks and prefill only the suffix; off (the default) keeps today's behavior exactly",
 )
 @click.option(
+    "--compile-cache", "compile_cache", default=None, metavar="DIR",
+    help="persistent XLA compilation cache directory (exported as "
+    "UNIONML_TPU_COMPILE_CACHE before the app module imports; '1' = the default "
+    "location, '0' = off): re-runs of the same program load from disk instead of "
+    "recompiling",
+)
+@click.option(
+    "--aot-preload", "aot_preload", is_flag=False, flag_value="1", default=None,
+    metavar="[DIR]",
+    help="AOT program store for generation serving (bare flag = the default "
+    "~/.cache/unionml_tpu/aot): warmup loads serialized executables instead of "
+    "compiling — cold-start-to-first-token becomes load-bound — and every compile "
+    "actually paid is serialized back for the next cold process; same early-export "
+    "contract as --dp-replicas (UNIONML_TPU_AOT_PRELOAD)",
+)
+@click.option(
     "--quantize", default=None, type=click.Choice(["int8", "none"]),
     help="weight-only quantization for the app's serving Generators: int8 stores matmul "
     "kernels as int8 with per-channel scales (dequant fuses in-jit, so int8 is what "
@@ -428,6 +444,8 @@ def serve(
     prefill_budget: Optional[int],
     max_admissions: Optional[int],
     prefix_cache: Optional[bool],
+    compile_cache: Optional[str],
+    aot_preload: Optional[str],
     quantize: Optional[str],
     kv_cache_dtype: Optional[str],
     trace: Optional[bool],
@@ -497,6 +515,18 @@ def serve(
     precision over an inherited export. Composes with ``--prefix-cache``
     (cached int8 blocks replay bit-identically) and ``--dp-replicas`` (each
     replica quantizes its own placement).
+
+    Cold start (docs/serving.md "Cold start and AOT preload"):
+    ``--compile-cache DIR`` points JAX's persistent compilation cache at a
+    directory so identical programs skip XLA recompilation across processes,
+    and ``--aot-preload [DIR]`` arms the AOT program store — serving warmup
+    then *loads* serialized generator executables (prefill per bucket,
+    decode, admission scatter/gather) instead of compiling them, making
+    cold-start-to-first-token load-bound; compiles actually paid are
+    serialized back for the next cold process, ``scale_to`` scale-ups onto a
+    previously-used submesh join without a fresh XLA trace, and the
+    serverless handler restores its programs on the first invocation. Both
+    exported before the app module imports, like ``--dp-replicas``.
 
     Observability (docs/observability.md): ``--trace`` records per-request
     timelines into the flight recorder (``GET /debug/requests``,
@@ -571,6 +601,24 @@ def serve(
         from unionml_tpu.defaults import SERVE_PREFIX_CACHE_ENV_VAR
 
         os.environ[SERVE_PREFIX_CACHE_ENV_VAR] = "1" if prefix_cache else "0"
+    if compile_cache is not None or aot_preload is not None:
+        # same early-export contract as --dp-replicas: engines (and the
+        # package-import compile-cache hook in reload/fork children) must see
+        # the knobs before the app module imports. --compile-cache also takes
+        # effect NOW — this process's import hook already ran with the old env
+        from unionml_tpu import defaults as _defaults
+
+        if compile_cache is not None:
+            os.environ[_defaults.SERVE_COMPILE_CACHE_ENV_VAR] = compile_cache
+            if compile_cache.strip().lower() not in ("", "0", "false", "no", "off"):
+                from unionml_tpu.compile_cache import enable_compile_cache
+
+                try:
+                    enable_compile_cache(compile_cache)
+                except Exception as exc:
+                    raise click.ClickException(f"--compile-cache {compile_cache}: {exc}")
+        if aot_preload is not None:
+            os.environ[_defaults.SERVE_AOT_PRELOAD_ENV_VAR] = aot_preload
     if quantize is not None or kv_cache_dtype is not None:
         # same early-export contract: Generators built at app-module import
         # time resolve these at construction ("none" exports too — it must
@@ -682,6 +730,8 @@ def serve(
         dp_replicas, replica_roles=replica_roles, prefill_threshold=prefill_threshold
     ).configure_quantization(
         quantize=quantize, kv_cache_dtype=kv_cache_dtype
+    ).configure_cold_start(
+        compile_cache=compile_cache, aot_preload=aot_preload
     ).configure_observability(
         trace=trace,
         flight_recorder_size=flight_recorder_size,

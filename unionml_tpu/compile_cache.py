@@ -18,6 +18,15 @@ Enabled two ways:
 
 Backends whose executables cannot be serialized simply skip the cache with a
 JAX-internal warning — enabling it is never incorrect, only sometimes useless.
+
+This cache removes the *XLA-compile* cost of a re-run but still re-traces and
+re-lowers every program through the compiler machinery. The serving stack's
+AOT program store (:mod:`unionml_tpu.serving.aot`, ``serve --aot-preload``)
+sits one layer above it: whole serialized executables keyed per program, so a
+cold server/replica/serverless container skips tracing, lowering, AND
+compilation — see docs/serving.md "Cold start and AOT preload". The two
+compose; ``serve --compile-cache`` re-exports this module's env var for
+reload/fork children.
 """
 
 from __future__ import annotations

@@ -131,6 +131,25 @@ SERVE_MIN_REPLICAS_ENV_VAR = "UNIONML_TPU_MIN_REPLICAS"
 #: fleet-size ceiling; 0 = bounded by the spare submeshes/devices available.
 SERVE_MAX_REPLICAS_ENV_VAR = "UNIONML_TPU_MAX_REPLICAS"
 
+# -------------------------------------------------------- cold start / AOT preload
+# Compile-cache + AOT-program-store knobs (compile_cache.py, serving/aot.py,
+# docs/serving.md "Cold start and AOT preload"). Same early-export contract as
+# SERVE_DP_REPLICAS_ENV_VAR: the serve CLI sets these before the app module
+# imports, so engines built at import time preload too.
+
+#: persistent XLA compilation cache directory (a path, "1" for the default
+#: location, or an off-flag) — honored at package import by compile_cache.py;
+#: `serve --compile-cache DIR` re-exports it for reload/fork children.
+SERVE_COMPILE_CACHE_ENV_VAR = "UNIONML_TPU_COMPILE_CACHE"
+
+#: AOT program store for serving executables: a directory path, a truthy flag
+#: ("1"/"true"/"yes"/"on") for the default location, or an off-flag
+#: (""/"0"/"false"/"no"/"off"/unset). With the store on, engine/Generator
+#: warmup loads serialized executables instead of compiling (load-before-
+#: compile), and every compile it does pay is serialized back for the next
+#: cold process. An unusable directory warns and degrades to plain jit.
+SERVE_AOT_PRELOAD_ENV_VAR = "UNIONML_TPU_AOT_PRELOAD"
+
 # ------------------------------------------------------------ quantized serving
 # Serve-time quantization knobs (docs/serving.md "Quantized serving"). Decode is
 # HBM-bandwidth bound and the KV cache dominates resident memory at scale:
@@ -287,6 +306,44 @@ def env_choice(name: str, choices: "tuple[str, ...]", what: str) -> "str | None"
         f"{choices + ('none',)} — falling back to full precision"
     )
     return None
+
+
+#: env values that mean "on, default location" / "off" for path-or-flag knobs
+_TRUTHY_FLAGS = ("1", "true", "yes", "on")
+_FALSY_FLAGS = ("", "0", "false", "no", "off")
+
+
+def _env_path_flag(name: str, default_dir: str) -> "str | None":
+    """Parse a path-or-flag env var: off-flags (and unset) mean None, truthy
+    flags mean ``default_dir``, anything else is the path itself. Whether the
+    path is *usable* is the consumer's concern — ProgramStore/compile_cache
+    warn and degrade on an unwritable directory (the serve-export contract:
+    a garbage value must never crash serve at app-import time)."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return None
+    value = raw.strip()
+    if value.lower() in _FALSY_FLAGS:
+        return None
+    if value.lower() in _TRUTHY_FLAGS:
+        return default_dir
+    return value
+
+
+def serve_compile_cache() -> "str | None":
+    """The persistent XLA compilation cache directory
+    (``UNIONML_TPU_COMPILE_CACHE``); None = off. The package-import hook in
+    compile_cache.py is the normal consumer — this reader exists for code
+    that wants the resolved path (the cold-start bench, diagnostics)."""
+    return _env_path_flag(SERVE_COMPILE_CACHE_ENV_VAR, "~/.cache/unionml_tpu/xla")
+
+
+def serve_aot_preload() -> "str | None":
+    """The AOT program store directory (``UNIONML_TPU_AOT_PRELOAD``); None =
+    off. Read at engine/Generator construction, after the CLI's early export
+    — same contract as :func:`serve_admit_chunk`. An unusable directory warns
+    and degrades at ProgramStore construction, never at read time."""
+    return _env_path_flag(SERVE_AOT_PRELOAD_ENV_VAR, "~/.cache/unionml_tpu/aot")
 
 
 def serve_quantize() -> "str | None":

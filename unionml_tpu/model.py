@@ -282,9 +282,11 @@ class Model:
             return self._compiled_predictor(model_object, features)
         return self._predictor(model_object, features)
 
-    def _predictor_warmup(self, batch_size: int) -> None:
-        """AOT-compile the predictor for one bucket — called per configured bucket by
-        :meth:`unionml_tpu.serving.app.ServingApp.startup` after the artifact loads."""
+    def _predictor_warmup(self, batch_size: "int | None" = None) -> None:
+        """AOT-compile the predictor for every configured bucket — called once
+        by :meth:`unionml_tpu.serving.app.ServingApp.startup` after the
+        artifact loads (``CompiledPredictor.warmup`` sweeps the whole bucket
+        set itself; ``batch_size`` is accepted for older per-bucket callers)."""
         if self._compiled_predictor is None or self.artifact is None:
             return
         self._compiled_predictor.warmup(self.artifact.model_object, batch_size)

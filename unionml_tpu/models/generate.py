@@ -521,6 +521,79 @@ class Generator:
         self._beam_fns: dict = {}
         self._sp_prefill_fn = None
         self._spec_engine = None  # lazily built when config.draft is set
+        #: AOT program store (serving/aot.py): set by :meth:`enable_aot`, after
+        #: which the jitted programs above resolve load-before-compile
+        self._aot_store = None
+
+    # ------------------------------------------------------------------ AOT preload
+
+    def _aot_context(self) -> dict:
+        """The key parts that pin a serialized executable to THIS generator's
+        programs: module architecture, generation config (kv dtype, buckets,
+        sampling law — all compiled into the programs), quantization mode,
+        mesh topology, and — because grammar tables are traced in as
+        constants — a digest of the constraint set's tables."""
+        import hashlib as _hashlib
+
+        from unionml_tpu.serving.aot import mesh_context
+
+        ctx = {
+            "module": type(self.module).__name__,
+            "module_config": repr(getattr(self.module, "config", None)),
+            "generation_config": repr(self.config),
+            "quantize": self.quantize,
+            **mesh_context(self.mesh),
+        }
+        if self._cs is not None:
+            digest = _hashlib.sha256()
+            digest.update(np.asarray(self._cs_trans).tobytes())
+            digest.update(np.asarray(self._cs_allowed).tobytes())
+            ctx["constraints"] = digest.hexdigest()
+        return ctx
+
+    def enable_aot(self, store: Any) -> "Generator":
+        """Route this generator's jitted programs (``_prefill`` per bucket,
+        ``_prefill_chunk``, ``_first_token``, ``_decode``, and the lazily
+        built sequence-parallel prefill) through an AOT
+        :class:`~unionml_tpu.serving.aot.ProgramStore`: every distinct call
+        signature resolves load-before-compile, and every compile that does
+        happen is serialized back so the next cold process loads it. Tokens
+        are bit-identical either way — a loaded executable IS the program a
+        fresh compile would produce. Idempotent; ``None`` is a no-op."""
+        if store is None or self._aot_store is not None:
+            return self
+        from unionml_tpu.serving.aot import AOTFunction
+
+        ctx = self._aot_context()
+        self._aot_store = store
+        self._prefill = AOTFunction(self._prefill, "prefill", store, ctx)
+        self._prefill_chunk = AOTFunction(self._prefill_chunk, "prefill_chunk", store, ctx)
+        self._first_token = AOTFunction(self._first_token, "first_token", store, ctx)
+        self._decode = AOTFunction(
+            self._decode, "decode", store, ctx, static_argnames=("steps",)
+        )
+        return self
+
+    def warmup(self) -> "Generator":
+        """Resolve the batch-1 prefill program for every configured prompt
+        bucket plus one decode scan — through the AOT store when
+        :meth:`enable_aot` armed one (load-before-compile; a populated store
+        makes this load-bound), as a plain compile otherwise. The serving
+        engines have their own richer warmup; this is the standalone
+        ``Generator`` analog the serverless batch path and notebooks use."""
+        cfg = self.config
+        vocab = int(getattr(self.module.config, "vocab_size", 2))
+        tok = 1 % max(vocab, 1)
+        decoded = False
+        for bucket in sorted(set(cfg.prompt_buckets)):
+            _, _, _, carry = self._start([[tok] * bucket], 0)
+            if not decoded and cfg.max_new_tokens >= 2:
+                # one scan covers every bucket: the cache width is shared
+                # (cache_len keys off the WIDEST bucket), so decode is one
+                # program regardless of which bucket prefilled the carry
+                self._decode(self.params, *carry, steps=cfg.max_new_tokens - 1)
+                decoded = True
+        return self
 
     def _speculative(self):
         """The internal speculative engine for ``config.draft`` — reuses THIS
@@ -621,7 +694,12 @@ class Generator:
             tok0 = sample_tokens(logits, key, cfg)
             return tok0, tuple(new_cache), last.astype(jnp.float32)
 
-        return jax.jit(sp_prefill, donate_argnums=(3,))
+        jitted = jax.jit(sp_prefill, donate_argnums=(3,))
+        if self._aot_store is not None:
+            from unionml_tpu.serving.aot import AOTFunction
+
+            return AOTFunction(jitted, "sp_prefill", self._aot_store, self._aot_context())
+        return jitted
 
     def _bucket(self, max_prompt: int) -> int:
         for b in sorted(self.config.prompt_buckets):

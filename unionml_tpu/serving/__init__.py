@@ -8,6 +8,7 @@ also gives us what FastAPI never could: a dynamic micro-batching queue between t
 socket and the TPU so concurrent single-row requests ride one MXU dispatch.
 """
 
+from unionml_tpu.serving.aot import AOTFunction, ProgramStore  # noqa: F401
 from unionml_tpu.serving.app import ServingApp, serving_app  # noqa: F401
 from unionml_tpu.serving.batcher import MicroBatcher, ServingConfig  # noqa: F401
 from unionml_tpu.serving.compile import CompiledPredictor  # noqa: F401

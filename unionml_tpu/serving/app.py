@@ -281,6 +281,37 @@ class ServingApp:
             os.environ[SERVE_PREFILL_THRESHOLD_ENV_VAR] = str(prefill_threshold)
         return self
 
+    def configure_cold_start(
+        self,
+        compile_cache: Optional[str] = None,
+        aot_preload: Optional[str] = None,
+    ) -> "ServingApp":
+        """Record the serve-time ``--compile-cache``/``--aot-preload``
+        overrides (docs/serving.md "Cold start and AOT preload") and export
+        them — the :meth:`configure_replicas` env contract, so generation
+        engines built after startup (warmup hooks, first-request
+        construction) preload their programs. ``None`` leaves a knob alone;
+        an empty string (or ``"0"``) turns it off. ``compile_cache`` also
+        (re-)points JAX's persistent compilation cache immediately — the
+        package-import hook already ran by the time this executes."""
+        from unionml_tpu.defaults import (
+            SERVE_AOT_PRELOAD_ENV_VAR,
+            SERVE_COMPILE_CACHE_ENV_VAR,
+        )
+
+        if compile_cache is not None:
+            os.environ[SERVE_COMPILE_CACHE_ENV_VAR] = str(compile_cache)
+            if str(compile_cache).strip().lower() not in ("", "0", "false", "no", "off"):
+                from unionml_tpu.compile_cache import enable_compile_cache
+
+                try:
+                    enable_compile_cache(str(compile_cache))
+                except Exception as exc:  # an unwritable dir degrades, never crashes
+                    logger.warning(f"could not enable the XLA compilation cache: {exc}")
+        if aot_preload is not None:
+            os.environ[SERVE_AOT_PRELOAD_ENV_VAR] = str(aot_preload)
+        return self
+
     def configure_quantization(
         self,
         quantize: Optional[str] = None,
@@ -394,11 +425,13 @@ class ServingApp:
         if isinstance(config, ServingConfig) and config.warmup:
             warmup_fn = getattr(self.model, "_predictor_warmup", None)
             if warmup_fn is not None:
-                for bucket in config.buckets():
-                    try:
-                        warmup_fn(bucket)
-                    except Exception as exc:  # warmup is best-effort
-                        logger.warning(f"predictor warmup failed for bucket {bucket}: {exc}")
+                # one call: CompiledPredictor.warmup sweeps EVERY configured
+                # bucket itself (per-bucket calls here would re-sweep the
+                # whole set len(buckets) times)
+                try:
+                    warmup_fn()
+                except Exception as exc:  # warmup is best-effort
+                    logger.warning(f"predictor warmup failed: {exc}")
         # generation apps register a callable (e.g. building + warming their
         # ContinuousBatcher) to run once at startup, after the artifact loads —
         # first streams then skip the cold compiles

@@ -255,15 +255,25 @@ class CompiledPredictor:
 
     # ------------------------------------------------------------------ warmup
 
-    def warmup(self, model_object: Any, batch_size: int) -> bool:
-        """AOT-compile the bucket that ``batch_size`` maps to. Needs
-        ``config.feature_shape`` (per-row shape) to synthesize a template batch;
-        returns False when no template is configured (lazy compile on first
-        request still keeps the shape set bounded)."""
+    def warmup(self, model_object: Any, batch_size: "Optional[int]" = None) -> bool:
+        """AOT-compile EVERY configured bucket (each is its own XLA shape).
+        Earlier rounds warmed only the bucket ``batch_size`` mapped to, so a
+        "warmed" server still compiled lazily on the first request that landed
+        in a different bucket — the off-bucket cold-compile this now closes.
+        ``batch_size`` is kept for caller compatibility but no longer narrows
+        the set (its bucket is one of the configured ones by construction).
+        Needs ``config.feature_shape`` (per-row shape) to synthesize template
+        batches; returns False when no template is configured (lazy compile on
+        first request still keeps the shape set bounded). The first bucket
+        that proves the predictor unjittable stops the sweep — the eager
+        fallback serves every shape anyway."""
         shape = getattr(self.config, "feature_shape", None)
         if shape is None or self._eager:
             return False
         dtype = getattr(self.config, "feature_dtype", "float32")
-        template = np.zeros((batch_size, *tuple(shape)), dtype=dtype)
-        self(model_object, template)
+        for bucket in self._buckets():
+            if self._eager:
+                break
+            template = np.zeros((bucket, *tuple(shape)), dtype=dtype)
+            self(model_object, template)
         return not self._eager

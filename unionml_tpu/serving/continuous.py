@@ -74,6 +74,7 @@ from unionml_tpu.defaults import (
 from unionml_tpu.observability.trace import current_trace
 from unionml_tpu.observability.slo import SLOConfig, SLOTracker
 from unionml_tpu.observability.timeseries import EngineTimeseries
+from unionml_tpu.serving.aot import AOTFunction, resolve_store
 from unionml_tpu.serving.metrics import LatencyWindow
 from unionml_tpu.serving.overload import (
     DeadlineExceeded,
@@ -420,6 +421,7 @@ class ContinuousBatcher:
         slo: Optional[Any] = None,
         role: Optional[str] = None,
         tenancy: Optional[Any] = None,
+        aot: Optional[Any] = None,
     ):
         if slots < 1:
             raise ValueError("slots must be >= 1")
@@ -452,6 +454,23 @@ class ContinuousBatcher:
         self.trace_requests = True if trace is None else bool(trace)
         cfg = generator.config
         self.gen = generator
+        #: AOT program store (serving/aot.py, docs/serving.md "Cold start and
+        #: AOT preload"). Resolution mirrors admit_chunk: a ProgramStore or
+        #: directory kwarg pins it, None reads the serve CLI's
+        #: UNIONML_TPU_AOT_PRELOAD export, False disables. With a store armed,
+        #: the generator's prefill/decode programs AND this engine's
+        #: admit/gather helpers resolve load-before-compile — warmup() on a
+        #: populated store deserializes executables in milliseconds instead of
+        #: compiling, and every compile it does pay is serialized back for the
+        #: next cold process. Off (the default) keeps the engine byte-for-byte
+        #: the plain-jit one, stats() included.
+        self._aot = resolve_store(aot)
+        if self._aot is not None:
+            generator.enable_aot(self._aot)
+            # the generator may already carry a store from an earlier engine
+            # (or an explicit enable_aot): surface THAT one so telemetry and
+            # key context stay consistent with the programs actually wrapped
+            self._aot = generator._aot_store
         #: stall-free admission (chunked prefill interleaved with decode).
         #: Resolution mirrors the --dp-replicas pattern: constructor kwarg,
         #: then the serve CLI's env export, then the model's own
@@ -479,6 +498,10 @@ class ContinuousBatcher:
         #: and budgets), so concurrent streams share draft+verify dispatches
         #: and each greedy stream still equals its solo target-only run
         self._spec = generator._speculative() if cfg.draft is not None else None
+        if self._spec is not None and self._aot is not None:
+            # the draft model's prefill/decode programs preload from the same
+            # store (its own context: draft architecture, same mesh)
+            self._spec._draft.enable_aot(self._aot)
         #: disaggregated-serving role (informational except for the guards
         #: below; None = a role-less engine whose stats() stay byte-for-byte
         #: the historical ones). The replica scheduler routes long-prompt
@@ -664,6 +687,11 @@ class ContinuousBatcher:
                 self._radix.pin(self._shared_prefix_blocks)
             #: one compile: the dense-row gather at the engine's fixed width
             self._gather_fn = jax.jit(gather_paged_rows, static_argnums=(2,))
+            if self._aot is not None:
+                self._gather_fn = AOTFunction(
+                    self._gather_fn, "gather_paged_rows", self._aot,
+                    self.gen._aot_context(), static_argnums=(2,),
+                )
         self._lock = threading.Condition()
         self._pending: "List[tuple]" = []  # (prompt, session) awaiting a free slot
         self._admissions: "List[_Admission]" = []  # slot-holding, prefill in flight
@@ -687,6 +715,18 @@ class ContinuousBatcher:
         self._paged_spec_admit_fn = jax.jit(
             self._paged_spec_admit_impl, donate_argnums=(0, 1, 2)
         )
+        if self._aot is not None:
+            # the admission scatter helpers preload too — on a cold TPU the
+            # paged scatter over a big pool is its own multi-second compile
+            ectx = self.gen._aot_context()
+            self._admit_fn = AOTFunction(self._admit_fn, "admit", self._aot, ectx)
+            self._spec_admit_fn = AOTFunction(self._spec_admit_fn, "spec_admit", self._aot, ectx)
+            self._paged_admit_fn = AOTFunction(
+                self._paged_admit_fn, "paged_admit", self._aot, ectx
+            )
+            self._paged_spec_admit_fn = AOTFunction(
+                self._paged_spec_admit_fn, "paged_spec_admit", self._aot, ectx
+            )
         #: dispatch/utilization counters for benchmarks and /metrics
         self.decode_dispatches = 0
         self.decoded_rows = 0
@@ -1323,14 +1363,20 @@ class ContinuousBatcher:
                 self._mask_slot_done(session.slot)
 
     def warmup(self) -> None:
-        """AOT-compile the admission/prefill/decode programs before traffic
+        """Resolve the admission/prefill/decode programs before traffic
         arrives, so the first real request never pays a cold XLA compile (tens
         of seconds on TPU — the same rationale as CompiledPredictor's startup
         warmup). A bucket-FILLING request runs through each prompt bucket
         (budget 1: admission only — each bucket is its own prefill shape), then
         a short request exercises one decode/round chunk (the decode program is
-        bucket-independent). Counters are reset afterwards so ``/metrics``
-        reflects real traffic only."""
+        bucket-independent). With an AOT store armed (``aot=`` /
+        ``UNIONML_TPU_AOT_PRELOAD``) every program resolves
+        **load-before-compile**: a populated store makes this whole pass
+        deserialize-bound (milliseconds per program) and an empty one compiles
+        once and serializes the result for the next cold process. Counters are
+        reset afterwards so ``/metrics`` reflects real traffic only (the AOT
+        load/compile telemetry deliberately survives the reset — preload work
+        IS the warmup story ``stats()["aot"]`` exists to tell)."""
         cfg = self.gen.config
         for bucket in sorted(cfg.prompt_buckets):
             # length == bucket: _bucket() maps shorter prompts to the smallest
@@ -1611,6 +1657,12 @@ class ContinuousBatcher:
         # window reports {"window": 0}, never a None gauge
         snapshot["ttft_ms"] = self._ttft.snapshot()
         snapshot["tbt_ms"] = self._tbt.snapshot()
+        if self._aot is not None:
+            # AOT preload telemetry (internally synchronized; absent entirely
+            # with the store off — the byte-for-byte contract): programs
+            # loaded vs compiled vs serialized plus the load/compile latency
+            # windows the cold_start bench lane pins
+            snapshot["aot"] = self._aot.stats()
         if self.role is not None:
             # export→resident transfer latency (decode-role replicas observe
             # it at import finalize); {"window": 0} until a handoff lands

@@ -393,6 +393,7 @@ class ReplicaSet:
         prefill_threshold: Optional[int] = None,
         autoscale: Optional[Any] = None,
         tenancy: Optional[Any] = None,
+        aot: Optional[Any] = None,
     ):
         if (generators is None) == (engines is None):
             raise ValueError("pass exactly one of generators= or engines=")
@@ -407,6 +408,7 @@ class ReplicaSet:
             pool_blocks=pool_blocks, max_waiting=max_waiting, admit_chunk=admit_chunk,
             prefill_budget=prefill_budget, max_admissions=max_admissions,
             trace=trace, prefix_cache=prefix_cache, slo=slo, tenancy=tenancy,
+            aot=aot,
         )
         self._prefix_tokens_saved = prefix_tokens
         if engines is not None:
@@ -978,9 +980,12 @@ class ReplicaSet:
         ) from last_exc
 
     def warmup(self) -> None:
-        """AOT-compile every replica's admission/prefill/decode programs,
+        """Resolve every replica's admission/prefill/decode programs,
         concurrently — replicas own disjoint engines (and usually disjoint
-        devices), so their compile walls overlap instead of stacking."""
+        devices), so their compile walls overlap instead of stacking. With
+        the AOT store armed (``aot=`` / ``UNIONML_TPU_AOT_PRELOAD``) each
+        replica preloads serialized executables keyed to its own submesh —
+        a restarted server with the same fleet layout warms load-bound."""
         from concurrent.futures import ThreadPoolExecutor
 
         batchers = self.batchers
@@ -1022,7 +1027,12 @@ class ReplicaSet:
         count. Scale-UP places the construction template's params onto a
         spare submesh (or, mesh-less, the next device round-robin), warms the
         new engine up, and only then joins it to the scheduler — the first
-        routed request never pays a cold compile. ``role`` tags the added
+        routed request never pays a cold compile. With the AOT store armed
+        the warmup itself preloads serialized executables keyed to the new
+        replica's submesh: a submesh the store has seen (an earlier scale-up,
+        a previous process with the same fleet layout) joins without a single
+        fresh XLA trace, so autoscaler oscillation costs milliseconds, not
+        compile walls. ``role`` tags the added
         replicas (default: ``decode`` in a role-split fleet, ``mixed``
         otherwise). Scale-DOWN drains the TAIL replica with PR 1's machinery:
         it is unrouted and quiesced first (new submits bounce to siblings),
@@ -1387,6 +1397,25 @@ class ReplicaSet:
                     }
                 }
                 if any("prefix_cache" in entry for entry in per_replica)
+                else {}
+            ),
+            # fleet-wide AOT preload totals (present only when some replica
+            # runs a program store — store-off fleets keep today's stats
+            # byte-for-byte; per-replica load/compile latency windows stay
+            # under per_replica, since percentiles don't sum)
+            **(
+                {
+                    "aot": {
+                        key: sum(
+                            int((entry.get("aot") or {}).get(key) or 0)
+                            for entry in per_replica
+                        )
+                        for key in ("programs_loaded", "programs_compiled",
+                                    "programs_serialized", "load_failures",
+                                    "serialize_failures")
+                    }
+                }
+                if any("aot" in entry for entry in per_replica)
                 else {}
             ),
             # fleet-wide multi-tenant QoS totals (present only when some

@@ -53,16 +53,51 @@ def _event_request(event: Dict[str, Any]) -> tuple:
     return method, path, raw, headers
 
 
-def lambda_handler(serving: ServingApp) -> Callable[[Dict[str, Any], Any], Dict[str, Any]]:
+def lambda_handler(
+    serving: ServingApp, *, preload: bool = False
+) -> Callable[[Dict[str, Any], Any], Dict[str, Any]]:
     """Wrap a :class:`ServingApp` as an API-Gateway Lambda handler (the Mangum analog).
 
     Usage in an app module::
 
         model.serve()               # returns the ServingApp
         handler = lambda_handler(model.serve())
+
+    **Scale-to-zero** (docs/serving.md "Cold start and AOT preload"): the
+    handler closure retains ``serving`` for the lifetime of the execution
+    environment, so the engine built and warmed on the first invocation is
+    REUSED by every later one — ``startup()`` is idempotent and runs exactly
+    once per container, never per request. With the AOT program store armed
+    (``UNIONML_TPU_AOT_PRELOAD`` pointed at a baked layer or mounted volume),
+    that one startup *loads* the generator's serialized executables instead of
+    compiling them, so a scaled-from-zero container answers its first token
+    load-bound, not compile-bound. ``preload=True`` moves the startup to
+    handler CREATION time — the serverless platform's init phase, which most
+    providers bill (and time-box) separately from request handling — so even
+    the first invocation sees a warm engine.
+
+    ``handler.stats`` reports ``invocations``, ``startups`` (1 after the first
+    use, by contract), and ``cold_start_s`` (wall time of the one real
+    startup) for the cold-start telemetry the bench lane and tests pin.
     """
+    stats: Dict[str, Any] = {"invocations": 0, "startups": 0, "cold_start_s": None}
+
+    def _startup_once() -> None:
+        if getattr(serving, "_started", False):
+            return
+        import time
+
+        begin = time.perf_counter()
+        serving.startup()
+        stats["startups"] += 1
+        stats["cold_start_s"] = round(time.perf_counter() - begin, 3)
+        logger.info(f"serverless cold start: engine ready in {stats['cold_start_s']} s")
+
+    if preload:
+        _startup_once()
 
     def handler(event: Dict[str, Any], context: Any = None) -> Dict[str, Any]:
+        stats["invocations"] += 1
         method, path, body, headers = _event_request(event)
 
         async def run() -> Any:
@@ -70,7 +105,7 @@ def lambda_handler(serving: ServingApp) -> Callable[[Dict[str, Any], Any], Dict[
             # shed responses) must survive the event bridge — API Gateway
             # forwards response headers, so callers correlate exactly like
             # socket clients (docs/observability.md)
-            serving.startup()
+            _startup_once()
             return await serving.server.dispatch_with_headers(method, path, body, headers)
 
         status, payload, content_type, extra = asyncio.run(run())
@@ -82,6 +117,7 @@ def lambda_handler(serving: ServingApp) -> Callable[[Dict[str, Any], Any], Dict[
             "isBase64Encoded": False,
         }
 
+    handler.stats = stats
     return handler
 
 
