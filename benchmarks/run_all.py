@@ -45,6 +45,7 @@ SCRIPTS = {
     "multitenant_qos": "bench_multitenant.py",
     "quantized_serving": "bench_quantized_serving.py",
     "replica_serving": "bench_replica_serving.py",
+    "multihost_serving": "bench_multihost.py",
     "observability": "bench_observability.py",
     "fleet_health": "bench_fleet_health.py",
     "lint": "bench_lint.py",
@@ -84,11 +85,15 @@ if _cpu_extra - set(SCRIPTS):
 #: same-substrate scheduling property, by construction; cold_start pins the
 #: empty-vs-populated AOT-store ready-to-first-token ratio across two fresh
 #: interpreters — compile work avoided, same-substrate by construction (its
-#: children pin the persistent XLA cache OFF so the store is the only warm path)
+#: children pin the persistent XLA cache OFF so the store is the only warm path);
+#: multihost_serving pins the emulated 2-process fleet's aggregate tok/s
+#: PARITY against the single-process 2-replica fleet (>= 0.9x gate) plus the
+#: cross-host handoff transfer_ms — the control-plane boundary's cost, a
+#: same-substrate topology property by construction
 CPU_ONLY = {
     "digits", "serving", "replica_serving", "continuous_stall", "prefix_cache",
     "quantized_serving", "observability", "fleet_health", "lint", "disagg_serving",
-    "multitenant_qos", "cold_start",
+    "multitenant_qos", "cold_start", "multihost_serving",
 } | _cpu_extra
 
 #: per-lane env overrides: lanes that reuse a script in a different mode

@@ -52,6 +52,7 @@ MODULES = [
     "unionml_tpu.serving.aot",
     "unionml_tpu.serving.app",
     "unionml_tpu.serving.batcher",
+    "unionml_tpu.serving.cluster",
     "unionml_tpu.serving.compile",
     "unionml_tpu.serving.continuous",
     "unionml_tpu.serving.http",
@@ -72,6 +73,7 @@ MODULES = [
     "unionml_tpu.analysis.engine",
     "unionml_tpu.analysis.project",
     "unionml_tpu.artifact",
+    "unionml_tpu.distributed",
     "unionml_tpu.remote",
     "unionml_tpu.launcher",
     "unionml_tpu.gke",

@@ -1553,6 +1553,108 @@ def test_project_rule_findings_respect_suppressions(tmp_path):
     assert [finding.rule for finding in result.suppressed] == ["TPU001"]
 
 
+# --------------------------------------------------------------------- TPU013
+
+
+def test_tpu013_flags_collective_under_lock(tmp_path):
+    # the three spellings: a with-block collective, a *_locked method body
+    # (caller holds the lock), and a control-plane RPC on a host handle
+    result = lint_source(
+        tmp_path,
+        """
+        import threading
+
+        from jax.experimental import multihost_utils
+
+
+        class Coordinator:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.hosts = []
+
+            def rebalance(self):
+                with self._lock:
+                    multihost_utils.sync_global_devices("rebalance")
+
+            def _sync_locked(self):
+                broadcast_one_to_all(None)
+
+            def route(self, i):
+                with self._lock:
+                    self.hosts[i].probe([1, 2])
+        """,
+    )
+    assert rule_ids(result) == ["TPU013", "TPU013", "TPU013"]
+    assert "multihost_utils.sync_global_devices" in result.findings[0].message
+    assert "self._lock" in result.findings[0].message
+    assert "broadcast_one_to_all" in result.findings[1].message
+    assert "probe" in result.findings[2].message
+
+
+def test_tpu013_flags_jax_distributed_and_repo_helpers(tmp_path):
+    result = lint_source(
+        tmp_path,
+        """
+        import threading
+
+        import jax
+        from unionml_tpu import distributed
+
+
+        class Fleet:
+            def __init__(self):
+                self._state_lock = threading.Condition()
+
+            def join(self):
+                with self._state_lock:
+                    jax.distributed.initialize()
+
+            def agree_config(self, cfg):
+                with self._state_lock:
+                    return distributed.agree(cfg)
+        """,
+    )
+    assert rule_ids(result) == ["TPU013", "TPU013"]
+    assert "jax.distributed.initialize" in result.findings[0].message
+    assert "distributed.agree" in result.findings[1].message
+
+
+def test_tpu013_near_miss_outside_lock_and_lockless_class(tmp_path):
+    # the fix idiom (snapshot under the lock, rendezvous outside), collectives
+    # in a class with no lock, ordinary calls under the lock, and __init__ are
+    # all clean
+    result = lint_source(
+        tmp_path,
+        """
+        import threading
+
+        from jax.experimental import multihost_utils
+
+
+        class Coordinator:
+            def __init__(self):
+                self._lock = threading.Lock()
+                multihost_utils.sync_global_devices("construction")  # pre-sharing
+
+            def rebalance(self):
+                with self._lock:
+                    plan = self._plan()
+                multihost_utils.sync_global_devices("rebalance")
+                return plan
+
+            def _plan(self):
+                with self._lock:
+                    return len("plan")
+
+
+        class LockFree:
+            def sync(self):
+                multihost_utils.sync_global_devices("fine")
+        """,
+    )
+    assert result.findings == []
+
+
 # ------------------------------------------------- index cache + incremental
 
 
@@ -1666,7 +1768,7 @@ def test_sarif_reporter_round_trip(tmp_path):
     run = payload["runs"][0]
     assert run["tool"]["driver"]["name"] == "tpu-lint"
     rule_index = {rule["id"] for rule in run["tool"]["driver"]["rules"]}
-    assert {"TPU001", "TPU005", "TPU010", "TPU011", "TPU012"} <= rule_index
+    assert {"TPU001", "TPU005", "TPU010", "TPU011", "TPU012", "TPU013"} <= rule_index
     active = [r for r in run["results"] if "suppressions" not in r]
     suppressed = [r for r in run["results"] if "suppressions" in r]
     assert len(active) == 1 and len(suppressed) == 1

@@ -87,6 +87,44 @@ def test_serve_requires_existing_model_path(cli_project):
     assert "does not exist" in result.output
 
 
+def test_serve_cluster_flags_validate_and_export_early(cli_project, monkeypatch):
+    """The --num-hosts/--coordinator/--process-id trio: usage errors fail NOW
+    (before any app import), and valid flags export the distributed env vars
+    under the --dp-replicas early-export contract."""
+    import os
+
+    runner = CliRunner()
+    result = runner.invoke(app, ["serve", "cli_app:model", "--num-hosts", "0"])
+    assert result.exit_code != 0 and "--num-hosts" in result.output
+    result = runner.invoke(app, ["serve", "cli_app:model", "--num-hosts", "2"])
+    assert result.exit_code != 0 and "--coordinator" in result.output
+    result = runner.invoke(
+        app,
+        ["serve", "cli_app:model", "--num-hosts", "2", "--coordinator", "h:1", "--process-id", "2"],
+    )
+    assert result.exit_code != 0 and "--process-id" in result.output
+    # a VALID trio exports before the app module imports; the app itself then
+    # fails later (no artifact), which is how we observe the export without
+    # actually forming a 2-process runtime in a unit test
+    for name in ("UNIONML_TPU_COORDINATOR", "UNIONML_TPU_NUM_PROCESSES", "UNIONML_TPU_PROCESS_ID"):
+        monkeypatch.delenv(name, raising=False)
+    result = runner.invoke(
+        app,
+        ["serve", "cli_app:model", "--num-hosts", "2", "--coordinator", "127.0.0.1:9",
+         "--process-id", "1", "--workers", "2"],
+    )
+    assert result.exit_code != 0
+    assert "--workers does not compose" in result.output
+    assert os.environ.get("UNIONML_TPU_COORDINATOR") == "127.0.0.1:9"
+    assert os.environ.get("UNIONML_TPU_NUM_PROCESSES") == "2"
+    assert os.environ.get("UNIONML_TPU_PROCESS_ID") == "1"
+    for name in ("UNIONML_TPU_COORDINATOR", "UNIONML_TPU_NUM_PROCESSES", "UNIONML_TPU_PROCESS_ID"):
+        # plain pop, NOT monkeypatch.delenv: the CLI set these AFTER the
+        # earlier delenv, so monkeypatch would faithfully RESTORE them at
+        # teardown and leak a fake 2-process fleet env into later tests
+        os.environ.pop(name, None)
+
+
 def test_app_source_files_snapshot(cli_project):
     from unionml_tpu.cli import _app_source_files
 

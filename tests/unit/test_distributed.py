@@ -1,0 +1,84 @@
+"""The shared jax.distributed bootstrap (unionml_tpu/distributed.py): env
+readers follow the defaults.py warn-and-degrade contract, the single-process
+degenerate forms of every collective are exact no-ops, and job_runner
+consumes the extracted bootstrap (one code path for train AND serve)."""
+
+import pytest
+
+from unionml_tpu import distributed
+from unionml_tpu.defaults import (
+    distributed_coordinator,
+    distributed_num_processes,
+    distributed_process_id,
+    fleet_dir,
+    fleet_host_roles,
+)
+
+
+def test_env_readers_defaults(monkeypatch):
+    for name in (
+        "UNIONML_TPU_COORDINATOR", "UNIONML_TPU_NUM_PROCESSES", "UNIONML_TPU_PROCESS_ID",
+        "UNIONML_TPU_FLEET_DIR", "UNIONML_TPU_HOST_ROLES",
+    ):
+        monkeypatch.delenv(name, raising=False)
+    assert distributed_coordinator() is None
+    assert distributed_num_processes() == 1
+    assert distributed_process_id() == 0
+    assert fleet_dir() == ".unionml_fleet"
+    assert fleet_host_roles() == {}
+
+
+def test_env_readers_parse_and_degrade(monkeypatch, caplog):
+    from unionml_tpu._logging import logger
+
+    monkeypatch.setattr(logger, "propagate", True)
+    monkeypatch.setenv("UNIONML_TPU_COORDINATOR", " 10.0.0.1:1234 ")
+    monkeypatch.setenv("UNIONML_TPU_NUM_PROCESSES", "4")
+    monkeypatch.setenv("UNIONML_TPU_PROCESS_ID", "3")
+    monkeypatch.setenv("UNIONML_TPU_HOST_ROLES", "prefill=1,decode=3")
+    assert distributed_coordinator() == "10.0.0.1:1234"
+    assert distributed_num_processes() == 4
+    assert distributed_process_id() == 3
+    assert fleet_host_roles() == {"prefill": 1, "decode": 3}
+    # garbage warns and degrades — a typo'd fleet env must never crash the
+    # bootstrap (the env_int/env_choice contract, satellite-pinned)
+    monkeypatch.setenv("UNIONML_TPU_NUM_PROCESSES", "many")
+    monkeypatch.setenv("UNIONML_TPU_PROCESS_ID", "-2")
+    monkeypatch.setenv("UNIONML_TPU_HOST_ROLES", "turbo=9")
+    with caplog.at_level("WARNING", logger="unionml_tpu"):
+        assert distributed_num_processes() == 1
+        assert distributed_process_id() == 0
+        assert fleet_host_roles() == {}
+    assert any("many" in record.message for record in caplog.records)
+    assert any("turbo=9" in record.message for record in caplog.records)
+
+
+def test_single_process_collectives_are_no_ops(monkeypatch):
+    for name in (
+        "UNIONML_TPU_COORDINATOR", "UNIONML_TPU_NUM_PROCESSES", "UNIONML_TPU_PROCESS_ID",
+    ):
+        monkeypatch.delenv(name, raising=False)
+    assert distributed.maybe_initialize() is False
+    assert distributed.is_initialized() is False
+    assert distributed.process_index() == 0
+    assert distributed.process_count() == 1
+    distributed.barrier("noop")  # must not touch jax at all
+    config = {"builder": "app:build", "kwargs": {"slots": 2}}
+    assert distributed.agree(config) == config
+    assert distributed.allgather_ints(8123) == [8123]
+
+
+def test_process_identity_tracks_env_before_init(monkeypatch):
+    monkeypatch.setenv("UNIONML_TPU_NUM_PROCESSES", "2")
+    monkeypatch.setenv("UNIONML_TPU_PROCESS_ID", "1")
+    assert distributed.process_index() == 1
+    assert distributed.process_count() == 2
+
+
+def test_job_runner_delegates_to_shared_bootstrap(monkeypatch):
+    from unionml_tpu import job_runner
+
+    calls = []
+    monkeypatch.setattr(distributed, "maybe_initialize", lambda: calls.append(1) or True)
+    job_runner._maybe_init_distributed()
+    assert calls == [1]

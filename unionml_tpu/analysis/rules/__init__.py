@@ -21,6 +21,7 @@ from unionml_tpu.analysis.rules.tpu009_registry import UnboundedPerKeyRegistry
 from unionml_tpu.analysis.rules.tpu010_lock_order import LockOrderCycle
 from unionml_tpu.analysis.rules.tpu011_recompile import RecompileHazard
 from unionml_tpu.analysis.rules.tpu012_contextvar import ContextvarExecutorHole
+from unionml_tpu.analysis.rules.tpu013_locked_collectives import BlockingCollectiveUnderLock
 
 __all__ = ["RULES"]
 
@@ -39,5 +40,6 @@ RULES = {
         LockOrderCycle,
         RecompileHazard,
         ContextvarExecutorHole,
+        BlockingCollectiveUnderLock,
     )
 }

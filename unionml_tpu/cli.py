@@ -253,6 +253,25 @@ def fetch_model(
 @click.option("--app-version", default=None, help="app version for --remote model loading")
 @click.option("--model-version", default="latest", show_default=True, help="model version for --remote loading")
 @click.option("--workers", default=1, show_default=True, type=int, help="server processes sharing the port (SO_REUSEPORT)")
+@click.option(
+    "--num-hosts", default=None, type=int,
+    help="multi-host fleet serving (docs/serving.md 'Multi-host fleets'): total "
+    "processes in the fleet. Host 0 serves the public HTTP front door and "
+    "coordinates; hosts > 0 run their engines behind a loopback control server. "
+    "Exported as UNIONML_TPU_NUM_PROCESSES before the app module imports",
+)
+@click.option(
+    "--coordinator", default=None, metavar="HOST:PORT",
+    help="jax.distributed coordinator address every fleet process rendezvouses "
+    "at (required with --num-hosts > 1); exported as UNIONML_TPU_COORDINATOR "
+    "before the app module imports — the same bootstrap job_runner uses for "
+    "multi-host training",
+)
+@click.option(
+    "--process-id", default=None, type=int,
+    help="this process's id in [0, --num-hosts); exported as "
+    "UNIONML_TPU_PROCESS_ID before the app module imports",
+)
 @click.option("--reload", "reload_", is_flag=True, default=False, help="restart the server when app source changes (development)")
 @click.option(
     "--log-level",
@@ -426,6 +445,9 @@ def serve(
     app_version: Optional[str],
     model_version: str,
     workers: int,
+    num_hosts: Optional[int],
+    coordinator: Optional[str],
+    process_id: Optional[int],
     reload_: bool,
     log_level: Optional[str],
     max_inflight: Optional[int],
@@ -547,6 +569,18 @@ def serve(
     routes new work around a breaching replica. Same early-export contract as
     the other knobs (``UNIONML_TPU_SLO_*``).
 
+    Multi-host fleets (docs/serving.md "Multi-host fleets"):
+    ``--num-hosts N --coordinator HOST:PORT --process-id I`` runs this serve
+    process as one member of an N-host fleet. Every process joins one
+    jax.distributed runtime (the same bootstrap ``job_runner`` uses for
+    multi-host training), process 0 serves the public HTTP front door with a
+    FleetCoordinator routing over every host's engines — fleet-global
+    prefix-cache routing, cross-host prefill→decode handoff of block-native
+    KV pages, per-host sections on ``/metrics``/``/healthz``/``/debug/fleet``
+    — and processes > 0 run their engines behind a loopback control server.
+    Same early-export contract as ``--dp-replicas``
+    (``UNIONML_TPU_COORDINATOR``/``NUM_PROCESSES``/``PROCESS_ID``).
+
     Multi-tenant QoS (docs/serving.md "Multi-tenant QoS"):
     ``--tenant-config tenants.json`` / ``--default-tenant-rate R`` arm the
     tenancy subsystem — tenant identity from ``X-Tenant-Id`` or the
@@ -558,6 +592,30 @@ def serve(
     ``/v1/chat/completions`` routes are always served; the tenancy knobs
     make them multi-tenant. Same early-export contract as ``--dp-replicas``.
     """
+    if num_hosts is not None or coordinator is not None or process_id is not None:
+        # multi-host fleet bootstrap knobs: validate NOW (a typo'd explicit
+        # flag is a usage error), then export before the app module imports so
+        # engines built at import time see the multi-process runtime — the
+        # --dp-replicas contract, shared with job_runner's training bootstrap
+        from unionml_tpu import defaults as _defaults
+
+        resolved_hosts = num_hosts if num_hosts is not None else 1
+        if resolved_hosts < 1:
+            raise click.ClickException("--num-hosts must be >= 1")
+        if resolved_hosts > 1 and coordinator is None:
+            raise click.ClickException(
+                "--num-hosts > 1 needs --coordinator HOST:PORT (the jax.distributed rendezvous)"
+            )
+        if process_id is not None and not (0 <= process_id < resolved_hosts):
+            raise click.ClickException(
+                f"--process-id must be in [0, {resolved_hosts}); got {process_id}"
+            )
+        if num_hosts is not None:
+            os.environ[_defaults.DISTRIBUTED_NUM_PROCESSES_ENV_VAR] = str(num_hosts)
+        if coordinator is not None:
+            os.environ[_defaults.DISTRIBUTED_COORDINATOR_ENV_VAR] = coordinator
+        if process_id is not None:
+            os.environ[_defaults.DISTRIBUTED_PROCESS_ID_ENV_VAR] = str(process_id)
     if dp_replicas is not None:
         if dp_replicas < 0:
             raise click.ClickException("--dp-replicas must be >= 0 (0 = derive from the mesh)")
@@ -741,6 +799,19 @@ def serve(
         tenant_config=str(tenant_config) if tenant_config is not None else None,
         default_tenant_rate=default_tenant_rate,
     )
+
+    from unionml_tpu.defaults import distributed_num_processes
+
+    if distributed_num_processes() > 1:
+        # multi-host fleet: host 0 serves the public front door over a
+        # FleetCoordinator; hosts > 0 run only the control server. --workers
+        # forking doesn't compose with a per-process jax runtime.
+        if workers > 1:
+            raise click.ClickException("--workers does not compose with --num-hosts; scale via hosts")
+        from unionml_tpu.serving.cluster import enable_serve_cluster
+
+        enable_serve_cluster(serving, host=host, port=port)
+        return
 
     if workers > 1:
         import signal

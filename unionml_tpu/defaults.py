@@ -187,6 +187,86 @@ SERVE_TENANT_CONFIG_ENV_VAR = "UNIONML_TPU_TENANT_CONFIG"
 #: (anonymous traffic is never bucket-limited); 0/unset = unlimited.
 SERVE_DEFAULT_TENANT_RATE_ENV_VAR = "UNIONML_TPU_DEFAULT_TENANT_RATE"
 
+# ----------------------------------------------------------- multi-process fleets
+# jax.distributed bootstrap knobs (unionml_tpu/distributed.py) shared by TRAIN
+# (job_runner joining a slice) and SERVE (serving/cluster.py's worker
+# processes). Same early-export contract as SERVE_DP_REPLICAS_ENV_VAR: the
+# serve CLI exports them before the app module imports, and the launcher sets
+# them on every worker it spawns.
+
+#: coordinator address (``host:port``) for ``jax.distributed.initialize``;
+#: unset = single-process (the bootstrap is a no-op).
+DISTRIBUTED_COORDINATOR_ENV_VAR = "UNIONML_TPU_COORDINATOR"
+
+#: total processes in the slice/fleet (1 = single process).
+DISTRIBUTED_NUM_PROCESSES_ENV_VAR = "UNIONML_TPU_NUM_PROCESSES"
+
+#: this process's id in ``[0, num_processes)``.
+DISTRIBUTED_PROCESS_ID_ENV_VAR = "UNIONML_TPU_PROCESS_ID"
+
+#: rendezvous directory for the serving fleet's control plane
+#: (serving/cluster.py): each worker announces its loopback control-server
+#: address as a ``host-<id>.json`` file there, and the coordinator connects by
+#: polling it. Unset = ``.unionml_fleet`` under the working directory.
+FLEET_DIR_ENV_VAR = "UNIONML_TPU_FLEET_DIR"
+
+#: per-host role spec for the fleet coordinator (``prefill=1,decode=1`` at
+#: HOST granularity — the cross-host analog of SERVE_REPLICA_ROLES_ENV_VAR);
+#: unset/empty = every host mixed. Garbage warns and degrades to symmetric.
+FLEET_HOST_ROLES_ENV_VAR = "UNIONML_TPU_HOST_ROLES"
+
+
+def distributed_coordinator() -> "str | None":
+    """The ``jax.distributed`` coordinator address (``host:port``); None =
+    single-process. Read at bootstrap time (job_runner start, serve start),
+    after the CLI/launcher export — the :func:`serve_dp_replicas` contract."""
+    raw = os.environ.get(DISTRIBUTED_COORDINATOR_ENV_VAR)
+    if raw is None or not raw.strip():
+        return None
+    return raw.strip()
+
+
+def distributed_num_processes() -> int:
+    """Total processes in the slice/fleet; garbage warns and degrades to 1
+    (single-process) instead of crashing the bootstrap — the env_int
+    contract."""
+    return env_int(DISTRIBUTED_NUM_PROCESSES_ENV_VAR, 1, minimum=1)
+
+
+def distributed_process_id() -> int:
+    """This process's id in ``[0, num_processes)``; garbage warns and degrades
+    to 0 — a mis-set worker then fails loudly at ``jax.distributed``
+    rendezvous (duplicate id) rather than silently joining wrong."""
+    return env_int(DISTRIBUTED_PROCESS_ID_ENV_VAR, 0, minimum=0)
+
+
+def fleet_dir() -> str:
+    """The serving fleet's control-plane rendezvous directory
+    (``UNIONML_TPU_FLEET_DIR``); defaults to ``.unionml_fleet`` under the
+    working directory so an emulated local fleet needs zero configuration."""
+    raw = os.environ.get(FLEET_DIR_ENV_VAR)
+    if raw is None or not raw.strip():
+        return ".unionml_fleet"
+    return raw.strip()
+
+
+def fleet_host_roles() -> "dict[str, int]":
+    """The per-HOST role census for the fleet coordinator, parsed with the
+    same grammar (and warn-and-degrade contract) as :func:`serve_replica_roles`;
+    ``{}`` = every host mixed."""
+    raw = os.environ.get(FLEET_HOST_ROLES_ENV_VAR)
+    if raw is None or not raw.strip():
+        return {}
+    try:
+        return parse_replica_roles(raw)
+    except ValueError as exc:
+        logger.warning(
+            f"ignoring {FLEET_HOST_ROLES_ENV_VAR}={raw!r} ({exc}); "
+            "falling back to a symmetric (all-mixed) host fleet"
+        )
+        return {}
+
+
 # --------------------------------------------------------------- observability
 # Request-tracing / flight-recorder / profiler knobs (unionml_tpu/observability,
 # docs/observability.md). Same export pattern as the admission knobs above: the

@@ -84,28 +84,12 @@ def _maybe_inject_fault(exec_path: Path) -> None:
 
 
 def _maybe_init_distributed() -> None:
-    coordinator = os.environ.get("UNIONML_TPU_COORDINATOR")
-    if not coordinator:
-        return
-    import jax
+    # one bootstrap shared by train and serve (unionml_tpu/distributed.py);
+    # the "joined jax.distributed runtime" log line the watchdog tests assert
+    # on is emitted there
+    from unionml_tpu.distributed import maybe_initialize
 
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        # emulated multi-host lane: a TPU plugin on the path would win over the env
-        # var, so pin the platform before the backend initializes
-        jax.config.update("jax_platforms", "cpu")
-    num_processes = env_int("UNIONML_TPU_NUM_PROCESSES", 1, minimum=1)
-    process_id = env_int("UNIONML_TPU_PROCESS_ID", 0, minimum=0)
-    jax.distributed.initialize(
-        coordinator_address=coordinator,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
-    # the definitive signal that the slice formed: this process sees every
-    # device of every peer (watchdog tests assert on this line)
-    logger.info(
-        f"joined jax.distributed runtime: process {process_id}/{num_processes}, "
-        f"global devices {jax.device_count()} ({jax.local_device_count()} local)"
-    )
+    maybe_initialize()
 
 
 def run_job(execution_dir: str) -> None:

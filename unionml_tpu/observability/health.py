@@ -156,6 +156,14 @@ def fleet_debug(batcher: Optional[Any]) -> Dict[str, Any]:
         out["replicas"] = 0
         return out
     out["replicas"] = len(_engines(batcher))
+    census_hosts = getattr(batcher, "host_census", None)
+    if callable(census_hosts):
+        # multi-host fleets (serving/cluster.py): the host table — who is
+        # where, alive, what role, how many replicas — plus the coordinator's
+        # failure/handoff counters, in the same debug fetch
+        out["hosts"] = census_hosts()
+        out["host_failures"] = int(getattr(batcher, "host_failures", 0))
+        out["handoffs_cross_host"] = int(getattr(batcher, "cross_host_handoffs", 0))
     loads_fn = getattr(batcher, "replica_loads", None)
     if callable(loads_fn):
         out["replica_loads"] = loads_fn()

@@ -93,12 +93,89 @@ class MeshSpec:
             device_array = np.asarray(devices).reshape(shape)
         return Mesh(device_array, AXIS_ORDER)
 
+    def build_hybrid(
+        self,
+        devices: Optional[Sequence[jax.Device]] = None,
+        dcn_axes: Optional[Sequence[str]] = None,
+    ) -> Mesh:
+        """Materialize a HYBRID ICI/DCN mesh over a multi-process runtime
+        (the T5X ``create_hybrid_device_mesh`` shape): the ``dcn_axes``
+        (slow, cross-host axes — the data/replica axes by convention) span
+        processes over DCN while every other axis stays within one host's
+        ICI-connected devices. ``dcn_axes=None`` picks outermost batch axes
+        greedily until their product covers the process count — for a
+        serving fleet that is ``dcn_data`` (or ``data``), exactly the
+        per-replica split :func:`unionml_tpu.serving.replicas.slice_mesh`
+        cuts along, so each host's replicas are host-local by construction.
+        Falls back to a process-grouped reshape when ``mesh_utils`` cannot
+        build the topology (CPU emulation without locality metadata)."""
+        devices = list(jax.devices()) if devices is None else list(devices)
+        sizes = self.axis_sizes(len(devices))
+        n_processes = len({d.process_index for d in devices})
+        if dcn_axes is None:
+            dcn_axes, extent = [], 1
+            for name in AXIS_ORDER:
+                if extent >= n_processes:
+                    break
+                if sizes[name] > 1:
+                    dcn_axes.append(name)
+                    extent *= sizes[name]
+            if extent != n_processes:
+                raise ValueError(
+                    f"cannot cover {n_processes} processes with leading batch axes "
+                    f"(sizes {sizes}); pass dcn_axes= explicitly"
+                )
+        dcn_axes = tuple(dcn_axes)
+        unknown = [name for name in dcn_axes if name not in AXIS_ORDER]
+        if unknown:
+            raise ValueError(f"unknown dcn axes {unknown}; expected a subset of {AXIS_ORDER}")
+        ici_shape = tuple(1 if name in dcn_axes else sizes[name] for name in AXIS_ORDER)
+        dcn_shape = tuple(sizes[name] if name in dcn_axes else 1 for name in AXIS_ORDER)
+        try:
+            from jax.experimental import mesh_utils
+
+            device_array = mesh_utils.create_hybrid_device_mesh(
+                ici_shape, dcn_shape, devices=devices, process_is_granule=True
+            )
+        except Exception:
+            # emulated/CPU fallback: group by process (the granule), keep
+            # process-id order on the DCN dims so the mesh is deterministic
+            # across every process building it
+            ordered = sorted(devices, key=lambda d: (d.process_index, d.id))
+            device_array = np.asarray(ordered).reshape(dcn_shape + ici_shape)
+            # interleave [dcn..., ici...] -> AXIS_ORDER: dim i of the final
+            # mesh is dcn dim i times ici dim i (one of the two is 1)
+            n = len(AXIS_ORDER)
+            perm = [axis for pair in zip(range(n), range(n, 2 * n)) for axis in pair]
+            device_array = device_array.transpose(perm).reshape(
+                tuple(sizes[name] for name in AXIS_ORDER)
+            )
+        return Mesh(device_array, AXIS_ORDER)
+
     @property
     def num_devices_required(self) -> int:
         sizes = [self.data, self.fsdp, self.model, self.sequence, self.pipe, self.expert, self.dcn_data]
         if any(s == -1 for s in sizes):
             return -1
         return math.prod(sizes)
+
+
+def process_local_submeshes(submeshes: Sequence[Mesh]) -> "list[Tuple[int, Mesh]]":
+    """Filter a :func:`~unionml_tpu.serving.replicas.slice_mesh` result down to
+    the submeshes THIS process can drive: ``(global_index, submesh)`` pairs
+    whose devices are all local. On a hybrid ICI/DCN mesh with the replica
+    axes on DCN every submesh is single-host, so the pairs partition the
+    fleet across processes with stable global indices — the coordinator's
+    host ids."""
+    import jax
+
+    me = jax.process_index()
+    out = []
+    for index, sub in enumerate(submeshes):
+        procs = {d.process_index for d in np.asarray(sub.devices).ravel()}
+        if procs == {me}:
+            out.append((index, sub))
+    return out
 
 
 def single_device_mesh() -> Mesh:

@@ -11,6 +11,13 @@ socket and the TPU so concurrent single-row requests ride one MXU dispatch.
 from unionml_tpu.serving.aot import AOTFunction, ProgramStore  # noqa: F401
 from unionml_tpu.serving.app import ServingApp, serving_app  # noqa: F401
 from unionml_tpu.serving.batcher import MicroBatcher, ServingConfig  # noqa: F401
+from unionml_tpu.serving.cluster import (  # noqa: F401
+    FleetCoordinator,
+    LocalHost,
+    RemoteHost,
+    WorkerAgent,
+    connect_fleet,
+)
 from unionml_tpu.serving.compile import CompiledPredictor  # noqa: F401
 from unionml_tpu.serving.continuous import ContinuousBatcher  # noqa: F401
 from unionml_tpu.serving.prefix_cache import RadixPrefixCache  # noqa: F401

@@ -1,0 +1,1337 @@
+"""Multi-host fleet serving: a host-0 coordinator over per-process worker fleets.
+
+Everything the serving stack shipped through PR 10 — replica slicing, the
+disaggregated prefill/decode handoff, elastic ``scale_to``, SLO-aware and
+prefix-affine routing — lived inside ONE Python process, so a fleet could
+never outgrow a single host's devices (ROADMAP item 2's "last structural
+wall"). This module breaks it:
+
+- **workers** own their local engines: each process builds a
+  :class:`~unionml_tpu.serving.replicas.ReplicaSet` (or a single
+  :class:`~unionml_tpu.serving.continuous.ContinuousBatcher`) over its OWN
+  devices — on a hybrid ICI/DCN mesh
+  (:meth:`~unionml_tpu.parallel.mesh.MeshSpec.build_hybrid`, the T5X
+  partitioning shape: DCN carries the data/replica axes, ICI the model axes)
+  each host keeps exactly the replica submeshes that are local to it
+  (``ReplicaSet.build`` is process-aware) — and expose them through a
+  loopback control server (:class:`WorkerAgent`);
+- **the coordinator** (:class:`FleetCoordinator`) owns routing, admission,
+  and scale decisions: it mirrors the engine surface (``submit`` / ``warmup``
+  / ``stats`` / ``health`` / ``scale_to`` / ``close``) so the serving app,
+  ``/metrics``, ``/healthz`` and ``/debug/fleet`` compose with a multi-host
+  fleet exactly as they do with a :class:`ReplicaSet`;
+- **the control plane** is plain HTTP over loopback/DCN (newline-delimited
+  JSON token streams, binary ``npz`` handoff payloads): out-of-band from the
+  jax runtime, so a worker crash breaks one TCP connection — the coordinator
+  marks the host dead and routes around it — instead of a collective;
+- **jax.distributed** (:mod:`unionml_tpu.distributed`, the bootstrap shared
+  with ``job_runner``) gives workers their process identity, and
+  ``multihost_utils`` carries the cross-host agreements: process 0's fleet
+  config is broadcast so every host provably builds knob-identical engines
+  (:func:`distributed.agree`), and control ports are exchanged with
+  ``process_allgather`` (:func:`distributed.allgather_ints`).
+
+Routing is the :class:`~unionml_tpu.serving.replicas.ReplicaScheduler` at
+HOST granularity: per-submission the coordinator probes every live host for
+its token-weighted load, SLO state, and — the fleet-global radix tier — its
+ACTUAL cached-prefix length for this prompt, so a multi-turn conversation
+lands on the host that already holds its KV. Hosts may carry roles
+(``prefill``/``decode``/``mixed``, the ``UNIONML_TPU_HOST_ROLES`` export):
+a long prompt prefills on a prefill host and its finished KV pages — the
+block-native payload of ``continuous._export_admission`` — cross the wire to
+a decode host, token-identical to a single mixed fleet serving it.
+
+Collectives (``agree``/``barrier``/``allgather_ints``) run only during
+worker bootstrap and NEVER while holding a lock — one stalled host must
+degrade to a dead host, not a fleet-wide deadlock (tpu-lint TPU013, which
+this module is the reason for).
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import json
+import math
+import os
+import threading
+import time
+from http.client import HTTPConnection
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from unionml_tpu._logging import logger
+from unionml_tpu.defaults import (
+    fleet_dir as default_fleet_dir,
+    fleet_host_roles,
+    serve_prefill_threshold,
+)
+from unionml_tpu.serving.metrics import LatencyWindow
+from unionml_tpu.serving.overload import (
+    DeadlineExceeded,
+    QueueFullError,
+    TenantThrottled,
+    expired,
+    remaining_s,
+)
+from unionml_tpu.serving.replicas import ReplicaScheduler
+
+__all__ = [
+    "FleetCoordinator",
+    "LocalHost",
+    "RemoteHost",
+    "WorkerAgent",
+    "connect_fleet",
+    "deserialize_handoff",
+    "run_worker",
+    "serialize_handoff",
+]
+
+#: control-plane RPC timeout for NON-streaming calls (probe/stats/scale);
+#: loopback and intra-fleet DCN both answer in milliseconds, so a second of
+#: silence means the worker is gone, not slow
+CONTROL_TIMEOUT_S = 30.0
+
+#: per-read ceiling on a token stream: long enough for any cold compile a
+#: first token can hide behind, short enough that a genuinely wedged worker
+#: is eventually declared dead instead of pinning the relay forever
+STREAM_READ_TIMEOUT_S = 600.0
+
+#: errors that mean "the worker is unreachable" — the caller marks the host
+#: dead and routes around it (never retries into the same wall)
+_DEAD_ERRORS = (ConnectionError, OSError, TimeoutError)
+
+
+# ---------------------------------------------------------------------- handoff wire
+
+
+def serialize_handoff(payload: Dict[str, Any]) -> bytes:
+    """Encode a handoff payload (``_export_admission``'s dict) for the wire:
+    KV pages/rows as an uncompressed ``npz``, the scalar metadata as JSON
+    riding inside it. The ``trace`` never crosses (request timelines are
+    per-process); the absolute-monotonic ``deadline``/``created_at`` are
+    rebased to RELATIVE seconds so the importing host's clock domain applies
+    them correctly."""
+    meta = {
+        "prompt": [int(t) for t in payload["prompt"]],
+        "first": int(payload["first"]),
+        "lengths": int(payload["lengths"]),
+        "max_new": int(payload["max_new"]),
+        "produced": int(payload["produced"]),
+        "echo": [int(t) for t in payload.get("echo", [])],
+        "grammar": int(payload.get("grammar", 0)),
+        "priority": int(payload.get("priority", 1)),
+        "tenant": payload.get("tenant"),
+        "deadline_remaining_s": remaining_s(payload.get("deadline")),
+        "age_s": time.monotonic() - payload.get("created_at", time.monotonic()),
+        "block_size": payload.get("block_size"),
+    }
+    arrays: Dict[str, np.ndarray] = {}
+    if payload.get("pages") is not None:
+        meta["kind"] = "pages"
+        for i, layer in enumerate(payload["pages"]):
+            for name, buf in layer.items():
+                arrays[f"p{i}.{name}"] = np.asarray(buf)
+        meta["layers"] = len(payload["pages"])
+    else:
+        meta["kind"] = "row"
+        for i, layer in enumerate(payload["row"]):
+            for name, buf in layer.items():
+                arrays[f"p{i}.{name}"] = np.asarray(buf)
+        meta["layers"] = len(payload["row"])
+    arrays["__meta__"] = np.frombuffer(json.dumps(meta).encode(), np.uint8)
+    out = io.BytesIO()
+    np.savez(out, **arrays)
+    return out.getvalue()
+
+
+def deserialize_handoff(data: bytes) -> Dict[str, Any]:
+    """Decode :func:`serialize_handoff`'s bytes back into the payload dict
+    :meth:`ContinuousBatcher.import_handoff` consumes (pages as numpy — the
+    importing engine places them onto its own submesh)."""
+    with np.load(io.BytesIO(data)) as bundle:
+        meta = json.loads(bytes(bundle["__meta__"]).decode())
+        layers = [
+            {
+                key.split(".", 1)[1]: bundle[key]
+                for key in bundle.files
+                if key.startswith(f"p{i}.")
+            }
+            for i in range(meta["layers"])
+        ]
+    remaining = meta.pop("deadline_remaining_s")
+    age = meta.pop("age_s")
+    kind = meta.pop("kind")
+    meta.pop("layers")
+    payload: Dict[str, Any] = dict(meta)
+    payload["pages" if kind == "pages" else "row"] = tuple(layers)
+    payload["deadline"] = None if remaining is None else time.monotonic() + remaining
+    payload["created_at"] = time.monotonic() - max(age, 0.0)
+    payload["trace"] = None
+    return payload
+
+
+# --------------------------------------------------------------------- worker agent
+
+
+class _ControlHandler(BaseHTTPRequestHandler):
+    """Route table of one worker's control server. HTTP/1.0 close-delimited
+    responses keep the streaming path trivial (the coordinator reads lines
+    until EOF); every request is its own connection — loopback/DCN accepts
+    are microseconds against a decode chunk."""
+
+    agent: "WorkerAgent"  # set by WorkerAgent on the subclass
+
+    def log_message(self, fmt: str, *args: Any) -> None:  # route to our logger
+        logger.debug(f"cluster control: {fmt % args}")
+
+    def _json(self, status: int, payload: Dict[str, Any]) -> None:
+        body = json.dumps(payload, default=str).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self) -> bytes:
+        length = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(length) if length else b""
+
+    def do_GET(self) -> None:  # noqa: N802 - http.server contract
+        agent = self.agent
+        try:
+            if self.path == "/ctrl/ping":
+                self._json(200, {"ok": True, "process_id": agent.process_id, "role": agent.role})
+            elif self.path == "/ctrl/stats":
+                self._json(200, {"stats": _jsonable(agent.engine.stats())})
+            elif self.path == "/ctrl/health":
+                self._json(200, _jsonable(agent.engine.health()))
+            else:
+                self._json(404, {"detail": f"no control route for {self.path}"})
+        except Exception as exc:  # pragma: no cover - defensive
+            logger.exception("control GET failed")
+            self._json(500, {"detail": f"{type(exc).__name__}: {exc}"})
+
+    def do_POST(self) -> None:  # noqa: N802 - http.server contract
+        agent = self.agent
+        try:
+            if self.path == "/ctrl/submit":
+                self._submit(json.loads(self._body() or b"{}"))
+            elif self.path == "/ctrl/import":
+                self._import(self._body())
+            elif self.path == "/ctrl/probe":
+                request = json.loads(self._body() or b"{}")
+                self._json(200, agent.probe(request.get("prompt")))
+            elif self.path == "/ctrl/scale":
+                request = json.loads(self._body() or b"{}")
+                count = agent.engine.scale_to(
+                    int(request["replicas"]), role=request.get("role")
+                )
+                self._json(200, {"replicas": count})
+            elif self.path == "/ctrl/warmup":
+                agent.engine.warmup()
+                self._json(200, {"ok": True})
+            elif self.path == "/ctrl/drain":
+                agent.engine.close(wait=True)
+                self._json(200, {"ok": True})
+            elif self.path == "/ctrl/shutdown":
+                self._json(200, {"ok": True})
+                agent.request_shutdown()
+            else:
+                self._json(404, {"detail": f"no control route for {self.path}"})
+        except (QueueFullError, DeadlineExceeded) as exc:
+            self._shed(exc)
+        except Exception as exc:
+            logger.exception("control POST failed")
+            try:
+                self._json(500, {"detail": f"{type(exc).__name__}: {exc}"})
+            except _DEAD_ERRORS:
+                pass
+
+    # ------------------------------------------------------------ streaming routes
+
+    def _shed(self, exc: BaseException) -> None:
+        """Map the engine's shed exceptions onto the wire so the coordinator
+        re-raises the SAME types (429 queue/tenant, 503 deadline) — the
+        fleet-wide overload posture survives the process boundary."""
+        if isinstance(exc, TenantThrottled):
+            self._json(429, {
+                "detail": exc.detail, "kind": "tenant_limit",
+                "retry_after": exc.retry_after_s, "tenant": exc.tenant,
+            })
+        elif isinstance(exc, QueueFullError):
+            self._json(429, {
+                "detail": exc.detail, "kind": "queue_full", "retry_after": exc.retry_after_s,
+            })
+        else:
+            self._json(503, {"detail": str(exc) or "deadline exceeded", "kind": "deadline"})
+
+    def _stream(self, stream: Any, *, export: bool) -> None:
+        """Relay an engine token stream as ndjson lines, flushed per chunk so
+        the coordinator's client sees each token as it is produced. A broken
+        pipe (coordinator/client went away) closes the engine stream so the
+        producer never decodes to a dead connection. An EXPORT stream's
+        handoff payload rides as a final base64 ``npz`` line."""
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.end_headers()
+        try:
+            for chunk in stream:
+                tokens = [int(t) for t in np.asarray(chunk).ravel()]
+                self.wfile.write(json.dumps({"t": tokens}).encode() + b"\n")
+                self.wfile.flush()
+            if export and getattr(stream, "handoff", None) is not None:
+                blob = base64.b64encode(serialize_handoff(stream.handoff)).decode()
+                self.wfile.write(json.dumps({"handoff": blob}).encode() + b"\n")
+            self.wfile.write(b'{"end": true}\n')
+            self.wfile.flush()
+        except _DEAD_ERRORS:
+            _close_quietly(stream)
+        except Exception as exc:
+            _close_quietly(stream)
+            try:
+                self.wfile.write(
+                    json.dumps({"error": f"{type(exc).__name__}: {exc}"}).encode() + b"\n"
+                )
+            except _DEAD_ERRORS:
+                pass
+
+    def _submit(self, request: Dict[str, Any]) -> None:
+        agent = self.agent
+        deadline = request.get("deadline_remaining_s")
+        kwargs: Dict[str, Any] = {
+            "max_new_tokens": request.get("max_new_tokens"),
+            "constraint": request.get("constraint"),
+            "deadline": None if deadline is None else time.monotonic() + float(deadline),
+            "tenant": request.get("tenant"),
+            "priority": request.get("priority"),
+        }
+        export = bool(request.get("export"))
+        if export:
+            kwargs["export_handoff"] = True
+        stream = agent.engine.submit([int(t) for t in request["prompt"]], **kwargs)
+        self._stream(stream, export=export)
+
+    def _import(self, body: bytes) -> None:
+        stream = self.agent.engine.import_handoff(deserialize_handoff(body))
+        self._stream(stream, export=False)
+
+
+def _close_quietly(stream: Any) -> None:
+    closer = getattr(stream, "close", None)
+    if callable(closer):
+        try:
+            closer()
+        except Exception:  # pragma: no cover - defensive
+            pass
+
+
+def _jsonable(obj: Any) -> Any:
+    """Strip a stats/health dict down to JSON-encodable leaves (numpy scalars
+    become Python numbers; anything else stringifies)."""
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if isinstance(obj, (str, int, float, bool)) or obj is None:
+        return obj
+    return str(obj)
+
+
+def _fleet_probe(engine: Any, prompt: Optional[Sequence[int]]) -> Dict[str, Any]:
+    """One host's routing signals in a single fetch: token-weighted load,
+    the radix probe for this prompt (the fleet-global prefix tier), the SLO
+    breach flag, and the live replica count."""
+    cached = 0
+    if prompt is not None:
+        probe = getattr(engine, "cached_prefix_tokens", None)
+        if callable(probe):
+            cached = int(probe([int(t) for t in prompt]))
+    health_fn = getattr(engine, "health", None)
+    breaching = False
+    if callable(health_fn):
+        breaching = health_fn().get("state") == "breach"
+    replicas = getattr(engine, "replicas", 1)
+    return {
+        "load": float(engine.load()),
+        "cached": cached,
+        "breaching": bool(breaching),
+        "replicas": int(replicas) if isinstance(replicas, (int, np.integer)) else 1,
+    }
+
+
+class WorkerAgent:
+    """One worker process's control server around its local engine.
+
+    Binds a loopback (or fleet-network) :class:`ThreadingHTTPServer` on an
+    OS-assigned port, serves the control routes (`/ctrl/submit`,
+    ``/ctrl/import``, ``/ctrl/probe``, ``/ctrl/stats``, ``/ctrl/health``,
+    ``/ctrl/scale``, ``/ctrl/warmup``, ``/ctrl/drain``, ``/ctrl/shutdown``)
+    on daemon threads, and announces itself into the fleet rendezvous
+    directory so the coordinator can connect."""
+
+    def __init__(
+        self,
+        engine: Any,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        process_id: Optional[int] = None,
+        role: str = "mixed",
+    ):
+        from unionml_tpu import distributed
+
+        self.engine = engine
+        self.role = role
+        self.process_id = distributed.process_index() if process_id is None else int(process_id)
+        handler = type("_BoundControlHandler", (_ControlHandler,), {"agent": self})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self._thread: Optional[threading.Thread] = None
+        #: set by /ctrl/shutdown (and close()) — run_worker's exit signal
+        self.shutdown_event = threading.Event()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def probe(self, prompt: Optional[Sequence[int]]) -> Dict[str, Any]:
+        return _fleet_probe(self.engine, prompt)
+
+    def start(self) -> "WorkerAgent":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, kwargs={"poll_interval": 0.05},
+                daemon=True, name=f"unionml-tpu-worker-{self.process_id}",
+            )
+            self._thread.start()
+            logger.info(f"worker {self.process_id} control server on {self.address} (role={self.role})")
+        return self
+
+    def announce(self, fleet_dir: "str | Path") -> Path:
+        """Write this worker's rendezvous file (atomic: the coordinator must
+        never read a half-written announcement)."""
+        root = Path(fleet_dir).expanduser()
+        root.mkdir(parents=True, exist_ok=True)
+        path = root / f"host-{self.process_id}.json"
+        tmp = path.with_suffix(".json.tmp")
+        tmp.write_text(json.dumps({
+            "process_id": self.process_id,
+            "host": self.host,
+            "port": self.port,
+            "pid": os.getpid(),
+            "role": self.role,
+        }))
+        os.replace(tmp, path)
+        return path
+
+    def request_shutdown(self) -> None:
+        self.shutdown_event.set()
+
+    def close(self, *, close_engine: bool = True) -> None:
+        self.shutdown_event.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if close_engine:
+            self.engine.close(wait=True)
+
+
+# ---------------------------------------------------------------------- host handles
+
+
+class LocalHost:
+    """The coordinator's handle on an engine living in ITS OWN process (host 0
+    usually serves too) — direct calls, no HTTP hop."""
+
+    def __init__(self, engine: Any, *, host_id: int = 0, role: str = "mixed"):
+        self.engine = engine
+        self.host_id = int(host_id)
+        self.role = role
+        self.alive = True
+        self.address = "local"
+
+    def probe(self, prompt: Optional[Sequence[int]]) -> Dict[str, Any]:
+        return _fleet_probe(self.engine, prompt)
+
+    def submit(self, prompt: Sequence[int], *, export: bool = False, **kwargs: Any) -> Any:
+        if export:
+            kwargs["export_handoff"] = True
+        return self.engine.submit(prompt, **kwargs)
+
+    def import_handoff(self, payload: Any) -> Any:
+        if isinstance(payload, (bytes, bytearray)):
+            payload = deserialize_handoff(bytes(payload))
+        return self.engine.import_handoff(payload)
+
+    def stats(self) -> Dict[str, Any]:
+        return self.engine.stats()
+
+    def health(self) -> Dict[str, Any]:
+        fn = getattr(self.engine, "health", None)
+        if callable(fn):
+            return fn()
+        return {"score": 1.0, "state": "ok", "state_code": 0, "enabled": False}
+
+    def occupancy(self) -> "Tuple[int, int]":
+        fn = getattr(self.engine, "occupancy", None)
+        if callable(fn):
+            return fn()
+        resident = sum(b.occupancy()[0] for b in getattr(self.engine, "batchers", ()))
+        waiting = sum(b.occupancy()[1] for b in getattr(self.engine, "batchers", ()))
+        return resident, waiting
+
+    def warmup(self) -> None:
+        self.engine.warmup()
+
+    def scale_to(self, n: int, *, role: Optional[str] = None) -> int:
+        return self.engine.scale_to(n, role=role)
+
+    def replicas(self) -> int:
+        return int(getattr(self.engine, "replicas", 1) or 1)
+
+    def close(self, *, shutdown_worker: bool = False) -> None:
+        self.engine.close(wait=True)
+
+
+class _RemoteStream:
+    """Iterator over a worker's ndjson token stream. ``close()`` drops the
+    TCP connection, which the worker maps to closing the engine stream — the
+    relay's client-disconnect contract crosses the process boundary. An
+    EXPORT stream's serialized handoff lands on ``.handoff`` after the last
+    token."""
+
+    def __init__(self, conn: HTTPConnection, response: Any, host: "RemoteHost"):
+        self._conn = conn
+        self._response = response
+        self._host = host
+        self._closed = False
+        self.handoff: Optional[bytes] = None
+
+    def __iter__(self) -> "Iterator[np.ndarray]":
+        return self
+
+    def __next__(self) -> np.ndarray:
+        while True:
+            try:
+                line = self._response.readline()
+            except _DEAD_ERRORS as exc:
+                self._host.mark_dead(exc)
+                self.close()
+                raise RuntimeError(f"worker {self._host.host_id} died mid-stream: {exc}") from exc
+            if not line:
+                # connection closed without an end marker: the worker died
+                self.close()
+                if not self._closed_cleanly:
+                    self._host.mark_dead(ConnectionError("stream truncated"))
+                    raise RuntimeError(f"worker {self._host.host_id} truncated the stream")
+                raise StopIteration
+            record = json.loads(line)
+            if "t" in record:
+                return np.asarray(record["t"], np.int32)
+            if "handoff" in record:
+                self.handoff = base64.b64decode(record["handoff"])
+                continue
+            if record.get("end"):
+                self._closed_cleanly = True
+                self.close()
+                raise StopIteration
+            if "error" in record:
+                self.close()
+                raise RuntimeError(f"worker {self._host.host_id} stream failed: {record['error']}")
+
+    _closed_cleanly = False
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            try:
+                self._conn.close()
+            except Exception:  # pragma: no cover - defensive
+                pass
+
+
+class RemoteHost:
+    """The coordinator's handle on a worker process, over the HTTP control
+    plane. Any transport failure marks the host dead (``alive=False``) — the
+    scheduler then routes around it; there is no in-band retry, because a
+    wedged worker retried into is a wedged fleet."""
+
+    def __init__(self, address: str, *, host_id: int, role: str = "mixed"):
+        self.address = address
+        self.host_id = int(host_id)
+        self.role = role
+        self.alive = True
+        host, _, port = address.partition(":")
+        self._host, self._port = host, int(port)
+
+    def mark_dead(self, exc: BaseException) -> None:
+        if self.alive:
+            self.alive = False
+            logger.warning(f"fleet host {self.host_id} ({self.address}) marked dead: {exc}")
+
+    def _connect(self, timeout: Optional[float]) -> HTTPConnection:
+        return HTTPConnection(self._host, self._port, timeout=timeout)
+
+    def _call(self, method: str, path: str, body: Optional[bytes] = None,
+              *, timeout: float = CONTROL_TIMEOUT_S) -> Dict[str, Any]:
+        """One non-streaming control RPC; transport errors mark the host dead
+        and re-raise. NEVER call while holding a lock (TPU013): a stalled
+        worker must cost this call, not the whole coordinator."""
+        conn = self._connect(timeout)
+        try:
+            conn.request(method, path, body=body, headers={"Content-Type": "application/json"})
+            response = conn.getresponse()
+            payload = json.loads(response.read() or b"{}")
+            if response.status >= 400:
+                _raise_shed(response.status, payload)
+            return payload
+        except _DEAD_ERRORS as exc:
+            self.mark_dead(exc)
+            raise
+        finally:
+            conn.close()
+
+    def _stream_call(self, path: str, body: bytes, content_type: str) -> _RemoteStream:
+        conn = self._connect(CONTROL_TIMEOUT_S)
+        try:
+            # connect under the control timeout, then RELAX the socket for the
+            # stream's lifetime BEFORE the request: a cold first token can sit
+            # behind a multi-minute XLA compile, and for close-delimited
+            # responses http.client drops conn.sock at getresponse() — there
+            # is no socket left to retune afterwards (a 30 s-stalled stream
+            # used to mis-classify the worker as dead here)
+            conn.connect()
+            if conn.sock is not None:
+                conn.sock.settimeout(STREAM_READ_TIMEOUT_S)
+            conn.request("POST", path, body=body, headers={"Content-Type": content_type})
+            response = conn.getresponse()
+        except _DEAD_ERRORS as exc:
+            self.mark_dead(exc)
+            conn.close()
+            raise
+        if response.status >= 400:
+            payload = json.loads(response.read() or b"{}")
+            conn.close()
+            _raise_shed(response.status, payload)
+        return _RemoteStream(conn, response, self)
+
+    def ping(self, timeout: float = CONTROL_TIMEOUT_S) -> Dict[str, Any]:
+        return self._call("GET", "/ctrl/ping", timeout=timeout)
+
+    def probe(self, prompt: Optional[Sequence[int]]) -> Dict[str, Any]:
+        body = json.dumps({"prompt": [int(t) for t in prompt] if prompt is not None else None})
+        return self._call("POST", "/ctrl/probe", body.encode())
+
+    def submit(
+        self,
+        prompt: Sequence[int],
+        *,
+        max_new_tokens: Optional[int] = None,
+        constraint: Optional[int] = None,
+        deadline: Optional[float] = None,
+        tenant: Optional[str] = None,
+        priority: Optional[int] = None,
+        export: bool = False,
+    ) -> _RemoteStream:
+        body = json.dumps({
+            "prompt": [int(t) for t in prompt],
+            "max_new_tokens": max_new_tokens,
+            "constraint": constraint,
+            "deadline_remaining_s": remaining_s(deadline),
+            "tenant": tenant,
+            "priority": priority,
+            "export": export,
+        }).encode()
+        return self._stream_call("/ctrl/submit", body, "application/json")
+
+    def import_handoff(self, payload: Any) -> _RemoteStream:
+        if not isinstance(payload, (bytes, bytearray)):
+            payload = serialize_handoff(payload)
+        return self._stream_call("/ctrl/import", bytes(payload), "application/octet-stream")
+
+    def stats(self) -> Dict[str, Any]:
+        return self._call("GET", "/ctrl/stats")["stats"]
+
+    def health(self) -> Dict[str, Any]:
+        if not self.alive:
+            return {"score": 0.0, "state": "breach", "state_code": 2, "enabled": True, "dead": True}
+        try:
+            return self._call("GET", "/ctrl/health")
+        except _DEAD_ERRORS:
+            return {"score": 0.0, "state": "breach", "state_code": 2, "enabled": True, "dead": True}
+
+    def occupancy(self) -> "Tuple[int, int]":
+        stats = self.stats()
+        return int(stats.get("resident") or 0), int(stats.get("waiting") or 0)
+
+    def warmup(self) -> None:
+        self._call("POST", "/ctrl/warmup", b"{}", timeout=600.0)
+
+    def scale_to(self, n: int, *, role: Optional[str] = None) -> int:
+        payload = self._call(
+            "POST", "/ctrl/scale", json.dumps({"replicas": int(n), "role": role}).encode(),
+            timeout=600.0,
+        )
+        return int(payload["replicas"])
+
+    def replicas(self) -> int:
+        try:
+            return int(self.stats().get("replicas") or 1)
+        except _DEAD_ERRORS:
+            return 0
+
+    def close(self, *, shutdown_worker: bool = False) -> None:
+        if not self.alive:
+            return
+        try:
+            self._call("POST", "/ctrl/drain", b"{}", timeout=600.0)
+            if shutdown_worker:
+                self._call("POST", "/ctrl/shutdown", b"{}")
+        except _DEAD_ERRORS:
+            pass
+
+
+def _raise_shed(status: int, payload: Dict[str, Any]) -> None:
+    """Re-raise a worker's shed response as the SAME exception type the local
+    engine would have raised, Retry-After preserved."""
+    kind = payload.get("kind")
+    detail = payload.get("detail") or f"worker answered {status}"
+    if kind == "tenant_limit":
+        raise TenantThrottled(
+            detail, retry_after_s=float(payload.get("retry_after") or 1.0),
+            tenant=payload.get("tenant"),
+        )
+    if kind == "queue_full":
+        raise QueueFullError(detail, retry_after_s=float(payload.get("retry_after") or 1.0))
+    if kind == "deadline":
+        raise DeadlineExceeded(detail)
+    raise RuntimeError(f"control call failed ({status}): {detail}")
+
+
+# --------------------------------------------------------------------- coordinator
+
+
+class FleetCoordinator:
+    """Host-0's routing/admission/scale brain over N host handles.
+
+    Mirrors the engine surface (``submit`` / ``warmup`` / ``stats`` /
+    ``health`` / ``load`` / ``scale_to`` / ``close``), so
+    ``model.generation_batcher = coordinator`` gives the serving app a
+    multi-host fleet with zero route changes — ``/metrics`` grows per-host
+    sections, ``/healthz`` per-host scores, ``/debug/fleet`` the host census.
+
+    Routing is the :class:`ReplicaScheduler` at host granularity: per
+    submission every live host is probed (one concurrent control RPC each)
+    for its token-weighted load, SLO breach flag, and its actual
+    cached-prefix length for this prompt — the radix prefix tier made
+    FLEET-GLOBAL, so turn 2 of a conversation lands on the host whose KV
+    pool already holds turn 1. Dead hosts rank last and are skipped; a
+    transport failure during routing marks the host dead and the walk
+    continues on its siblings (degrade, don't shed).
+
+    With host roles configured (``host_roles=`` or the
+    ``UNIONML_TPU_HOST_ROLES`` export), prompts at least
+    ``prefill_threshold`` tokens long prefill on a prefill-role host and
+    their finished KV pages cross the control plane to a decode host
+    (:func:`serialize_handoff`'s block-native wire format) — token-identical
+    to a mixed fleet, with the transfer latency on ``stats()``."""
+
+    def __init__(
+        self,
+        hosts: Sequence[Any],
+        *,
+        affinity_tokens: int = 0,
+        affinity_margin: int = 2,
+        prefill_threshold: Optional[int] = None,
+        host_roles: Optional[Sequence[str]] = None,
+    ):
+        if not hosts:
+            raise ValueError("a fleet needs at least one host")
+        self.hosts: "List[Any]" = list(hosts)
+        if host_roles is not None:
+            if len(host_roles) != len(self.hosts):
+                raise ValueError(
+                    f"host_roles covers {len(host_roles)} hosts but the fleet has {len(self.hosts)}"
+                )
+            for host, role in zip(self.hosts, host_roles):
+                host.role = role
+        else:
+            env_roles = fleet_host_roles()
+            if env_roles:
+                expanded: "List[str]" = []
+                for role in ("prefill", "decode", "mixed"):
+                    expanded.extend([role] * env_roles.get(role, 0))
+                if len(expanded) == len(self.hosts) and any(r == "prefill" for r in expanded) and not all(
+                    r == "prefill" for r in expanded
+                ):
+                    for host, role in zip(self.hosts, expanded):
+                        host.role = role
+                else:
+                    logger.warning(
+                        f"ignoring UNIONML_TPU_HOST_ROLES={env_roles} over {len(self.hosts)} hosts; "
+                        "falling back to a symmetric (all-mixed) host fleet"
+                    )
+        self._scheduler = ReplicaScheduler(
+            len(self.hosts), affinity_tokens=affinity_tokens, affinity_margin=affinity_margin
+        )
+        if prefill_threshold is None:
+            prefill_threshold = serve_prefill_threshold()
+        self._prefill_threshold = int(prefill_threshold)
+        self._lock = threading.Lock()
+        #: fleet-level telemetry (the ReplicaSet counters, one level up)
+        self.shed_deadline = 0
+        self.shed_queue_full = 0
+        self.host_failures = 0
+        self.cross_host_handoffs = 0
+        self._transfer_ms = LatencyWindow()
+
+    # ------------------------------------------------------------------ introspection
+
+    @property
+    def batchers(self) -> "Tuple[Any, ...]":
+        """The host handles (the ``fleet_health`` duck-typing surface: each
+        handle's ``health()`` is one 'replica' row at host granularity)."""
+        return tuple(self.hosts)
+
+    @property
+    def replicas(self) -> int:
+        """Live hosts (the coordinator's fleet-size headline; per-host engine
+        replica counts ride ``stats()['hosts']``)."""
+        return sum(1 for host in self.hosts if host.alive)
+
+    @property
+    def roles(self) -> "List[str]":
+        return [host.role for host in self.hosts]
+
+    def _live(self) -> "List[int]":
+        return [i for i, host in enumerate(self.hosts) if host.alive]
+
+    def _note_failure(self) -> None:
+        with self._lock:
+            self.host_failures += 1
+
+    def _probe_all(
+        self, indices: "List[int]", prompt: Optional[Sequence[int]]
+    ) -> "Dict[int, Dict[str, Any]]":
+        """Probe the named hosts concurrently (one control RPC each); a host
+        that fails its probe is marked dead and omitted."""
+        if len(indices) == 1:
+            index = indices[0]
+            try:
+                return {index: self.hosts[index].probe(prompt)}
+            except _DEAD_ERRORS:
+                self._note_failure()
+                return {}
+        from concurrent.futures import ThreadPoolExecutor
+
+        def one(index: int) -> "Tuple[int, Optional[Dict[str, Any]]]":
+            try:
+                return index, self.hosts[index].probe(prompt)
+            except _DEAD_ERRORS:
+                self._note_failure()
+                return index, None
+
+        with ThreadPoolExecutor(max_workers=len(indices)) as pool:
+            results = list(pool.map(one, indices))
+        return {index: probe for index, probe in results if probe is not None}
+
+    # ------------------------------------------------------------------ submission
+
+    def submit(
+        self,
+        prompt: Sequence[int],
+        *,
+        max_new_tokens: Optional[int] = None,
+        constraint: Optional[int] = None,
+        deadline: Optional[float] = None,
+        tenant: Optional[str] = None,
+        priority: Optional[int] = None,
+    ) -> "Iterator[np.ndarray]":
+        """Route a prompt to the best live host and return its token stream
+        (the engine submit contract, one level up)."""
+        if expired(deadline):
+            with self._lock:
+                self.shed_deadline += 1
+            raise DeadlineExceeded("deadline expired before the prompt was routed to a host")
+        live = self._live()
+        if not live:
+            raise RuntimeError(f"all {len(self.hosts)} fleet hosts are dead")
+        probes = self._probe_all(live, prompt)
+        if not probes:
+            raise RuntimeError(f"all {len(self.hosts)} fleet hosts are dead")
+        kwargs = dict(
+            max_new_tokens=max_new_tokens, constraint=constraint, deadline=deadline,
+            tenant=tenant, priority=priority,
+        )
+        if any(self.hosts[i].role == "prefill" for i in probes):
+            stream = self._submit_disaggregated(probes, prompt, kwargs)
+            if stream is not None:
+                return stream
+        return self._submit_routed(probes, prompt, kwargs)
+
+    def _order(
+        self, probes: "Dict[int, Dict[str, Any]]", prompt: Sequence[int]
+    ) -> "Tuple[List[int], bool]":
+        """The scheduler's host order over the full (stable-index) host list;
+        dead/unprobed hosts rank last via infinite load + avoid flags and are
+        filtered from the returned walk."""
+        n = len(self.hosts)
+        loads = [probes[i]["load"] if i in probes else math.inf for i in range(n)]
+        cached = [probes[i]["cached"] if i in probes else 0 for i in range(n)]
+        breaching = [probes[i]["breaching"] if i in probes else True for i in range(n)]
+        deprioritized = [self.hosts[i].role == "prefill" for i in range(n)]
+        order, affinity_head = self._scheduler.order(
+            loads, prompt,
+            cached if max(cached, default=0) > 0 else None,
+            breaching,
+            deprioritized if any(deprioritized) else None,
+        )
+        return [i for i in order if i in probes], affinity_head
+
+    def _submit_routed(
+        self,
+        probes: "Dict[int, Dict[str, Any]]",
+        prompt: Sequence[int],
+        kwargs: Dict[str, Any],
+    ) -> "Iterator[np.ndarray]":
+        order, affinity_head = self._order(probes, prompt)
+        last_exc: Optional[BaseException] = None
+        for index in order:
+            try:
+                stream = self.hosts[index].submit(prompt, **kwargs)
+            except TenantThrottled:
+                raise  # every host shares the tenant policy; the walk could only re-shed
+            except QueueFullError as exc:
+                last_exc = exc
+                continue
+            except _DEAD_ERRORS as exc:
+                self._note_failure()
+                last_exc = exc
+                continue
+            self._scheduler.note(index, prompt, affinity=affinity_head and index == order[0])
+            return stream
+        with self._lock:
+            self.shed_queue_full += 1
+        raise QueueFullError(
+            f"all {len(order)} live hosts' queues are full"
+        ) from last_exc
+
+    # -------------------------------------------------------------- disaggregation
+
+    def _submit_disaggregated(
+        self,
+        probes: "Dict[int, Dict[str, Any]]",
+        prompt: Sequence[int],
+        kwargs: Dict[str, Any],
+    ) -> "Optional[Iterator[np.ndarray]]":
+        """The cross-host prefill→decode path; None = not applicable (short
+        prompt, no viable pair) — the caller falls back to the classic walk,
+        so host disaggregation can only redirect work, never shed it."""
+        prefills = [i for i in probes if self.hosts[i].role == "prefill"]
+        targets = [i for i in probes if self.hosts[i].role == "decode"] or [
+            i for i in probes if self.hosts[i].role == "mixed"
+        ]
+        if not prefills or not targets or len(prompt) < self._prefill_threshold:
+            return None
+        # warm multi-turn shortcut at host granularity: a decode host whose
+        # radix tier already covers most of the prompt admits directly
+        warm = max(targets, key=lambda i: (probes[i]["cached"], -probes[i]["load"]))
+        cached = probes[warm]["cached"]
+        if cached > 0 and len(prompt) - cached < max(self._prefill_threshold, (len(prompt) + 1) // 2):
+            try:
+                stream = self.hosts[warm].submit(prompt, **kwargs)
+            except (QueueFullError, *_DEAD_ERRORS):
+                pass
+            else:
+                self._scheduler.note(warm, prompt)
+                return stream
+        for p in sorted(prefills, key=lambda i: (probes[i]["load"], i)):
+            try:
+                pstream = self.hosts[p].submit(prompt, export=True, **kwargs)
+            except (QueueFullError, *_DEAD_ERRORS) as exc:
+                if isinstance(exc, _DEAD_ERRORS):
+                    self._note_failure()
+                continue
+            self._scheduler.note(p, prompt)
+            targets_ranked = sorted(targets, key=lambda i: (probes[i]["load"], i))
+            return self._relay(pstream, targets_ranked)
+        return None
+
+    def _relay(self, pstream: Any, targets: "List[int]") -> "Iterator[np.ndarray]":
+        """Stitch the prefill host's first-token stream and the decode host's
+        resident stream into one consumer-facing iterator, shipping the
+        block-native payload across the control plane in between."""
+        active = pstream
+        try:
+            for item in pstream:
+                yield item
+            payload = getattr(pstream, "handoff", None)
+            if payload is None:
+                return  # finished outright at the prompt-sampled token
+            started = time.monotonic()
+            dstream = self._import_on(targets, payload)
+            with self._lock:
+                self.cross_host_handoffs += 1
+            self._transfer_ms.observe(time.monotonic() - started)
+            active = dstream
+            for item in dstream:
+                yield item
+        finally:
+            _close_quietly(active)
+
+    def _import_on(self, targets: "List[int]", payload: Any) -> Any:
+        last_exc: Optional[BaseException] = None
+        for t in targets:
+            try:
+                return self.hosts[t].import_handoff(payload)
+            except (QueueFullError, RuntimeError) as exc:
+                last_exc = exc
+                continue
+            except _DEAD_ERRORS as exc:
+                self._note_failure()
+                last_exc = exc
+                continue
+        raise RuntimeError(
+            f"no decode host of {len(targets)} could adopt the handed-off prefill"
+        ) from last_exc
+
+    # ------------------------------------------------------------------ fleet ops
+
+    def warmup(self) -> None:
+        """Warm every live host concurrently (each host warms its own
+        replicas in parallel below this)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        live = [self.hosts[i] for i in self._live()]
+        with ThreadPoolExecutor(max_workers=max(len(live), 1)) as pool:
+            list(pool.map(lambda host: host.warmup(), live))
+
+    def load(self) -> float:
+        total = 0.0
+        for index in self._live():
+            try:
+                total += float(self.hosts[index].probe(None)["load"])
+            except _DEAD_ERRORS:
+                self._note_failure()
+        return total
+
+    def cached_prefix_tokens(self, prompt: Sequence[int]) -> int:
+        """Fleet-global radix probe (a coordinator can itself be a host of a
+        higher-level fleet)."""
+        best = 0
+        for index in self._live():
+            try:
+                best = max(best, int(self.hosts[index].probe(prompt)["cached"]))
+            except _DEAD_ERRORS:
+                self._note_failure()
+        return best
+
+    def occupancy(self) -> "Tuple[int, int]":
+        resident = waiting = 0
+        for index in self._live():
+            try:
+                r, w = self.hosts[index].occupancy()
+            except _DEAD_ERRORS:
+                self._note_failure()
+                continue
+            resident += r
+            waiting += w
+        return resident, waiting
+
+    def scale_to(self, n: int, *, role: Optional[str] = None, timeout: float = 120.0) -> int:
+        """Resize the FLEET to ``n`` total replicas, spread evenly over live
+        hosts (stable order, remainder to the lowest host ids). Each host's
+        own ``scale_to`` does the zero-loss work — warm-before-join on the
+        way up, quiesce-drain-close on the way down."""
+        live = self._live()
+        if not live:
+            raise RuntimeError("no live hosts to scale")
+        if n < len(live):
+            raise ValueError(
+                f"a {len(live)}-host fleet cannot scale below one replica per host ({len(live)})"
+            )
+        base, rem = divmod(int(n), len(live))
+        total = 0
+        for position, index in enumerate(live):
+            target = base + (1 if position < rem else 0)
+            try:
+                total += self.hosts[index].scale_to(target, role=role)
+            except _DEAD_ERRORS:
+                self._note_failure()
+        return total
+
+    def health(self) -> Dict[str, Any]:
+        """Fleet health at host granularity — same shape as
+        :func:`~unionml_tpu.observability.health.fleet_health` (which this
+        delegates to through the ``batchers`` duck-typing), so ``/healthz``
+        renders a multi-host fleet with per-host rows unchanged."""
+        from unionml_tpu.observability.health import fleet_health
+
+        return fleet_health(self)
+
+    def replica_loads(self) -> "List[Dict[str, Any]]":
+        """Per-host occupancy rows for live gauges (`/debug/fleet`)."""
+        out = []
+        for index, host in enumerate(self.hosts):
+            row: Dict[str, Any] = {
+                "host": index, "role": host.role, "alive": host.alive,
+                "address": host.address,
+            }
+            if host.alive:
+                try:
+                    resident, waiting = host.occupancy()
+                    row.update({"resident": resident, "waiting": waiting})
+                except _DEAD_ERRORS:
+                    self._note_failure()
+            out.append(row)
+        return out
+
+    def host_census(self) -> "List[Dict[str, Any]]":
+        """The ``/debug/fleet`` host table: who is where, alive, what role,
+        how many replicas."""
+        return [
+            {
+                "host": index,
+                "process_id": getattr(host, "host_id", index),
+                "address": host.address,
+                "role": host.role,
+                "alive": host.alive,
+                "replicas": host.replicas() if host.alive else 0,
+            }
+            for index, host in enumerate(self.hosts)
+        ]
+
+    def stats(self) -> Dict[str, Any]:
+        """Fleet snapshot for ``/metrics``: per-host sections plus the
+        cross-host aggregates and the coordinator's own routing/failure
+        telemetry."""
+        per_host: "List[Dict[str, Any]]" = []
+        for index, host in enumerate(self.hosts):
+            entry: Dict[str, Any] = {
+                "host": index,
+                "process_id": getattr(host, "host_id", index),
+                "address": host.address,
+                "role": host.role,
+                "alive": host.alive,
+            }
+            if host.alive:
+                try:
+                    entry["stats"] = host.stats()
+                except _DEAD_ERRORS:
+                    self._note_failure()
+                    entry["alive"] = False
+            per_host.append(entry)
+
+        def total(key: str) -> int:
+            return sum(
+                int((entry.get("stats") or {}).get(key) or 0) for entry in per_host
+            )
+
+        with self._lock:
+            shed_deadline, shed_queue_full = self.shed_deadline, self.shed_queue_full
+            host_failures = self.host_failures
+            cross_host = self.cross_host_handoffs
+        return {
+            "hosts": per_host,
+            "live_hosts": sum(1 for entry in per_host if entry["alive"]),
+            "replicas": total("replicas"),
+            "scheduler": self._scheduler.stats(),
+            "host_failures": host_failures,
+            "handoffs_cross_host": cross_host,
+            "handoff_transfer_ms": self._transfer_ms.snapshot(),
+            "slots": total("slots"),
+            "resident": total("resident"),
+            "waiting": total("waiting"),
+            "decode_dispatches": total("decode_dispatches"),
+            "decoded_rows": total("decoded_rows"),
+            "shed_queue_full": shed_queue_full + total("shed_queue_full"),
+            "shed_deadline": shed_deadline + total("shed_deadline"),
+        }
+
+    def close(self, wait: bool = True, timeout: float = 120.0,
+              *, shutdown_workers: bool = False) -> None:
+        """Drain every live host (``shutdown_workers=True`` also stops the
+        worker processes' control loops — the CLI-owned fleet's exit path;
+        test-owned workers are reaped by their spawner)."""
+        for index in self._live():
+            try:
+                self.hosts[index].close(shutdown_worker=shutdown_workers)
+            except _DEAD_ERRORS:
+                self._note_failure()
+
+
+# -------------------------------------------------------------------- fleet bootstrap
+
+
+def connect_fleet(
+    fleet_dir: "str | Path | None" = None,
+    *,
+    num_hosts: int,
+    timeout_s: float = 120.0,
+    local_engine: Any = None,
+    local_process_id: int = 0,
+    **coordinator_kwargs: Any,
+) -> FleetCoordinator:
+    """Build a :class:`FleetCoordinator` from the rendezvous directory the
+    workers announce into: poll until ``num_hosts`` announcements appear (a
+    worker that never announces fails the connect loudly at ``timeout_s``),
+    ping each worker, and return the coordinator with hosts in process-id
+    order. ``local_engine`` substitutes a direct in-process handle for
+    ``local_process_id`` (host 0 usually serves too — its submissions
+    shouldn't pay an HTTP hop)."""
+    root = Path(fleet_dir if fleet_dir is not None else default_fleet_dir()).expanduser()
+    deadline = time.monotonic() + timeout_s
+    announcements: "Dict[int, Dict[str, Any]]" = {}
+    while True:
+        if root.exists():
+            for path in sorted(root.glob("host-*.json")):
+                try:
+                    record = json.loads(path.read_text())
+                except (OSError, ValueError):
+                    continue  # half-written or vanished; next poll sees it
+                announcements[int(record["process_id"])] = record
+        needed = set(range(num_hosts))
+        if local_engine is not None:
+            needed.discard(local_process_id)
+        if needed <= set(announcements):
+            break
+        if time.monotonic() >= deadline:
+            raise TimeoutError(
+                f"fleet rendezvous timed out: {sorted(announcements)} of {num_hosts} "
+                f"hosts announced in {root}"
+            )
+        time.sleep(0.05)
+    hosts: "List[Any]" = []
+    for process_id in range(num_hosts):
+        if local_engine is not None and process_id == local_process_id:
+            hosts.append(LocalHost(local_engine, host_id=process_id))
+            continue
+        record = announcements[process_id]
+        host = RemoteHost(
+            f"{record['host']}:{record['port']}",
+            host_id=process_id,
+            role=record.get("role", "mixed"),
+        )
+        host.ping()  # fail the connect loudly rather than at first routing
+        hosts.append(host)
+    return FleetCoordinator(hosts, **coordinator_kwargs)
+
+
+def run_worker(spec: Dict[str, Any]) -> None:
+    """A worker process's whole life (the ``python -m
+    unionml_tpu.serving.cluster`` entrypoint body):
+
+    1. join the jax.distributed runtime named by the env
+       (:func:`unionml_tpu.distributed.maybe_initialize` — the bootstrap
+       shared with ``job_runner``);
+    2. AGREE on the fleet config: process 0's ``builder``/``kwargs`` are
+       broadcast over ``multihost_utils`` and every host builds from the
+       agreed copy — knob-identical engines by construction, not by hope;
+    3. build the local engine (the builder returns a ContinuousBatcher or
+       ReplicaSet over this host's devices) and fence at a barrier so no
+       host announces before the slowest finishes building;
+    4. exchange control ports (``process_allgather``), start the
+       :class:`WorkerAgent`, announce into the fleet dir, and serve until
+       ``/ctrl/shutdown`` (or SIGTERM) arrives.
+    """
+    from unionml_tpu import distributed
+    from unionml_tpu.resolver import locate
+
+    distributed.maybe_initialize()
+    agreed = distributed.agree(
+        {"builder": spec["builder"], "kwargs": spec.get("kwargs") or {}}
+    )
+    if agreed["builder"] != spec["builder"]:
+        logger.warning(
+            f"fleet config disagreement: host 0 builds {agreed['builder']!r}, this spec "
+            f"names {spec['builder']!r}; building host 0's (the agreement wins)"
+        )
+    builder = locate(agreed["builder"])
+    engine = builder(**agreed["kwargs"])
+    distributed.barrier("unionml-tpu-fleet-build")
+    agent = WorkerAgent(
+        engine,
+        host=spec.get("control_host", "127.0.0.1"),
+        role=spec.get("role", "mixed"),
+    )
+    agent.start()
+    ports = distributed.allgather_ints(agent.port)
+    logger.info(f"fleet control ports by process: {ports}")
+    agent.announce(spec.get("fleet_dir") or default_fleet_dir())
+    try:
+        while not agent.shutdown_event.wait(0.2):
+            pass
+    finally:
+        agent.close(close_engine=True)
+
+
+def enable_serve_cluster(serving: Any, *, host: str = "127.0.0.1", port: int = 8000) -> None:
+    """Run a :class:`~unionml_tpu.serving.app.ServingApp` as one member of a
+    multi-host fleet (the ``serve --num-hosts/--coordinator/--process-id``
+    path). Process 0 is the front door: its ``model.generation_batcher`` is
+    wrapped in a :class:`FleetCoordinator` (itself as the local host, every
+    peer as a remote one) and the public HTTP server runs as usual — so
+    ``/predict-stream``, ``/v1/*``, ``/metrics``, ``/healthz``,
+    ``/debug/fleet`` and ``/debug/scale`` all operate on the whole fleet.
+    Processes > 0 run only the control server: their engines take work from
+    the coordinator, not from clients."""
+    from unionml_tpu import distributed
+
+    distributed.maybe_initialize()
+    me, num = distributed.process_index(), distributed.process_count()
+    serving.startup()
+    engine = getattr(serving.model, "generation_batcher", None)
+    if engine is None:
+        raise RuntimeError(
+            "cluster serving needs a generation engine: set model.generation_batcher "
+            "(e.g. the text-generation template's ContinuousBatcher/ReplicaSet) "
+            "before serve starts"
+        )
+    fleet = default_fleet_dir()
+    if me != 0:
+        agent = WorkerAgent(engine)
+        agent.start()
+        ports = distributed.allgather_ints(agent.port)
+        logger.info(f"fleet control ports by process: {ports}")
+        agent.announce(fleet)
+        try:
+            while not agent.shutdown_event.wait(0.2):
+                pass
+        finally:
+            agent.close(close_engine=True)
+        return
+    # process 0: rendezvous with every worker, then serve the front door
+    distributed.allgather_ints(0)  # pair the workers' port exchange
+    coordinator = connect_fleet(
+        fleet, num_hosts=num, local_engine=engine, local_process_id=0
+    )
+    serving.model.generation_batcher = coordinator
+    serving.run(host=host, port=port)
+
+
+def main(argv: "Optional[List[str]]" = None) -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m unionml_tpu.serving.cluster",
+        description="run one multi-host serving fleet worker from a spec file",
+    )
+    parser.add_argument("spec", help="path to the worker spec JSON (builder, kwargs, fleet_dir, role)")
+    args = parser.parse_args(argv)
+    run_worker(json.loads(Path(args.spec).read_text()))
+
+
+if __name__ == "__main__":
+    main()

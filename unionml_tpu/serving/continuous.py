@@ -231,6 +231,10 @@ class _Admission:
     # ids, scratch-padded, that the dense-row gather reads
     cached: int = 0
     gather_row: Optional[np.ndarray] = None
+    # block-native handoff import (paged engines): the payload's KV pages in
+    # pool layout, placed on this engine's submesh — finalize scatters them
+    # whole-block into the allocation instead of the dense per-position paste
+    import_pages: Optional[tuple] = None
     # completion products consumed by _finalize_admission
     tok0: Any = None
     row_len: Any = None
@@ -715,6 +719,14 @@ class ContinuousBatcher:
         self._paged_spec_admit_fn = jax.jit(
             self._paged_spec_admit_impl, donate_argnums=(0, 1, 2)
         )
+        # block-native handoff (docs/serving.md "Disaggregated and elastic
+        # serving"): the export slices the prefilled row into block-sized
+        # pages (payload bytes scale with the PROMPT, not cache_len — the
+        # cross-host transfer contract) and the import scatters whole pages
+        # into the pool. One compile per distinct page count, each a trivial
+        # reshape/scatter; bounded by max_blocks.
+        self._export_pages_fn = jax.jit(self._export_pages_impl, static_argnums=(1, 2))
+        self._paged_page_admit_fn = jax.jit(self._paged_page_admit_impl, donate_argnums=(0,))
         if self._aot is not None:
             # the admission scatter helpers preload too — on a cold TPU the
             # paged scatter over a big pool is its own multi-second compile
@@ -863,6 +875,51 @@ class ContinuousBatcher:
                 new_layer[name] = layer[name].at[:, blk, off].set(
                     jnp.swapaxes(row[name][0], 0, 1).astype(layer[name].dtype)
                 )
+            new_layers.append(new_layer)
+        tok = jax.lax.dynamic_update_slice(tok, row_tok.astype(tok.dtype), (slot,))
+        lengths = jax.lax.dynamic_update_slice(lengths, row_len.astype(lengths.dtype), (slot,))
+        done = jax.lax.dynamic_update_slice(done, jnp.zeros((1,), bool), (slot,))
+        return tuple(new_layers), tok, lengths, done
+
+    @staticmethod
+    def _export_pages_impl(row_cache, n_blocks, block_size):
+        """Slice a prefilled dense ``[1, cache_len, H, last]`` row into its
+        first ``n_blocks`` block-sized pages in POOL layout
+        (``[H, n_blocks, block_size, last]``) — the block-native handoff
+        payload. ``n_blocks``/``block_size`` are static (one small compile per
+        distinct page count); the page contents are byte-identical to what the
+        dense admission scatter would have written into those blocks, which is
+        what makes the pages path bit-identical to the dense one."""
+        width = n_blocks * block_size
+        pages = []
+        for layer in row_cache:
+            page = {}
+            for name, buf in layer.items():
+                sliced = jnp.swapaxes(buf[0, :width], 0, 1)  # [H, width, last]
+                page[name] = sliced.reshape(sliced.shape[0], n_blocks, block_size, sliced.shape[-1])
+            pages.append(page)
+        return tuple(pages)
+
+    @staticmethod
+    def _paged_page_admit_impl(cache, pages, tok, lengths, done, slot, row_tok, row_len,
+                               blocks_row, skip=0):
+        """Block-native import: point slot ``slot``'s table at ``blocks_row``
+        and write the payload's pages WHOLE-BLOCK into the first
+        ``n_blocks`` allocated blocks — no ``cache_len``-wide dense row is
+        ever materialized on the importing engine. ``skip`` (traced) diverts
+        the first ``skip`` pages to the scratch block: those table entries are
+        SHARED (the static prefix's blocks), already holding exactly the
+        pages' content, and tree-shared pages must never be re-written under
+        their other readers — the same contract as the dense scatter's
+        ``skip``."""
+        n_blocks = pages[0]["k"].shape[1]
+        scratch = cache[0]["k"].shape[1] - 1  # scratch is the last pool block
+        ids = jnp.where(jnp.arange(n_blocks) < skip, scratch, blocks_row[:n_blocks])
+        new_layers = []
+        for layer, page in zip(cache, pages):
+            new_layer = {"table": jax.lax.dynamic_update_slice(layer["table"], blocks_row[None], (slot, 0))}
+            for name in page:
+                new_layer[name] = layer[name].at[:, ids].set(page[name].astype(layer[name].dtype))
             new_layers.append(new_layer)
         tok = jax.lax.dynamic_update_slice(tok, row_tok.astype(tok.dtype), (slot,))
         lengths = jax.lax.dynamic_update_slice(lengths, row_len.astype(lengths.dtype), (slot,))
@@ -1620,11 +1677,15 @@ class ContinuousBatcher:
                     "nodes": self._radix.nodes(),
                 }
             if self.role is not None:
+                snapshot["role"] = self.role
+            if self.role is not None or self.handoffs_exported or self.handoffs_imported:
                 # disaggregated serving: the engine's role plus its handoff
                 # counters (ints only; the transfer-latency window rides the
-                # post-lock section below) — absent on role-less engines, so
-                # their stats stay byte-for-byte the historical ones
-                snapshot["role"] = self.role
+                # post-lock section below) — absent on role-less engines that
+                # never handed off, so their stats stay byte-for-byte the
+                # historical ones. A ROLE-LESS engine can still export/import:
+                # the cluster coordinator disaggregates at HOST granularity
+                # over mixed per-host fleets (serving/cluster.py)
                 snapshot["handoff"] = {
                     "exported": self.handoffs_exported,
                     "imported": self.handoffs_imported,
@@ -1663,7 +1724,7 @@ class ContinuousBatcher:
             # loaded vs compiled vs serialized plus the load/compile latency
             # windows the cold_start bench lane pins
             snapshot["aot"] = self._aot.stats()
-        if self.role is not None:
+        if "handoff" in snapshot:
             # export→resident transfer latency (decode-role replicas observe
             # it at import finalize); {"window": 0} until a handoff lands
             snapshot["handoff"]["transfer_ms"] = self._handoff_ms.snapshot()
@@ -2228,17 +2289,44 @@ class ContinuousBatcher:
         emissions), stopping one short so :meth:`_finalize_admission`'s
         standard advance past the first token lands on the right state."""
         payload = adm.session.pending_import
-        row = payload["row"]
-        width = int(jax.tree_util.tree_leaves(row)[0].shape[1])
-        if width != self.cache_len:
-            raise ValueError(
-                f"handoff row width {width} != this engine's cache_len {self.cache_len}; "
-                "disaggregated replicas must be built with identical engine knobs"
+        pages = payload.get("pages")
+        if pages is not None:
+            # block-native payload: whole KV pages in pool layout, placed onto
+            # this engine's submesh (device_put copies between disjoint device
+            # sets — and accepts the numpy arrays a cross-host wire delivers)
+            if self.block_size is None:
+                raise ValueError(
+                    "a block-native (paged) handoff cannot import into a dense engine; "
+                    "disaggregated replicas must be built with identical engine knobs"
+                )
+            if int(payload.get("block_size") or 0) != self.block_size:
+                raise ValueError(
+                    f"handoff block_size {payload.get('block_size')} != this engine's "
+                    f"{self.block_size}; disaggregated replicas must be built with "
+                    "identical engine knobs"
+                )
+            if int(payload["lengths"]) > self.cache_len:
+                raise ValueError(
+                    f"handoff covers {payload['lengths']} positions but this engine's "
+                    f"cache_len is {self.cache_len}; disaggregated replicas must be "
+                    "built with identical engine knobs"
+                )
+            pages = tuple(
+                {name: jnp.asarray(buf) for name, buf in layer.items()} for layer in pages
             )
-        # cross-submesh transfer: the exporting replica's [1, cache_len] row is
-        # re-placed under this engine's mesh (device_put copies between
-        # disjoint device sets; a meshless engine keeps the row where it is)
-        adm.row_cache = self.gen._place_cache(row)
+            adm.import_pages = self.gen._place_paged_cache(pages)
+        else:
+            row = payload["row"]
+            width = int(jax.tree_util.tree_leaves(row)[0].shape[1])
+            if width != self.cache_len:
+                raise ValueError(
+                    f"handoff row width {width} != this engine's cache_len {self.cache_len}; "
+                    "disaggregated replicas must be built with identical engine knobs"
+                )
+            # cross-submesh transfer: the exporting replica's [1, cache_len] row
+            # is re-placed under this engine's mesh (device_put copies between
+            # disjoint device sets; a meshless engine keeps the row where it is)
+            adm.row_cache = self.gen._place_cache(row)
         adm.tok0 = jnp.asarray([int(payload["first"])], jnp.int32)
         adm.row_len = jnp.asarray([int(payload["lengths"])], jnp.int32)
         if self.gen._cs is not None:
@@ -2367,6 +2455,16 @@ class ContinuousBatcher:
         done_now = hit_eos or session.produced + 1 >= session.max_new
         row_cache, row_len = adm.row_cache, adm.row_len
         adm.row_cache = adm.last = None
+        pages = None
+        if self.block_size is not None and not done_now:
+            # BLOCK-NATIVE payload (the PR 9 follow-on): ship only the
+            # ceil(lengths / block_size) pages the prompt actually occupies,
+            # keyed by their position in the block run — a long-context
+            # engine's handoff no longer pays cache_len-wide rows per
+            # transfer, in-process or across hosts
+            n_blocks = -(-int(np.asarray(row_len)[0]) // self.block_size)
+            pages = self._export_pages_fn(row_cache, n_blocks, self.block_size)
+            row_cache = None  # the dense row never leaves a paged engine
         with self._lock:
             if adm in self._admissions:
                 self._admissions.remove(adm)
@@ -2405,7 +2503,13 @@ class ContinuousBatcher:
                 session.handoff = {
                     "prompt": list(adm.prompt),
                     "first": int(first[0]),
-                    "row": row_cache,
+                    # paged engines ship block-aligned pages keyed by block
+                    # position; dense engines keep the historical full row
+                    **(
+                        {"pages": pages, "block_size": self.block_size}
+                        if pages is not None
+                        else {"row": row_cache}
+                    ),
                     "lengths": int(np.asarray(row_len)[0]),
                     "max_new": session.max_new,
                     "produced": session.produced,
@@ -2447,7 +2551,15 @@ class ContinuousBatcher:
             blocks_row = adm.blocks_row
             if self._spec is None:
                 cache, tok, lengths, done, key, *cst = self._carry
-                if blocks_row is not None:
+                if adm.import_pages is not None:
+                    # block-native import: whole pages scatter straight into
+                    # the allocated blocks — no dense re-scatter ever runs
+                    cache, tok, lengths, done = self._paged_page_admit_fn(
+                        cache, adm.import_pages, tok, lengths, done, jnp.int32(slot),
+                        adm.tok0, adm.row_len, jnp.asarray(blocks_row),
+                        jnp.int32(session.shared_blocks),
+                    )
+                elif blocks_row is not None:
                     cache, tok, lengths, done = self._paged_admit_fn(
                         cache, adm.row_cache, tok, lengths, done, jnp.int32(slot), adm.tok0,
                         adm.row_len, jnp.asarray(blocks_row), jnp.int32(session.shared_blocks),
@@ -2484,7 +2596,7 @@ class ContinuousBatcher:
                 )
                 self._carry = tuple(state)
             # drop the row references promptly: the donated buffers are dead
-            adm.row_cache = adm.d_row_cache = adm.last = None
+            adm.row_cache = adm.d_row_cache = adm.last = adm.import_pages = None
         except BaseException as exc:
             with self._lock:
                 if adm in self._admissions:

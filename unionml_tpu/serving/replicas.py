@@ -603,15 +603,38 @@ class ReplicaSet:
                 replicas = sum(serve_replica_roles().values()) or None
         spares: "List[Any]" = []
         if mesh is not None and dp_extent(mesh) > 1:
-            extent = dp_extent(mesh)
+            all_submeshes = slice_mesh(mesh)
+            mesh_procs = {d.process_index for d in np.asarray(mesh.devices).ravel()}
+            if len(mesh_procs) > 1:
+                # process-aware fleets (docs/serving.md "Multi-host fleets"): a
+                # hybrid ICI/DCN mesh spans hosts, but one process can only
+                # drive its OWN devices — keep the host-local submeshes and let
+                # the cluster coordinator route across hosts. Replica counts
+                # are then per host (the cross-host agreement in
+                # serving/cluster.py hands every host the same number).
+                from unionml_tpu.parallel.mesh import process_local_submeshes
+
+                local = process_local_submeshes(all_submeshes)
+                if not local:
+                    raise ValueError(
+                        "no replica submesh of this mesh is local to this process — "
+                        "put the replica axes (dcn_data/data) on DCN "
+                        "(MeshSpec.build_hybrid) so each batch slice stays host-local"
+                    )
+                logger.info(
+                    f"multi-process mesh: this host owns replica submeshes "
+                    f"{[index for index, _ in local]} of {len(all_submeshes)}"
+                )
+                all_submeshes = [sub for _, sub in local]
+            extent = len(all_submeshes)
             if replicas is None:
                 replicas = extent
             if replicas > extent:
                 raise ValueError(
-                    f"replicas ({replicas}) exceed the mesh's data-parallel extent ({extent}); "
+                    f"replicas ({replicas}) exceed the mesh's {'host-local ' if len(mesh_procs) > 1 else ''}"
+                    f"data-parallel extent ({extent}); "
                     "a dp mesh cannot host more replicas than batch slices"
                 )
-            all_submeshes = slice_mesh(mesh)
             submeshes, spares = all_submeshes[:replicas], all_submeshes[replicas:]
         elif replicas is None or replicas == 1:
             submeshes = [mesh]
@@ -721,6 +744,7 @@ class ReplicaSet:
         deadline: Optional[float] = None,
         tenant: Optional[str] = None,
         priority: Optional[int] = None,
+        export_handoff: bool = False,
     ) -> "Iterator[np.ndarray]":
         """Route a prompt to the least-loaded replica (prefix affinity
         permitting) and return its engine's token stream. Sheds with
@@ -749,6 +773,16 @@ class ReplicaSet:
         with self._lock:
             batchers = list(self._batchers)
             roles = list(self._roles)
+        if export_handoff:
+            # the multi-host fleet's prefill leg (serving/cluster.py): run ONLY
+            # the prefill on this host's best-suited replica and hand the
+            # block-native payload back on the stream's ``handoff`` attribute —
+            # the coordinator ships it to another HOST's import_handoff
+            return self._submit_export(
+                batchers, roles, prompt,
+                max_new_tokens=max_new_tokens, constraint=constraint, deadline=deadline,
+                tenant=tenant, priority=priority,
+            )
         if any(role == "prefill" for role in roles):
             stream = self._submit_disaggregated(
                 batchers, roles, prompt,
@@ -845,6 +879,54 @@ class ReplicaSet:
         ) from last_exc
 
     # ------------------------------------------------------------- disaggregation
+
+    def _submit_export(
+        self,
+        batchers: "List[Any]",
+        roles: "List[str]",
+        prompt: Sequence[int],
+        *,
+        max_new_tokens: Optional[int],
+        constraint: Optional[int],
+        deadline: Optional[float],
+        tenant: Optional[str] = None,
+        priority: Optional[int] = None,
+    ) -> "Iterator[np.ndarray]":
+        """Run an EXPORT prefill on this fleet: prefill-role replicas first,
+        then least-loaded, with the usual full-queue fall-through. The
+        returned stream carries the handoff payload for a DIFFERENT host's
+        decode fleet — this fleet never takes the residency."""
+        rank = {"prefill": 0, "mixed": 1, "decode": 2}
+        loads = [batcher.load() for batcher in batchers]
+        order = sorted(
+            range(len(batchers)), key=lambda i: (rank.get(roles[i], 1), loads[i], i)
+        )
+        last_exc: Optional[QueueFullError] = None
+        for replica in order:
+            try:
+                stream = batchers[replica].submit(
+                    prompt, max_new_tokens=max_new_tokens, constraint=constraint,
+                    deadline=deadline, export_handoff=True,
+                    tenant=tenant, priority=priority,
+                )
+            except TenantThrottled:
+                raise
+            except QueueFullError as exc:
+                last_exc = exc
+                continue
+            self._scheduler.note(replica, prompt)
+            return stream
+        with self._lock:
+            self.shed_queue_full += 1
+        raise QueueFullError(
+            f"all {len(batchers)} replicas' waiting queues are full"
+        ) from last_exc
+
+    def import_handoff(self, payload: Dict[str, Any]) -> "Iterator[np.ndarray]":
+        """Adopt another HOST's exported prefill onto this fleet's best decode
+        replica (the cluster coordinator's cross-host landing path; the same
+        decode → mixed → prefill fallback order as the in-fleet relay)."""
+        return self._import_payload(payload, current_trace())
 
     def _submit_disaggregated(
         self,
@@ -997,6 +1079,17 @@ class ReplicaSet:
         """Aggregate token-weighted load (the signal a layer above a fleet of
         ReplicaSets would schedule on, mirroring the engine's own)."""
         return sum(batcher.load() for batcher in self.batchers)
+
+    def cached_prefix_tokens(self, prompt: Sequence[int]) -> int:
+        """Longest radix-cached run of ``prompt`` across this fleet's replicas
+        — the per-HOST quantity the cluster coordinator's fleet-global prefix
+        routing compares (serving/cluster.py). 0 with no prefix caches."""
+        best = 0
+        for batcher in self.batchers:
+            probe = getattr(batcher, "cached_prefix_tokens", None)
+            if callable(probe):
+                best = max(best, int(probe(prompt)))
+        return best
 
     def health(self) -> Dict[str, Any]:
         """Fleet health (observability/health.py): mean + worst per-replica
