@@ -43,6 +43,7 @@ SCRIPTS = {
     "prefix_cache": "bench_prefix_cache.py",
     "disagg_serving": "bench_disagg_serving.py",
     "multitenant_qos": "bench_multitenant.py",
+    "traffic_replay": "bench_traffic_replay.py",
     "quantized_serving": "bench_quantized_serving.py",
     "replica_serving": "bench_replica_serving.py",
     "multihost_serving": "bench_multihost.py",
@@ -89,11 +90,15 @@ if _cpu_extra - set(SCRIPTS):
 #: multihost_serving pins the emulated 2-process fleet's aggregate tok/s
 #: PARITY against the single-process 2-replica fleet (>= 0.9x gate) plus the
 #: cross-host handoff transfer_ms — the control-plane boundary's cost, a
-#: same-substrate topology property by construction
+#: same-substrate topology property by construction; traffic_replay replays
+#: the four-scenario workload suite through the real HTTP stack against the
+#: same dispatch-bound synthetic regime — front-door scheduling under
+#: realistic open-loop arrivals, gated on schedule adherence and per-tenant
+#: SLO verdicts, same-substrate by construction
 CPU_ONLY = {
     "digits", "serving", "replica_serving", "continuous_stall", "prefix_cache",
     "quantized_serving", "observability", "fleet_health", "lint", "disagg_serving",
-    "multitenant_qos", "cold_start", "multihost_serving",
+    "multitenant_qos", "cold_start", "multihost_serving", "traffic_replay",
 } | _cpu_extra
 
 #: per-lane env overrides: lanes that reuse a script in a different mode

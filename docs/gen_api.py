@@ -63,6 +63,10 @@ MODULES = [
     "unionml_tpu.serving.replicas",
     "unionml_tpu.serving.serverless",
     "unionml_tpu.serving.tenancy",
+    "unionml_tpu.workloads.traces",
+    "unionml_tpu.workloads.scenarios",
+    "unionml_tpu.workloads.replayer",
+    "unionml_tpu.workloads.verdicts",
     "unionml_tpu.observability.trace",
     "unionml_tpu.observability.recorder",
     "unionml_tpu.observability.prometheus",

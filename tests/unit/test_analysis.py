@@ -1789,3 +1789,101 @@ def test_sarif_cli_format(tmp_path, capsys):
     # JSON schema version is untouched by the SARIF addition
     assert lint_main([str(bad), "--format", "json"]) == 1
     assert json.loads(capsys.readouterr().out)["version"] == 1
+
+
+# --------------------------------------------------------------------- TPU014
+
+
+def _lint_bench_source(tmp_path, source):
+    """TPU014 is path-scoped to benchmarks/ and workloads/: write the snippet
+    under a benchmarks dir so the rule engages."""
+    bench_dir = tmp_path / "benchmarks"
+    bench_dir.mkdir(exist_ok=True)
+    snippet = bench_dir / "bench_snippet.py"
+    snippet.write_text(textwrap.dedent(source))
+    return run_lint([snippet])
+
+
+def test_tpu014_flags_global_rng_draws_in_benchmarks(tmp_path):
+    result = _lint_bench_source(
+        tmp_path,
+        """
+        import random
+
+        import numpy as np
+
+
+        def arrivals(n):
+            offsets = [random.expovariate(2.0) for _ in range(n)]
+            prompts = np.random.randint(1, 90, size=8)
+            random.shuffle(offsets)
+            return offsets, prompts
+        """,
+    )
+    assert rule_ids(result) == ["TPU014", "TPU014", "TPU014"]
+    assert "random.expovariate" in result.findings[0].message
+    assert "np.random.randint" in result.findings[1].message
+    assert "random.Random(seed)" in result.findings[0].message  # the fix idiom
+
+
+def test_tpu014_seeded_generators_and_jax_keys_stay_clean(tmp_path):
+    # the fixed forms: Random(seed) instances, default_rng(seed) Generators,
+    # jax.random keys — and rng METHOD calls are never confused with module
+    # draws
+    result = _lint_bench_source(
+        tmp_path,
+        """
+        import random
+
+        import jax
+        import numpy as np
+
+
+        def arrivals(n, seed):
+            rng = random.Random(seed)
+            gen = np.random.default_rng(seed)
+            key = jax.random.PRNGKey(seed)
+            offsets = [rng.expovariate(2.0) for _ in range(n)]
+            prompts = gen.integers(1, 90, size=8)
+            noise = jax.random.normal(key, (4,))
+            return offsets, prompts, noise
+        """,
+    )
+    assert rule_ids(result) == []
+
+
+def test_tpu014_out_of_scope_paths_stay_clean(tmp_path):
+    # the same global draw OUTSIDE benchmarks/workloads is out of scope:
+    # library code that wants entropy (id minting) is not the rule's business
+    result = lint_source(
+        tmp_path,
+        """
+        import random
+
+
+        def jitter():
+            return random.random()
+        """,
+    )
+    assert rule_ids(result) == []
+
+
+def test_tpu014_workloads_scope_and_global_seed(tmp_path):
+    # unionml_tpu/workloads is in scope too, and global random.seed() — the
+    # "seeded but shared" trap — is flagged alongside the draws
+    wl = tmp_path / "workloads"
+    wl.mkdir()
+    snippet = wl / "scenario.py"
+    snippet.write_text(textwrap.dedent(
+        """
+        import random
+
+
+        def build(seed):
+            random.seed(seed)
+            return [random.randrange(90) for _ in range(4)]
+        """
+    ))
+    result = run_lint([snippet])
+    assert rule_ids(result) == ["TPU014", "TPU014"]
+    assert "random.seed" in result.findings[0].message

@@ -63,9 +63,12 @@ def test_tree_is_lint_clean():
     assert result.clean, "tpu-lint findings (fix, or suppress with justification):\n" + render_text(result)
     assert result.files > 50, "lint walked suspiciously few files — path wiring broke"
     # perf budget: the gate must not eat the tier-1 envelope. The cold run
-    # pays parse + project-index build + every rule check; 5s leaves headroom
-    # for tree growth without masking an accidentally quadratic rule
-    assert elapsed < 5.0, f"cold lint run took {elapsed:.1f}s (> 5s budget)"
+    # pays parse + project-index build + every rule check; the budget leaves
+    # headroom for tree growth without masking an accidentally quadratic rule
+    # (7s: the workloads subsystem + TPU014 put the ~100-file cold pass at
+    # ~4.6s ambient on this machine — 5s flaked under concurrent test load;
+    # the WARM assertion below is the contract that keeps the gate cheap)
+    assert elapsed < 7.0, f"cold lint run took {elapsed:.1f}s (> 7s budget)"
     # incremental contract: the content-hash index cache makes a warm run
     # skip parsing and per-file re-checks entirely — this is what keeps the
     # gate cheap as the tree grows (and what bench_lint.py tracks as

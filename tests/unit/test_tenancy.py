@@ -663,7 +663,9 @@ def test_openai_rejections(tiny):
         cases = [
             ({"prompt": "text prompt"}, "tokenizer"),
             ({"prompt": [1, 2], "n": 3}, "n"),
-            ({"prompt": [1, 2], "stop": ["x"]}, "stop"),
+            # stop=/logprobs are SUPPORTED now (docs/workloads.md PR); their
+            # happy paths and validation live in tests/unit/test_workloads.py
+            ({"prompt": [1, 2], "echo": True}, "echo"),
             ({"prompt": [1, 2], "max_tokens": 0}, "max_tokens"),
             ({"prompt": []}, "non-empty"),
             ({"prompt": ["a", "b"]}, "token ids"),

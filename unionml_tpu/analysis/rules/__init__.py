@@ -22,6 +22,7 @@ from unionml_tpu.analysis.rules.tpu010_lock_order import LockOrderCycle
 from unionml_tpu.analysis.rules.tpu011_recompile import RecompileHazard
 from unionml_tpu.analysis.rules.tpu012_contextvar import ContextvarExecutorHole
 from unionml_tpu.analysis.rules.tpu013_locked_collectives import BlockingCollectiveUnderLock
+from unionml_tpu.analysis.rules.tpu014_unseeded_random import UnseededRandomness
 
 __all__ = ["RULES"]
 
@@ -41,5 +42,6 @@ RULES = {
         RecompileHazard,
         ContextvarExecutorHole,
         BlockingCollectiveUnderLock,
+        UnseededRandomness,
     )
 }

@@ -294,6 +294,14 @@ SERVE_PROFILE_DIR_ENV_VAR = "UNIONML_TPU_PROFILE_DIR"
 #: must not leave the profiler running for hours.
 SERVE_PROFILE_MAX_MS = 60_000.0
 
+#: directory ``serve --record-traffic`` captures live traffic traces into
+#: (workloads/traces.py TraceRecorder); unset = capture off.
+SERVE_RECORD_TRAFFIC_ENV_VAR = "UNIONML_TPU_RECORD_TRAFFIC"
+
+#: record SHA-256 digests + lengths instead of prompt token ids (privacy
+#: posture for traces that leave the machine); 0/unset = literal ids.
+SERVE_RECORD_TRAFFIC_HASH_ENV_VAR = "UNIONML_TPU_RECORD_TRAFFIC_HASH"
+
 # ------------------------------------------------------------ SLOs / fleet health
 # Declarative serving SLO targets (observability/slo.py, docs/observability.md
 # "SLOs and fleet health"). Same early-export contract as the knobs above: the
@@ -591,6 +599,21 @@ def serve_profile_dir() -> "str | None":
     endpoint is disabled."""
     raw = os.environ.get(SERVE_PROFILE_DIR_ENV_VAR)
     return raw.strip() or None if raw is not None else None
+
+
+def serve_record_traffic() -> "str | None":
+    """Directory live traffic is captured into as replayable traces
+    (``serve --record-traffic``, workloads/traces.py); None = capture off.
+    Read at app construction, after the CLI's early export — an unusable
+    directory degrades at TraceRecorder construction (warn, capture off),
+    never at read time."""
+    raw = os.environ.get(SERVE_RECORD_TRAFFIC_ENV_VAR)
+    return raw.strip() or None if raw is not None else None
+
+
+def serve_record_traffic_hash() -> bool:
+    """Whether captured traces carry prompt digests instead of token ids."""
+    return env_int(SERVE_RECORD_TRAFFIC_HASH_ENV_VAR, 0, minimum=0) > 0
 
 
 def serve_slo_ttft_p95_ms() -> float:

@@ -480,11 +480,14 @@ class Generator:
 
         def decode_steps(p, cache, tok, lengths, done, key, *cstate, steps: int):
             """Roll ``steps`` decode steps from the carry; returns the new tokens
-            ``[B, steps]`` and the advanced carry. One ``lax.scan`` compile per
-            distinct ``steps`` value — __call__ always uses max_new_tokens - 1 and
-            stream() a fixed chunk size, so the trace set stays tiny. With
-            constraints the carry gains each row's DFA state as its tail element;
-            ``steps`` is keyword-only so both carry layouts share this signature."""
+            ``[B, steps]``, each sampled token's log-probability ``[B, steps]``
+            f32 (under the constrained policy distribution — the OpenAI
+            ``logprobs`` surface reads these; done rows report 0.0), and the
+            advanced carry. One ``lax.scan`` compile per distinct ``steps``
+            value — __call__ always uses max_new_tokens - 1 and stream() a
+            fixed chunk size, so the trace set stays tiny. With constraints the
+            carry gains each row's DFA state as its tail element; ``steps`` is
+            keyword-only so both carry layouts share this signature."""
             self.decode_traces += 1
             eos = config.eos_id
 
@@ -494,7 +497,15 @@ class Generator:
                 ps = dequant(p)  # per-step so int8, not bf16, is the steady-state HBM read
                 positions = lengths[:, None]  # each example's next free cache slot
                 hidden, cache = apply(ps, tok[:, None], positions, cache, (~done)[:, None])
-                nxt = sample_tokens(constrain(head(ps, hidden[:, 0]), cst), sub, config)
+                logits = constrain(head(ps, hidden[:, 0]), cst)
+                nxt = sample_tokens(logits, sub, config)
+                # the chosen token's logprob rides along (one gather + one
+                # logsumexp over logits the head already materialized — noise
+                # next to the matmul); done rows' pad "samples" report 0.0
+                lp = jnp.take_along_axis(
+                    jax.nn.log_softmax(logits, axis=-1), nxt[:, None], axis=1
+                )[:, 0]
+                lp = jnp.where(done, jnp.float32(0.0), lp)
                 if cs is not None:
                     # done rows hold their state (their sampled token is a pad)
                     cst = (jnp.where(done, cst[0], self._cs_trans[cst[0], nxt]),)
@@ -502,14 +513,14 @@ class Generator:
                 lengths = lengths + jnp.where(done, 0, 1)
                 if eos is not None:
                     done = done | (nxt == eos)
-                return (cache, nxt, lengths, done, key, *cst), nxt
+                return (cache, nxt, lengths, done, key, *cst), (nxt, lp)
 
-            carry, toks = jax.lax.scan(
+            carry, (toks, lps) = jax.lax.scan(
                 body, (cache, tok, lengths, done, key, *cstate), None, length=steps
             )
             # the advanced carry (incl. cache) is returned so the donated input
             # buffers have outputs to alias with — one cache in HBM throughout
-            return toks.T, carry
+            return toks.T, lps.T, carry
 
         # donate the cache through both stages: one cache lives in HBM, not two
         self._prefill = jax.jit(prefill, donate_argnums=(3,))
@@ -542,6 +553,10 @@ class Generator:
             "module_config": repr(getattr(self.module, "config", None)),
             "generation_config": repr(self.config),
             "quantize": self.quantize,
+            # bumped when a program's OUTPUT signature changes (the decode
+            # scan gained a logprobs output): stale serialized executables
+            # from an older layout must miss and recompile, not load
+            "program_abi": "decode-logprobs-v2",
             **mesh_context(self.mesh),
         }
         if self._cs is not None:
@@ -971,7 +986,7 @@ class Generator:
         first = np.asarray(tok0)[:, None]
         if steps <= 0:
             return first[:n]
-        rest, _ = self._decode(self.params, *carry, steps=steps)
+        rest, _, _ = self._decode(self.params, *carry, steps=steps)
         return np.concatenate([first, np.asarray(rest)], axis=1)[:n]
 
     def beam_search(
@@ -1162,7 +1177,7 @@ class Generator:
         while produced < cfg.max_new_tokens:
             if bool(np.asarray(carry[3]).all()):
                 return  # every row finished with eos
-            toks, carry = self._decode(self.params, *carry, steps=chunk_size)
+            toks, _, carry = self._decode(self.params, *carry, steps=chunk_size)
             take = min(chunk_size, cfg.max_new_tokens - produced)
             yield np.asarray(toks)[:n, :take]
             produced += take

@@ -34,7 +34,7 @@ Knobs flow the established serving path: engine/app kwargs <- ``serve
 from unionml_tpu.observability.health import engine_health, fleet_debug, fleet_health
 from unionml_tpu.observability.prometheus import render as render_prometheus
 from unionml_tpu.observability.recorder import FlightRecorder, active_recorder, set_active_recorder
-from unionml_tpu.observability.slo import SLOConfig, SLOTracker
+from unionml_tpu.observability.slo import SLOConfig, SLOTracker, TenantSLORegistry
 from unionml_tpu.observability.timeseries import BucketRing, EngineTimeseries
 from unionml_tpu.observability.trace import (
     REQUEST_ID_HEADER,
@@ -55,6 +55,7 @@ __all__ = [
     "RequestTrace",
     "SLOConfig",
     "SLOTracker",
+    "TenantSLORegistry",
     "Span",
     "Tracer",
     "active_recorder",

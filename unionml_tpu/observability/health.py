@@ -34,7 +34,7 @@ from typing import Any, Dict, List, Optional
 
 from unionml_tpu.observability.slo import STATE_CODES, worst_state
 
-__all__ = ["STATE_FACTORS", "engine_health", "fleet_health", "fleet_debug"]
+__all__ = ["STATE_FACTORS", "engine_health", "fleet_health", "fleet_debug", "merge_tenant_slo"]
 
 #: SLO state -> score ceiling: breach < warn < ok with no overlap once the
 #: saturation discount (at most 0.5x) is applied
@@ -128,23 +128,49 @@ def _replica_health(engine: Any, index: int) -> Dict[str, Any]:
     return entry
 
 
+def merge_tenant_slo(engines: "List[Any]") -> Dict[str, Any]:
+    """Fleet-wide per-tenant SLO view: each tenant's WORST replica entry (a
+    tenant breaching anywhere is breaching — the same worst-wins posture as
+    the fleet state). ``{}`` when no engine tracks tenant targets, so the
+    section stays absent on tenancy-off fleets (the byte-for-byte contract).
+    Every entry is an engine's own evaluate() dict — numeric/state leaves
+    only, never ``None``."""
+    merged: Dict[str, Any] = {}
+    for engine in engines:
+        fn = getattr(engine, "tenant_slo", None)
+        if not callable(fn):
+            continue
+        for tenant, entry in fn().items():
+            current = merged.get(tenant)
+            if current is None or int(entry.get("state_code", 0)) > int(current.get("state_code", 0)):
+                merged[tenant] = entry
+    return merged
+
+
 def fleet_health(batcher: Optional[Any]) -> Dict[str, Any]:
     """The ``GET /healthz`` payload body: fleet score/state plus each
-    replica's health (score, SLO states, saturation, windowed rates). A
-    ``None`` batcher (an app with no generation engine) is a healthy empty
-    fleet — the probe still answers, with the HTTP layer's own readiness."""
+    replica's health (score, SLO states, saturation, windowed rates) and,
+    when any tenant carries per-tenant targets, the fleet-wide ``tenant_slo``
+    section (worst replica wins per tenant). A ``None`` batcher (an app with
+    no generation engine) is a healthy empty fleet — the probe still
+    answers, with the HTTP layer's own readiness."""
     if batcher is None:
         return {"score": 1.0, "worst_score": 1.0, "state": "ok", "state_code": 0, "replicas": []}
-    entries = [_replica_health(engine, i) for i, engine in enumerate(_engines(batcher))]
+    engines = _engines(batcher)
+    entries = [_replica_health(engine, i) for i, engine in enumerate(engines)]
     scores = [entry["score"] for entry in entries]
     state = worst_state(entry["state"] for entry in entries)
-    return {
+    out = {
         "score": round(sum(scores) / len(scores), 3),
         "worst_score": min(scores),
         "state": state,
         "state_code": STATE_CODES[state],
         "replicas": entries,
     }
+    tenant_slo = merge_tenant_slo(engines)
+    if tenant_slo:
+        out["tenant_slo"] = tenant_slo
+    return out
 
 
 def fleet_debug(batcher: Optional[Any]) -> Dict[str, Any]:

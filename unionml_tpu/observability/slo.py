@@ -34,9 +34,10 @@ from __future__ import annotations
 
 import dataclasses
 import threading
-from typing import Any, Dict, Optional
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Optional
 
-__all__ = ["SLOConfig", "SLOTracker", "STATE_CODES", "worst_state"]
+__all__ = ["SLOConfig", "SLOTracker", "STATE_CODES", "TenantSLORegistry", "worst_state"]
 
 #: state -> numeric code, the Prometheus-safe rendering of the state machine
 #: (strings are skipped by the exposition; the code is the series)
@@ -249,3 +250,138 @@ class SLOTracker:
         with self._lock:
             self._states = {}
             self.breached_requests = 0
+
+
+class _TenantSLOEntry:
+    """One tenant's SLO state on one engine: its own windowed timeseries
+    (TTFT/TBT reservoirs + token/admission/shed rings) and burn-rate tracker.
+    Created lazily by :class:`TenantSLORegistry` — only tenants whose
+    :class:`~unionml_tpu.serving.tenancy.TenantSpec` arms a target ever get
+    one."""
+
+    __slots__ = ("timeseries", "tracker")
+
+    def __init__(self, config: SLOConfig, clock: Callable[[], float]):
+        from unionml_tpu.observability.timeseries import EngineTimeseries
+        from unionml_tpu.serving.metrics import LatencyWindow
+
+        self.timeseries = EngineTimeseries(
+            clock=clock,
+            horizon_s=config.slow_window_s,
+            ttft=LatencyWindow(clock=clock),
+            tbt=LatencyWindow(clock=clock),
+        )
+        self.tracker = SLOTracker(config)
+
+
+class TenantSLORegistry:
+    """Per-tenant SLO evaluation state, bounded (the TPU009 discipline).
+
+    The engine-level :class:`SLOTracker` judges the WHOLE engine; at
+    millions-of-users fidelity the question is per tenant — a hostile burst
+    tenant breaching its own targets while the well-behaved tenants stay
+    green is the multi-tenant QoS story told in SLO terms. This registry
+    keys one (timeseries, tracker) pair per tenant whose ``TenantSpec``
+    declares targets, in a **bounded LRU** (``max_tenants``, least-recently-
+    FED eviction) so request-controlled tenant-id cardinality can never grow
+    host memory — exactly the bug class tpu-lint TPU009 exists for.
+
+    Feed methods (``note_ttft``/``note_tbt``/``admitted``/``tokens``/
+    ``shed``) run on the engine thread at the existing observation sites and
+    cost one dict probe when the tenant has no armed targets; ``evaluate``
+    runs at scrape cadence on whatever thread snapshots ``stats()``.
+    ``config_for`` is the spec lookup (None = no targets armed = no state
+    ever created), injected so this module stays import-light."""
+
+    def __init__(
+        self,
+        config_for: "Callable[[str], Optional[SLOConfig]]",
+        *,
+        max_tenants: int = 64,
+        clock: "Optional[Callable[[], float]]" = None,
+    ):
+        import time as _time
+
+        if max_tenants < 1:
+            raise ValueError("max_tenants must be >= 1")
+        self._config_for = config_for
+        self._max_tenants = max_tenants
+        self._clock = clock if clock is not None else _time.monotonic
+        self._lock = threading.Lock()
+        #: tenant -> entry, least-recently-fed first (move_to_end per touch;
+        #: eviction pops the front — bounded by construction)
+        self._entries: "OrderedDict[str, _TenantSLOEntry]" = OrderedDict()
+        self.evicted = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def _entry(self, tenant: Optional[str]) -> "Optional[_TenantSLOEntry]":
+        if tenant is None:
+            return None
+        with self._lock:
+            entry = self._entries.get(tenant)
+            if entry is not None:
+                self._entries.move_to_end(tenant)
+                return entry
+        config = self._config_for(tenant)
+        if config is None or not config.armed:
+            return None
+        with self._lock:
+            entry = self._entries.get(tenant)
+            if entry is None:
+                entry = _TenantSLOEntry(config, self._clock)
+                self._entries[tenant] = entry
+                while len(self._entries) > self._max_tenants:
+                    self._entries.popitem(last=False)
+                    self.evicted += 1
+            self._entries.move_to_end(tenant)
+            return entry
+
+    # ------------------------------------------------------------------ feeds
+
+    def note_ttft(self, tenant: Optional[str], trace: Any, seconds: float) -> None:
+        entry = self._entry(tenant)
+        if entry is not None:
+            entry.timeseries.ttft.observe(seconds)
+            entry.tracker.note_ttft(trace, seconds * 1e3)
+
+    def note_tbt(self, tenant: Optional[str], trace: Any, seconds: float) -> None:
+        entry = self._entry(tenant)
+        if entry is not None:
+            entry.timeseries.tbt.observe(seconds)
+            entry.tracker.note_tbt(trace, seconds * 1e3)
+
+    def admitted(self, tenant: Optional[str]) -> None:
+        entry = self._entry(tenant)
+        if entry is not None:
+            entry.timeseries.admissions.add()
+
+    def tokens(self, tenant: Optional[str], n: int) -> None:
+        entry = self._entry(tenant)
+        if entry is not None and n > 0:
+            entry.timeseries.tokens.add(int(n))
+
+    def shed(self, tenant: Optional[str]) -> None:
+        entry = self._entry(tenant)
+        if entry is not None:
+            entry.timeseries.sheds.add()
+
+    # ------------------------------------------------------------------ reads
+
+    def evaluate(self) -> "Dict[str, Dict[str, Any]]":
+        """Every tracked tenant's SLO section (the ``tenant_slo`` block on
+        ``stats()``/``/metrics``/``/healthz``): ``{}`` when no tenant ever
+        armed — the tenancy-off byte-for-byte contract rides on that."""
+        with self._lock:
+            entries = list(self._entries.items())
+        return {
+            tenant: entry.tracker.evaluate(entry.timeseries)
+            for tenant, entry in sorted(entries)
+        }
+
+    def clear(self) -> None:
+        """Drop every tenant's state (the engine's warmup reset)."""
+        with self._lock:
+            self._entries.clear()

@@ -121,6 +121,26 @@ class ServingApp:
 
         self.tenancy = TenantRegistry.from_env()
         set_active_registry(self.tenancy)
+        # ---- traffic capture (docs/workloads.md): serve --record-traffic DIR
+        # captures parsed /v1 + /predict-stream requests into replayable
+        # traces through the process-wide TraceRecorder (the flight-recorder
+        # install pattern). None = capture off, the zero-cost default.
+        from unionml_tpu.defaults import serve_record_traffic, serve_record_traffic_hash
+        from unionml_tpu.workloads.traces import TraceRecorder, set_active_traffic_recorder
+
+        self.traffic_recorder: Optional[TraceRecorder] = None
+        record_dir = serve_record_traffic()
+        if record_dir is not None:
+            try:
+                self.traffic_recorder = TraceRecorder(
+                    record_dir, hash_prompts=serve_record_traffic_hash()
+                )
+            except OSError as exc:  # unwritable dir: warn and serve uncaptured
+                logger.warning(
+                    f"could not open traffic capture directory {record_dir!r} ({exc}); "
+                    "capture disabled"
+                )
+        set_active_traffic_recorder(self.traffic_recorder)
         # correlated access logs come free once either correlation signal is
         # on: tracing (timeline ids) or JSON log lines (request_id field)
         self.server.access_log = (
@@ -370,6 +390,52 @@ class ServingApp:
             set_active_registry(self.tenancy)
         return self
 
+    def configure_traffic_capture(
+        self,
+        record_traffic: Optional[str] = None,
+        hash_prompts: Optional[bool] = None,
+    ) -> "ServingApp":
+        """Override the ``serve --record-traffic`` capture knobs
+        (docs/workloads.md): ``record_traffic`` points (or, empty string,
+        clears) the capture directory, ``hash_prompts`` switches the privacy
+        digest mode. Rebuilds and reinstalls the process-wide recorder, like
+        :meth:`configure_tenancy` does its registry."""
+        import os as _os
+
+        from unionml_tpu.defaults import (
+            SERVE_RECORD_TRAFFIC_ENV_VAR,
+            SERVE_RECORD_TRAFFIC_HASH_ENV_VAR,
+            serve_record_traffic,
+            serve_record_traffic_hash,
+        )
+        from unionml_tpu.workloads.traces import TraceRecorder, set_active_traffic_recorder
+
+        if record_traffic is None and hash_prompts is None:
+            return self
+        if record_traffic is not None:
+            if record_traffic:
+                _os.environ[SERVE_RECORD_TRAFFIC_ENV_VAR] = str(record_traffic)
+            else:
+                _os.environ.pop(SERVE_RECORD_TRAFFIC_ENV_VAR, None)
+        if hash_prompts is not None:
+            _os.environ[SERVE_RECORD_TRAFFIC_HASH_ENV_VAR] = "1" if hash_prompts else "0"
+        if self.traffic_recorder is not None:
+            self.traffic_recorder.close()
+            self.traffic_recorder = None
+        directory = serve_record_traffic()
+        if directory is not None:
+            try:
+                self.traffic_recorder = TraceRecorder(
+                    directory, hash_prompts=serve_record_traffic_hash()
+                )
+            except OSError as exc:
+                logger.warning(
+                    f"could not open traffic capture directory {directory!r} ({exc}); "
+                    "capture disabled"
+                )
+        set_active_traffic_recorder(self.traffic_recorder)
+        return self
+
     def _replica_gauge(self) -> Optional[Any]:
         batcher = getattr(self.model, "generation_batcher", None)
         loads = getattr(batcher, "replica_loads", None)
@@ -386,6 +452,15 @@ class ServingApp:
                 batcher.close(wait=False)
             except Exception:  # pragma: no cover - defensive
                 logger.exception("generation batcher close failed during drain")
+        # a live traffic capture flushes per line; the drain close makes the
+        # trace file complete (and logs where it went) before the process exits
+        if self.traffic_recorder is not None:
+            try:
+                path = self.traffic_recorder.close()
+                if path is not None:
+                    logger.info(f"traffic capture written to {path}")
+            except Exception:  # pragma: no cover - defensive
+                logger.exception("traffic capture close failed during drain")
         # postmortem on the way out: whatever timelines the recorder holds
         # (requests that never finished included) reach the log before the
         # process exits — skipped when tracing never recorded anything
@@ -585,6 +660,10 @@ class ServingApp:
             # bounded, so the label cardinality this mints is too. Absent
             # entirely when tenancy is off (the byte-for-byte contract).
             snapshot["tenants"] = self.tenancy.stats()
+        if self.traffic_recorder is not None:
+            # traffic capture counters (serve --record-traffic): absent with
+            # capture off, ints only — the no-None-gauge contract
+            snapshot["traffic_capture"] = self.traffic_recorder.stats()
         if fmt == "prometheus":
             return 200, render_prometheus(snapshot), "text/plain; version=0.0.4"
         return 200, snapshot, "application/json"
@@ -770,6 +849,20 @@ class ServingApp:
             raise HTTPError(500, "features must be supplied.")
         if self.model.artifact is None:
             raise HTTPError(500, "Model artifact not found.")
+        from unionml_tpu.workloads.traces import active_traffic_recorder
+
+        traffic = active_traffic_recorder()
+        if traffic is not None:
+            # the /predict-stream capture keeps the raw (validated) body: its
+            # features need not be token ids, so the replayer re-sends the
+            # body verbatim (docs/workloads.md)
+            from unionml_tpu.serving.tenancy import current_priority, current_tenant, priority_name
+
+            priority = current_priority()
+            traffic.record(
+                "/predict-stream", body=payload, tenant=current_tenant(),
+                priority=priority_name(priority) if priority is not None else None,
+            )
         loop = asyncio.get_running_loop()
         sentinel = object()
         # run_in_executor does NOT propagate contextvars — but a generator

@@ -882,11 +882,15 @@ class FleetCoordinator:
         return self._submit_routed(probes, prompt, kwargs)
 
     def _order(
-        self, probes: "Dict[int, Dict[str, Any]]", prompt: Sequence[int]
-    ) -> "Tuple[List[int], bool]":
+        self, probes: "Dict[int, Dict[str, Any]]", prompt: Sequence[int],
+        tenant: Optional[str] = None,
+    ) -> "Tuple[List[int], Any]":
         """The scheduler's host order over the full (stable-index) host list;
         dead/unprobed hosts rank last via infinite load + avoid flags and are
-        filtered from the returned walk."""
+        filtered from the returned walk. ``tenant`` arms HOST-level tenant
+        session affinity: when no host's radix probe is warm for this prompt,
+        the host that last served the tenant heads the walk (margin-gated) —
+        its radix tier holds the tenant's recent sessions."""
         n = len(self.hosts)
         loads = [probes[i]["load"] if i in probes else math.inf for i in range(n)]
         cached = [probes[i]["cached"] if i in probes else 0 for i in range(n)]
@@ -897,6 +901,7 @@ class FleetCoordinator:
             cached if max(cached, default=0) > 0 else None,
             breaching,
             deprioritized if any(deprioritized) else None,
+            tenant=tenant,
         )
         return [i for i in order if i in probes], affinity_head
 
@@ -906,7 +911,12 @@ class FleetCoordinator:
         prompt: Sequence[int],
         kwargs: Dict[str, Any],
     ) -> "Iterator[np.ndarray]":
-        order, affinity_head = self._order(probes, prompt)
+        tenant = kwargs.get("tenant")
+        if tenant is None:
+            from unionml_tpu.serving.tenancy import current_tenant
+
+            tenant = current_tenant()
+        order, affinity_head = self._order(probes, prompt, tenant)
         last_exc: Optional[BaseException] = None
         for index in order:
             try:
@@ -920,7 +930,11 @@ class FleetCoordinator:
                 self._note_failure()
                 last_exc = exc
                 continue
-            self._scheduler.note(index, prompt, affinity=affinity_head and index == order[0])
+            self._scheduler.note(
+                index, prompt,
+                affinity=affinity_head if index == order[0] else False,
+                tenant=tenant,
+            )
             return stream
         with self._lock:
             self.shed_queue_full += 1

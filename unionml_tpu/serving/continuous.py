@@ -72,7 +72,7 @@ from unionml_tpu.defaults import (
     serve_replica_roles,
 )
 from unionml_tpu.observability.trace import current_trace
-from unionml_tpu.observability.slo import SLOConfig, SLOTracker
+from unionml_tpu.observability.slo import SLOConfig, SLOTracker, TenantSLORegistry
 from unionml_tpu.observability.timeseries import EngineTimeseries
 from unionml_tpu.serving.aot import AOTFunction, resolve_store
 from unionml_tpu.serving.metrics import LatencyWindow
@@ -195,6 +195,14 @@ class _Session:
     #: keep the engine on its historical FIFO path exactly
     tenant: Optional[str] = None
     priority: int = PRIORITY_NORMAL
+    #: OpenAI ``logprobs`` support: when True the engine appends each emitted
+    #: token's log-probability (from the decode scan's ride-along output) to
+    #: ``lp`` BEFORE enqueueing the tokens, so a consumer that has read k
+    #: tokens can always read k logprobs off the stream. Off (the default)
+    #: costs nothing — the scan computes the column either way, the engine
+    #: just doesn't copy it host-side.
+    want_logprobs: bool = False
+    lp: "List[float]" = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass(eq=False)  # identity semantics: fields hold device arrays
@@ -269,6 +277,15 @@ class _TokenStream:
 
     def close(self) -> None:
         self._batcher._cancel(self._session)
+
+    @property
+    def logprobs(self) -> "List[float]":
+        """Log-probabilities of the tokens emitted so far (``submit(...,
+        logprobs=True)`` streams only). The engine appends each chunk's
+        logprobs BEFORE enqueueing its tokens, so after consuming k tokens at
+        least k entries are here — the OpenAI surface slices them chunk by
+        chunk."""
+        return list(self._session.lp)
 
     @property
     def handoff(self) -> "Optional[Dict[str, Any]]":
@@ -777,6 +794,21 @@ class ContinuousBatcher:
             self.timeseries = EngineTimeseries(
                 horizon_s=slo_config.slow_window_s, ttft=self._ttft, tbt=self._tbt
             )
+        #: PER-TENANT SLO keying (ROADMAP 4(a), docs/observability.md): one
+        #: bounded-LRU (timeseries, tracker) pair per tenant whose TenantSpec
+        #: arms slo_* targets, fed at the same observation sites as the
+        #: engine-level tracker. Empty — and absent from stats() — unless a
+        #: registry with armed per-tenant targets sees traffic, so tenancy-off
+        #: (and target-less) engines stay byte-for-byte unchanged; slo=False
+        #: disables the layer with the rest of the windowed telemetry.
+        self._tenant_slo: Optional[TenantSLORegistry] = (
+            TenantSLORegistry(self._tenant_slo_config) if self.timeseries is not None else None
+        )
+        #: lazily-jitted first-token logprob program (logprobs=True submits
+        #: only): the decode scan carries logprobs for every DECODED token,
+        #: but the prompt-sampled first token needs one extra head+gather over
+        #: the admission's accumulated last-hidden row
+        self._lp0_fn = None
         #: cached health evaluation (observability/health.engine_health): the
         #: replica scheduler consults health per routing decision, so the full
         #: evaluation (reservoir sorts + SLO state machine) runs at most once
@@ -1059,6 +1091,7 @@ class ContinuousBatcher:
         prefix: Optional[PrefixCache] = None,
         budget: Optional[int] = None,
         dfa_state: Optional[int] = None,
+        allow_sp: bool = True,
     ):
         """Prefill one prompt at batch 1 into a fresh [1, cache_len] cache using
         the Generator's own jitted machinery — identical numerics and the same
@@ -1069,7 +1102,14 @@ class ContinuousBatcher:
         model and its prefix rows (speculative mode prefills the draft's row
         with the DRAFT's prefix). ``budget`` is THIS request's remaining token
         budget (default: the config's) — feasibility and the resume-width
-        fallback below depend on it, not on the config worst case."""
+        fallback below depend on it, not on the config worst case.
+
+        Returns ``(tok0, lengths, row_cache, last)`` — ``last`` is the
+        prompt's last-token hidden row (``None`` only on the sequence-parallel
+        path, which does not surface it); a ``logprobs=True`` admission reads
+        it to price the prompt-sampled token, and ``allow_sp=False`` keeps
+        such admissions on the dense prefill (token-identical by the
+        sp==dense contract) so the row is always available."""
         cfg = self.gen.config
         if gen is None:
             gen, prefix = self.gen, self.prefix
@@ -1106,6 +1146,7 @@ class ContinuousBatcher:
         # the request's current DFA state masks the prompt-sampled token, same
         # as Generator._start's cstate tail (batch-1 row here)
         cstate = () if dfa_state is None else (jnp.asarray([dfa_state], jnp.int32),)
+        last = None
         if prefix is not None:
             chunk = cfg.prefill_chunk or bucket
             aligned = chunk_aligned(bucket, chunk)  # ragged tails would cost one
@@ -1121,7 +1162,8 @@ class ContinuousBatcher:
             )
             tok0 = gen._first_token(gen.params, last, key, *cstate)
         elif (
-            gen.config.sp_prefill
+            allow_sp
+            and gen.config.sp_prefill
             and gen.mesh is not None
             and int(gen.mesh.shape.get("sequence", 1)) > 1
             and chunk_aligned(bucket, int(gen.mesh.shape["sequence"])) <= self.cache_len
@@ -1147,10 +1189,10 @@ class ContinuousBatcher:
                 gen.params, jnp.asarray(tokens), lengths, row_cache, key, row_valid, *cstate
             )
         else:
-            tok0, row_cache, _ = gen._prefill(
+            tok0, row_cache, last = gen._prefill(
                 gen.params, jnp.asarray(tokens), lengths, row_cache, key, row_valid, *cstate
             )
-        return tok0, lengths, row_cache
+        return tok0, lengths, row_cache, last
 
     def _table_entries(self, tokens: int) -> int:
         """Block-table entries covering positions ``[0, tokens)``."""
@@ -1201,11 +1243,54 @@ class ContinuousBatcher:
         construction — the serve startup order — still applies."""
         return self._tenancy if self._tenancy is not None else active_registry()
 
+    def _tenant_slo_config(self, tenant: str) -> "Optional[SLOConfig]":
+        """A tenant's per-tenant SLO targets (None = none armed — the
+        TenantSLORegistry never creates state for such a tenant)."""
+        registry = self._registry()
+        if registry is None:
+            return None
+        return registry.spec(tenant).slo_config()
+
+    def _tenant_shed(self, tenant: Optional[str]) -> None:
+        """Feed a shed into the tenant's SLO timeseries (one None test when
+        per-tenant SLOs are off; called at every engine shed site)."""
+        if self._tenant_slo is not None and tenant is not None:
+            self._tenant_slo.shed(tenant)
+
+    def tenant_slo(self) -> "Dict[str, Any]":
+        """Per-tenant SLO verdicts (``{}`` with none tracked) — the section
+        ``stats()``/``/metrics`` carry and ``/healthz`` merges fleet-wide."""
+        if self._tenant_slo is None:
+            return {}
+        return self._tenant_slo.evaluate()
+
+    def _first_logprob(self, adm: "_Admission") -> Optional[float]:
+        """The prompt-sampled first token's log-probability (logprobs=True
+        admissions): one lazily-jitted head+log-softmax gather over the
+        admission's accumulated last-hidden row — the same constrained policy
+        distribution the token was sampled from, so it matches the decode
+        scan's ride-along logprobs exactly."""
+        if adm.last is None:
+            return None  # no hidden state retained (shouldn't happen: sp is fenced)
+        gen = self.gen
+        if self._lp0_fn is None:
+            compute_dtype = getattr(gen.module.config, "dtype", jnp.bfloat16)
+
+            def impl(p, last, tok, *cstate):
+                p = gen._dequant_params(p)
+                logits = gen._constrain(gen._head_fn(p, last.astype(compute_dtype)), cstate)
+                return jnp.take_along_axis(
+                    jax.nn.log_softmax(logits, axis=-1), tok[:, None], axis=1
+                )[:, 0]
+
+            self._lp0_fn = jax.jit(impl)
+        return float(np.asarray(self._lp0_fn(gen.params, adm.last, adm.tok0, *adm.cstate))[0])
+
     def submit(
         self, prompt: Sequence[int], *, max_new_tokens: Optional[int] = None,
         constraint: Optional[int] = None, deadline: Optional[float] = None,
         export_handoff: bool = False, tenant: Optional[str] = None,
-        priority: Optional[int] = None,
+        priority: Optional[int] = None, logprobs: bool = False,
     ) -> Iterator[np.ndarray]:
         """Enqueue a prompt; returns an iterator of 1-D int32 arrays of new
         tokens (first item is the prompt-sampled token). Blocks-free: the
@@ -1240,6 +1325,17 @@ class ContinuousBatcher:
             raise ValueError(
                 "export_handoff does not compose with speculative decoding (config.draft)"
             )
+        if logprobs and self._spec is not None:
+            raise ValueError(
+                "logprobs does not compose with speculative decoding (config.draft) yet: "
+                "accepted draft tokens carry no per-token policy logprob"
+            )
+        if logprobs and export_handoff:
+            raise ValueError(
+                "logprobs does not compose with export_handoff: the logprob column "
+                "does not ride the KV handoff payload (the replica layer routes "
+                "logprobs requests onto a decode/mixed replica directly)"
+            )
         req_trace = current_trace() if self.trace_requests else None
         if expired(deadline):
             # under the lock: submit runs on arbitrary executor threads, and the
@@ -1248,6 +1344,7 @@ class ContinuousBatcher:
                 self.shed_deadline += 1
                 if self.timeseries is not None:
                     self.timeseries.sheds.add()
+            self._tenant_shed(tenant if tenant is not None else current_tenant())
             if req_trace is not None:
                 req_trace.event("engine.shed_deadline", phase="submit")
             raise DeadlineExceeded("deadline expired before the prompt was enqueued")
@@ -1288,7 +1385,7 @@ class ContinuousBatcher:
         session = _Session(
             slot=-1, out=queue.Queue(), max_new=budget, grammar=grammar, deadline=deadline,
             created_at=time.monotonic(), trace=req_trace, export=export_handoff,
-            tenant=tenant, priority=priority,
+            tenant=tenant, priority=priority, want_logprobs=bool(logprobs),
             # the original prompt is retained only where preemption can resume it
             prompt=list(prompt) if self.block_size is not None else [],
         )
@@ -1307,6 +1404,7 @@ class ContinuousBatcher:
                 self.shed_queue_full += 1
                 if self.timeseries is not None:
                     self.timeseries.sheds.add()
+                self._tenant_shed(tenant)
                 if req_trace is not None:
                     req_trace.event("engine.shed_queue_full", waiting=waiting)
                 raise QueueFullError(
@@ -1323,6 +1421,7 @@ class ContinuousBatcher:
                     self.shed_tenant_limit += 1
                     if self.timeseries is not None:
                         self.timeseries.sheds.add()
+                    self._tenant_shed(tenant)
                     if req_trace is not None:
                         req_trace.event(
                             "engine.shed_tenant_limit", tenant=tenant,
@@ -1481,6 +1580,8 @@ class ContinuousBatcher:
                 self.timeseries.clear()
             if self.slo is not None:
                 self.slo.reset()  # a slow compile-paying probe is not a breach
+            if self._tenant_slo is not None:
+                self._tenant_slo.clear()  # probe traffic is nobody's tenant SLO
             self._grammar_counts.clear()  # warmup probes all ride FREE (id 0)
             if self._spec is not None:
                 # the carry's device-side ride-along counters are NOT reset;
@@ -1739,6 +1840,11 @@ class ContinuousBatcher:
             }
         if self.slo is not None and self.slo.armed:
             snapshot["slo"] = self.slo.evaluate(self.timeseries)
+        if self._tenant_slo is not None and len(self._tenant_slo):
+            # per-tenant SLO verdicts (bounded LRU of tenants with armed
+            # targets): absent entirely until such a tenant sends traffic —
+            # the tenancy-off byte-for-byte contract
+            snapshot["tenant_slo"] = self._tenant_slo.evaluate()
         return snapshot
 
     def quiesce(self) -> None:
@@ -1896,6 +2002,7 @@ class ContinuousBatcher:
                     self.shed_deadline += 1
                     if self.timeseries is not None:
                         self.timeseries.sheds.add()
+                    self._tenant_shed(s.tenant)
                     _tev(s, "engine.shed_deadline", phase="waiting")
                     s.out.put(DeadlineExceeded(
                         "deadline exceeded while waiting for a decode slot"
@@ -2154,6 +2261,7 @@ class ContinuousBatcher:
                 self.shed_deadline += 1
                 if self.timeseries is not None:
                     self.timeseries.sheds.add()
+                self._tenant_shed(session.tenant)
                 _tev(session, "engine.shed_deadline", phase="prefill")
                 session.out.put(DeadlineExceeded(
                     "deadline exceeded mid-prefill; admission abandoned"
@@ -2232,8 +2340,11 @@ class ContinuousBatcher:
             # the shard_map), or an exact-width resume whose chunk-aligned
             # width would overflow the cache (the fallback keeps the resume's
             # token-exactness guarantee instead of failing the stream)
-            adm.tok0, adm.row_len, adm.row_cache = self._prefill_row(
-                prompt, adm.seed, budget=adm.budget, dfa_state=dfa_state
+            adm.tok0, adm.row_len, adm.row_cache, adm.last = self._prefill_row(
+                prompt, adm.seed, budget=adm.budget, dfa_state=dfa_state,
+                # logprobs admissions keep the dense prefill (token-identical
+                # to sp) so the last-hidden row is retained for tok0's logprob
+                allow_sp=not session.want_logprobs,
             )
             if self._spec is not None:
                 # the draft's cache row: same prompt through the draft model
@@ -2242,7 +2353,7 @@ class ContinuousBatcher:
                 # SpeculativeGenerator._start_state). dfa_state rides along:
                 # the draft Generator shares the constraints config, so its
                 # prefill closure requires the state argument too
-                _, _, adm.d_row_cache = self._prefill_row(
+                _, _, adm.d_row_cache, _ = self._prefill_row(
                     prompt, adm.seed, gen=self._spec._draft, prefix=self._draft_prefix,
                     budget=adm.budget, dfa_state=dfa_state,
                 )
@@ -2480,6 +2591,10 @@ class ContinuousBatcher:
                 self._ttft.observe(now - session.created_at)
                 if self.slo is not None:
                     self.slo.note_ttft(session.trace, (now - session.created_at) * 1e3)
+                if self._tenant_slo is not None and session.tenant is not None:
+                    self._tenant_slo.note_ttft(
+                        session.tenant, session.trace, now - session.created_at
+                    )
                 _tev(
                     session, "engine.first_token",
                     ttft_ms=round((now - session.created_at) * 1e3, 3),
@@ -2492,6 +2607,9 @@ class ContinuousBatcher:
             if self.timeseries is not None:
                 self.timeseries.admissions.add()
                 self.timeseries.tokens.add()
+            if self._tenant_slo is not None and session.tenant is not None:
+                self._tenant_slo.admitted(session.tenant)
+                self._tenant_slo.tokens(session.tenant, 1)
             registry = self._registry()
             if registry is not None:
                 registry.charge_tokens(session.tenant, 1)
@@ -2538,6 +2656,11 @@ class ContinuousBatcher:
         ride-along writes corrupting reallocated pages)."""
         cfg = self.gen.config
         session, slot = adm.session, adm.slot
+        lp0: Optional[float] = None
+        if session.want_logprobs and session.pending_import is None:
+            # priced BEFORE the paste: the paste donates the row cache and the
+            # epilogue below drops the last-hidden reference
+            lp0 = self._first_logprob(adm)
         try:
             if self._carry is None:
                 self._carry = self._init_carry()
@@ -2633,8 +2756,12 @@ class ContinuousBatcher:
                 session.last_emit = time.monotonic()
                 if self.timeseries is not None:
                     self.timeseries.admissions.add()
+                if self._tenant_slo is not None and session.tenant is not None:
+                    self._tenant_slo.admitted(session.tenant)
                 self.handoffs_imported += 1
             else:
+                if session.want_logprobs and lp0 is not None:
+                    session.lp.append(lp0)  # before the token: k tokens => >= k logprobs
                 session.out.put(first)
                 now = time.monotonic()
                 if session.produced == 0:
@@ -2643,6 +2770,10 @@ class ContinuousBatcher:
                     self._ttft.observe(now - session.created_at)
                     if self.slo is not None:
                         self.slo.note_ttft(session.trace, (now - session.created_at) * 1e3)
+                    if self._tenant_slo is not None and session.tenant is not None:
+                        self._tenant_slo.note_ttft(
+                            session.tenant, session.trace, now - session.created_at
+                        )
                     _tev(
                         session, "engine.first_token",
                         ttft_ms=round((now - session.created_at) * 1e3, 3),
@@ -2652,10 +2783,17 @@ class ContinuousBatcher:
                     self._tbt.observe(now - session.last_emit)
                     if self.slo is not None:
                         self.slo.note_tbt(session.trace, (now - session.last_emit) * 1e3)
+                    if self._tenant_slo is not None and session.tenant is not None:
+                        self._tenant_slo.note_tbt(
+                            session.tenant, session.trace, now - session.last_emit
+                        )
                 session.last_emit = now
                 if self.timeseries is not None:
                     self.timeseries.admissions.add()
                     self.timeseries.tokens.add()
+                if self._tenant_slo is not None and session.tenant is not None:
+                    self._tenant_slo.admitted(session.tenant)
+                    self._tenant_slo.tokens(session.tenant, 1)
                 registry = self._registry()
                 if registry is not None:
                     registry.charge_tokens(session.tenant, 1)
@@ -2928,9 +3066,10 @@ class ContinuousBatcher:
         if self._spec is not None:
             return self._spec_chunk()
         cfg = self.gen.config
-        toks, carry = self.gen._decode(self.gen.params, *self._carry, steps=self.decode_chunk)
+        toks, lps, carry = self.gen._decode(self.gen.params, *self._carry, steps=self.decode_chunk)
         self._carry = carry
         toks_np = np.asarray(toks)  # [S, chunk]; also fences the dispatch
+        lps_np = np.asarray(lps)  # [S, chunk] f32: each sampled token's logprob
         done_np = np.asarray(carry[3])
         registry = self._registry()
         with self._lock:
@@ -2946,6 +3085,10 @@ class ContinuousBatcher:
                     if hits.size:
                         take = min(take, int(hits[0]) + 1)  # emit the eos, stop after
                 if take > 0:
+                    if session.want_logprobs:
+                        # BEFORE the tokens enqueue: a consumer holding k
+                        # tokens must always find >= k logprobs on the stream
+                        session.lp.extend(float(v) for v in lps_np[slot][:take])
                     session.out.put(row[:take].copy())
                     if registry is not None:
                         # post-charge the tenant's generated-tokens bucket:
@@ -2956,12 +3099,18 @@ class ContinuousBatcher:
                         self._tbt.observe(now - session.last_emit)
                         if self.slo is not None:
                             self.slo.note_tbt(session.trace, (now - session.last_emit) * 1e3)
+                        if self._tenant_slo is not None and session.tenant is not None:
+                            self._tenant_slo.note_tbt(
+                                session.tenant, session.trace, now - session.last_emit
+                            )
                     session.last_emit = now
                     if self.block_size is not None:
                         session.echo.extend(int(t) for t in row[:take])
                     session.produced += take
                     if self.timeseries is not None:
                         self.timeseries.tokens.add(take)
+                    if self._tenant_slo is not None and session.tenant is not None:
+                        self._tenant_slo.tokens(session.tenant, take)
                     _tev(session, "engine.emit", tokens=take, produced=session.produced)
                 device_done = bool(done_np[slot])
                 if session.produced >= session.max_new or device_done:
@@ -3016,12 +3165,18 @@ class ContinuousBatcher:
                         self._tbt.observe(now - session.last_emit)
                         if self.slo is not None:
                             self.slo.note_tbt(session.trace, (now - session.last_emit) * 1e3)
+                        if self._tenant_slo is not None and session.tenant is not None:
+                            self._tenant_slo.note_tbt(
+                                session.tenant, session.trace, now - session.last_emit
+                            )
                     session.last_emit = now
                     if self.block_size is not None:
                         session.echo.extend(int(t) for t in new)
                     session.produced = session.resident_base + int(prod_np[slot])
                     if self.timeseries is not None:
                         self.timeseries.tokens.add(int(new.size))
+                    if self._tenant_slo is not None and session.tenant is not None:
+                        self._tenant_slo.tokens(session.tenant, int(new.size))
                     _tev(session, "engine.emit", tokens=int(new.size), produced=session.produced)
                 if bool(done_np[slot]):
                     self._finish_locked(slot, device_done=True)

@@ -15,21 +15,34 @@ Compatibility matrix (docs/serving.md "Multi-tenant QoS" carries the table):
 
 - supported: ``prompt`` (string with a tokenizer, or a token-id list),
   ``messages``, ``max_tokens`` (clipped to the engine's configured budget),
-  ``stream``, ``model`` (echoed), per-request deadlines via the stack's
-  ``X-Request-Deadline-Ms``, 429 + ``Retry-After`` sheds, ``X-Tenant-Id`` /
-  ``X-Priority`` QoS headers;
+  ``stream``, ``model`` (echoed), ``stop`` (string or list — emission
+  truncates at the earliest match with ``finish_reason: "stop"``, the same
+  truncate-at-match semantics the grammar ``stop_sequences`` constraint
+  enforces device-side; here the scan runs server-side at the emission
+  boundary so ARBITRARY per-request stop strings work without a recompile),
+  ``logprobs`` (completions int/bool; chat ``logprobs: true``) — the sampled
+  token's log-probability from the decode scan rides every stream chunk and
+  the final choice (``top_logprobs`` beyond the sampled token are not
+  computed), per-request deadlines via the stack's ``X-Request-Deadline-Ms``,
+  429 + ``Retry-After`` sheds, ``X-Tenant-Id`` / ``X-Priority`` QoS headers;
 - accepted but inert: ``temperature``/``top_p``/seeds — the sampling policy is
   fixed server-side by the engine's :class:`GenerationConfig` (every resident
   stream shares one compiled decode program);
-- rejected with 400: ``n``/``best_of`` > 1, ``logprobs``, ``echo``,
-  ``suffix``, ``stop`` (use the grammar-constraint machinery instead), string
-  prompts without a ``model.tokenizer``.
+- rejected with 400: ``n``/``best_of`` > 1, ``echo``, ``suffix``, string
+  prompts without a ``model.tokenizer``, and ``logprobs`` on engines that
+  cannot surface it (speculative decoding, the multi-host coordinator).
 
 Tokenizer contract: ``model.tokenizer`` with ``encode(str) -> list[int]`` and
 ``decode(list[int]) -> str`` (``apply_chat_template(messages) -> str``
 honored when present). Without one, prompts must be token-id lists and
 completion ``text`` falls back to space-joined token ids — enough for tests
 and id-level clients, stated in the matrix.
+
+Traffic capture: with ``serve --record-traffic DIR`` armed, every parsed
+``/v1`` request taps the process-wide
+:class:`~unionml_tpu.workloads.traces.TraceRecorder` (token ids, budget,
+tenant, priority, stream flag) — the capture side of the record→replay→verdict
+loop (docs/workloads.md).
 """
 
 from __future__ import annotations
@@ -53,7 +66,95 @@ _DEFAULT_MAX_TOKENS = 16
 
 #: request knobs we cannot honor silently — a client that sets them gets a
 #: clear 400 instead of subtly different completions
-_UNSUPPORTED = ("n", "best_of", "logprobs", "echo", "suffix", "stop", "tools", "functions")
+_UNSUPPORTED = ("n", "best_of", "echo", "suffix", "tools", "functions")
+
+#: OpenAI caps stop at 4 sequences; matching that bound keeps the per-chunk
+#: scan trivially cheap
+_MAX_STOPS = 4
+
+
+def _parse_stop(payload: "Dict[str, Any]") -> "List[str]":
+    """The request's ``stop`` as a list of non-empty strings ([] = none)."""
+    raw = payload.get("stop")
+    if raw is None:
+        return []
+    stops = [raw] if isinstance(raw, str) else raw
+    if (
+        not isinstance(stops, list)
+        or not stops
+        or len(stops) > _MAX_STOPS
+        or any(not isinstance(s, str) or not s for s in stops)
+    ):
+        raise HTTPError(
+            400,
+            f"stop must be a non-empty string or a list of 1-{_MAX_STOPS} "
+            f"non-empty strings, got {raw!r}",
+        )
+    return list(stops)
+
+
+def _parse_logprobs(payload: "Dict[str, Any]", *, chat: bool) -> bool:
+    """Whether the request wants per-token logprobs. Chat uses ``logprobs:
+    true``; classic completions accept an int (the top-N count — only the
+    SAMPLED token's logprob is computed, so any positive count gets that one
+    column, documented in the matrix)."""
+    raw = payload.get("logprobs")
+    if raw is None or raw is False:
+        return False
+    if raw is True:
+        return True
+    if chat or not isinstance(raw, int) or raw < 0:
+        raise HTTPError(
+            400,
+            "logprobs must be true/false (chat) or a non-negative integer "
+            f"(completions), got {raw!r}",
+        )
+    return raw > 0
+
+
+class _StopScanner:
+    """Incremental stop-sequence matcher over decoded emission text.
+
+    The grammar machinery (models/structured.py ``stop_sequences``) enforces
+    stops device-side but needs the stop strings compiled into the engine's
+    ConstraintSet; a per-request ``stop=`` arrives too late for that, so the
+    serving layer applies the SAME truncate-at-earliest-match semantics at the
+    emission boundary. A rolling holdback of ``max(len(stop)) - 1`` characters
+    catches matches spanning chunk boundaries; once matched, the consumer
+    closes the engine stream — tokens past the stop are never generated."""
+
+    def __init__(self, stops: "List[str]"):
+        self.stops = stops
+        self._buffer = ""
+        self._hold = max(len(s) for s in stops) - 1
+        self.matched = False
+
+    def feed(self, text: str) -> str:
+        """Scan ``text``; returns the emittable portion (truncated at the
+        earliest stop match, which also flips :attr:`matched`)."""
+        self._buffer += text
+        best = -1
+        for stop in self.stops:
+            idx = self._buffer.find(stop)
+            if idx >= 0 and (best < 0 or idx < best):
+                best = idx
+        if best >= 0:
+            self.matched = True
+            out, self._buffer = self._buffer[:best], ""
+            return out
+        if self._hold and len(self._buffer) > self._hold:
+            out = self._buffer[: -self._hold]
+            self._buffer = self._buffer[-self._hold :]
+            return out
+        if not self._hold:
+            out, self._buffer = self._buffer, ""
+            return out
+        return ""
+
+    def flush(self) -> str:
+        """The held-back tail once the stream ended without a match."""
+        out, self._buffer = self._buffer, ""
+        return out
 
 
 def register_openai_routes(app: Any) -> None:
@@ -147,6 +248,15 @@ def _decode_tokens(app: Any, ids: "List[int]") -> str:
     return " ".join(str(i) for i in ids)
 
 
+def _chunk_glue(app: Any) -> str:
+    """What joins consecutive chunks' decoded text: nothing for a real
+    tokenizer (decode pieces concatenate), the fallback's space for id-text —
+    so incremental stop scanning sees the same string the one-shot decode
+    would have produced."""
+    tok = _tokenizer(app)
+    return "" if (tok is not None and hasattr(tok, "decode")) else " "
+
+
 def _chat_to_prompt(app: Any, messages: Any) -> Any:
     """OpenAI ``messages`` to a single prompt: the tokenizer's own
     ``apply_chat_template`` when it has one, else a plain role-prefixed
@@ -166,7 +276,9 @@ def _chat_to_prompt(app: Any, messages: Any) -> Any:
     return "\n".join(f"{m['role']}: {m['content']}" for m in messages) + "\nassistant:"
 
 
-def _parse_request(app: Any, body: bytes, *, chat: bool) -> "Tuple[Dict[str, Any], List[int], int, bool, str]":
+def _parse_request(
+    app: Any, body: bytes, *, chat: bool
+) -> "Tuple[Dict[str, Any], List[int], int, bool, str, List[str], bool]":
     payload = app._parse_json_object(body)
     for knob in _UNSUPPORTED:
         value = payload.get(knob)
@@ -177,6 +289,10 @@ def _parse_request(app: Any, body: bytes, *, chat: bool) -> "Tuple[Dict[str, Any
                 f"unsupported parameter {knob!r} (see the compatibility matrix "
                 "in docs/serving.md)",
             )
+    # explicit-knob validation first: a malformed stop/logprobs is reported as
+    # ITS error even when the prompt would also fail (no tokenizer)
+    stops = _parse_stop(payload)
+    want_logprobs = _parse_logprobs(payload, chat=chat)
     if chat:
         prompt = _chat_to_prompt(app, payload.get("messages"))
     else:
@@ -192,20 +308,53 @@ def _parse_request(app: Any, body: bytes, *, chat: bool) -> "Tuple[Dict[str, Any
     # routinely send large max_tokens; a hard reject would break drop-in use)
     max_new = min(raw_max, int(cfg.max_new_tokens))
     stream = bool(payload.get("stream", False))
-    return payload, ids, max_new, stream, _model_name(app, payload.get("model"))
+    return payload, ids, max_new, stream, _model_name(app, payload.get("model")), stops, want_logprobs
+
+
+def _record_traffic(route: str, ids: "List[int]", max_new: int, stream: bool) -> None:
+    """Tap the process-wide traffic recorder (``serve --record-traffic``) with
+    the PARSED request — None = capture off, zero cost."""
+    from unionml_tpu.workloads.traces import active_traffic_recorder
+
+    recorder = active_traffic_recorder()
+    if recorder is None:
+        return
+    from unionml_tpu.serving.tenancy import current_priority, current_tenant, priority_name
+
+    priority = current_priority()
+    recorder.record(
+        route,
+        prompt=ids,
+        max_tokens=max_new,
+        stream=stream,
+        tenant=current_tenant(),
+        priority=priority_name(priority) if priority is not None else None,
+    )
 
 
 async def _completions(app: Any, body: bytes, *, chat: bool):
-    payload, ids, max_new, stream, model_name = _parse_request(app, body, chat=chat)
+    payload, ids, max_new, stream, model_name, stops, want_logprobs = _parse_request(
+        app, body, chat=chat
+    )
     engine = _engine(app)
     cfg = _gen_config(engine)
+    _record_traffic("/v1/chat/completions" if chat else "/v1/completions", ids, max_new, stream)
     rid = current_request_id() or "req"
     created = int(time.time())  # wall clock, display only — never subtracted
     completion_id = f"{'chatcmpl' if chat else 'cmpl'}-{rid}"
+    submit_kwargs: "Dict[str, Any]" = dict(max_new_tokens=max_new, deadline=current_deadline())
+    if want_logprobs:
+        # only passed when requested, so engines predating the kwarg (the
+        # multi-host coordinator) keep serving plain requests untouched
+        submit_kwargs["logprobs"] = True
     try:
-        token_stream = engine.submit(ids, max_new_tokens=max_new, deadline=current_deadline())
+        token_stream = engine.submit(ids, **submit_kwargs)
     except (QueueFullError, DeadlineExceeded):
         raise  # the HTTP layer maps these to 429 (+ Retry-After) / 503
+    except TypeError as exc:
+        if want_logprobs:
+            raise HTTPError(400, f"logprobs is not supported by this engine: {exc}")
+        raise
     except ValueError as exc:
         raise HTTPError(400, f"generation rejected the request: {exc}")
     loop = asyncio.get_running_loop()
@@ -219,23 +368,57 @@ async def _completions(app: Any, body: bytes, *, chat: bool):
         return next(iterator, sentinel)
 
     eos_id = cfg.eos_id
+    scanner = _StopScanner(stops) if stops else None
+    lp_consumed = 0
+
+    def take_logprobs(count: int) -> "Optional[List[float]]":
+        """The next ``count`` logprobs off the engine stream (appended before
+        their tokens were enqueued, so they are always there by now)."""
+        nonlocal lp_consumed
+        if not want_logprobs:
+            return None
+        values = getattr(token_stream, "logprobs", [])[lp_consumed : lp_consumed + count]
+        lp_consumed += count
+        return [round(float(v), 6) for v in values]
+
+    glue = _chunk_glue(app)
 
     if not stream:
         emitted: "List[int]" = []
+        pieces: "List[str]" = []
+        fed_any = False
+        stopped = False
         try:
             while True:
                 chunk = await loop.run_in_executor(None, ctx.run, pull)
                 if chunk is sentinel:
                     break
-                emitted.extend(int(t) for t in np.asarray(chunk).ravel())
+                chunk_ids = [int(t) for t in np.asarray(chunk).ravel()]
+                emitted.extend(chunk_ids)
+                if scanner is not None and chunk_ids:
+                    prefix = glue if fed_any else ""
+                    fed_any = True
+                    pieces.append(scanner.feed(prefix + _decode_tokens(app, chunk_ids)))
+                    if scanner.matched:
+                        # truncate-at-match: nothing past the stop is pulled —
+                        # closing below frees the engine slot promptly
+                        stopped = True
+                        break
         except (QueueFullError, DeadlineExceeded):
             raise
         except Exception as exc:
             raise HTTPError(500, f"generation failed: {type(exc).__name__}: {exc}")
         finally:
             token_stream.close()
+        logprobs = take_logprobs(len(emitted))
+        text: Optional[str] = None
+        if scanner is not None:
+            if not stopped:
+                pieces.append(scanner.flush())
+            text = "".join(pieces)
         return 200, _final_payload(
-            app, chat, completion_id, created, model_name, emitted, max_new, len(ids), eos_id
+            app, chat, completion_id, created, model_name, emitted, max_new, len(ids), eos_id,
+            text=text, stopped=stopped, logprobs=logprobs,
         ), "application/json"
 
     # ---- stream=true: server-sent events, one data: line per engine chunk,
@@ -253,15 +436,22 @@ async def _completions(app: Any, body: bytes, *, chat: bool):
     def sse(obj: "Dict[str, Any]") -> bytes:
         return b"data: " + json.dumps(obj).encode() + b"\n\n"
 
-    def chunk_payload(piece: "List[int]", finish: Optional[str]) -> "Dict[str, Any]":
-        text = _decode_tokens(app, piece) if piece else ""
+    def chunk_payload(
+        piece: "List[int]", finish: Optional[str], *,
+        text: Optional[str] = None, lps: "Optional[List[float]]" = None,
+    ) -> "Dict[str, Any]":
+        if text is None:
+            text = _decode_tokens(app, piece) if piece else ""
+        logprobs_block = _logprobs_block(app, chat, piece, lps) if lps is not None else None
         if chat:
             delta: "Dict[str, Any]" = {}
-            if piece:
+            if text:
                 delta["content"] = text
-            choice = {"index": 0, "delta": delta, "finish_reason": finish}
+            choice: "Dict[str, Any]" = {"index": 0, "delta": delta, "finish_reason": finish}
+            if logprobs_block is not None:
+                choice["logprobs"] = logprobs_block
         else:
-            choice = {"index": 0, "text": text, "logprobs": None, "finish_reason": finish}
+            choice = {"index": 0, "text": text, "logprobs": logprobs_block, "finish_reason": finish}
         return {
             "id": completion_id, "object": object_name, "created": created,
             "model": model_name, "choices": [choice],
@@ -270,6 +460,8 @@ async def _completions(app: Any, body: bytes, *, chat: bool):
     async def events():
         emitted = 0
         last_token: Optional[int] = None
+        stopped = False
+        fed_any = False
         try:
             if chat:
                 # the OpenAI stream opener: role first, content deltas after
@@ -284,20 +476,62 @@ async def _completions(app: Any, body: bytes, *, chat: bool):
                 if piece:
                     emitted += len(piece)
                     last_token = piece[-1]
-                    yield sse(chunk_payload(piece, None))
+                    lps = take_logprobs(len(piece))
+                    if scanner is not None:
+                        prefix = glue if fed_any else ""
+                        fed_any = True
+                        text = scanner.feed(prefix + _decode_tokens(app, piece))
+                        if scanner.matched:
+                            stopped = True
+                            if text or lps:
+                                yield sse(chunk_payload(piece, None, text=text, lps=lps))
+                            break
+                        if text or lps:
+                            # an all-held-back chunk still ships its logprobs
+                            # (empty text) so the per-token columns stay whole
+                            yield sse(chunk_payload(piece, None, text=text, lps=lps))
+                    else:
+                        yield sse(chunk_payload(piece, None, lps=lps))
                 chunk = await loop.run_in_executor(None, ctx.run, pull)
-            finish = "stop" if (eos_id is not None and last_token == eos_id) else "length"
-            final = chunk_payload([], finish)
+            if scanner is not None and not stopped:
+                tail = scanner.flush()
+                if tail:
+                    yield sse(chunk_payload([], None, text=tail))
+            if stopped:
+                finish = "stop"
+            else:
+                finish = "stop" if (eos_id is not None and last_token == eos_id) else "length"
+            final = chunk_payload([], finish, text="")
             final["usage"] = _usage(len(ids), emitted)
             yield sse(final)
             yield b"data: [DONE]\n\n"
         finally:
             # the server acloses this generator on client disconnect; closing
             # the token stream releases the engine slot promptly (plain-object
-            # close — safe from any thread, no generator re-entrancy hazard)
+            # close — safe from any thread, no generator re-entrancy hazard);
+            # a stop match lands here too, freeing the slot mid-budget
             token_stream.close()
 
     return 200, events(), "text/event-stream"
+
+
+def _logprobs_block(
+    app: Any, chat: bool, piece: "List[int]", lps: "List[float]"
+) -> "Dict[str, Any]":
+    """The OpenAI logprobs shape for one run of tokens: chat uses the
+    ``content`` entry list, classic completions the parallel-array form.
+    Only the SAMPLED token's logprob is computed (top_logprobs stays null —
+    the decode scan does not rank the rest of the vocabulary)."""
+    tokens = [_decode_tokens(app, [tok]) for tok in piece]
+    pairs = list(zip(tokens, lps))
+    if chat:
+        return {"content": [{"token": tok, "logprob": lp} for tok, lp in pairs]}
+    return {
+        "tokens": [tok for tok, _ in pairs],
+        "token_logprobs": [lp for _, lp in pairs],
+        "top_logprobs": None,
+        "text_offset": None,
+    }
 
 
 def _usage(prompt_tokens: int, completion_tokens: int) -> "Dict[str, int]":
@@ -318,18 +552,31 @@ def _final_payload(
     max_new: int,
     prompt_tokens: int,
     eos_id: Optional[int],
+    *,
+    text: Optional[str] = None,
+    stopped: bool = False,
+    logprobs: "Optional[List[float]]" = None,
 ) -> "Dict[str, Any]":
-    text = _decode_tokens(app, emitted) if emitted else ""
-    finish = "stop" if (eos_id is not None and emitted and emitted[-1] == eos_id) else "length"
+    if text is None:
+        text = _decode_tokens(app, emitted) if emitted else ""
+    if stopped:
+        finish = "stop"  # a matched stop= sequence, truncated at the match
+    else:
+        finish = "stop" if (eos_id is not None and emitted and emitted[-1] == eos_id) else "length"
+    logprobs_block = (
+        _logprobs_block(app, chat, emitted, logprobs) if logprobs is not None else None
+    )
     if chat:
         choice: "Dict[str, Any]" = {
             "index": 0,
             "message": {"role": "assistant", "content": text},
             "finish_reason": finish,
         }
+        if logprobs_block is not None:
+            choice["logprobs"] = logprobs_block
         object_name = "chat.completion"
     else:
-        choice = {"index": 0, "text": text, "logprobs": None, "finish_reason": finish}
+        choice = {"index": 0, "text": text, "logprobs": logprobs_block, "finish_reason": finish}
         object_name = "text_completion"
     return {
         "id": completion_id,

@@ -92,6 +92,7 @@ from unionml_tpu.serving.overload import (
     TenantThrottled,
     expired,
 )
+from unionml_tpu.serving.tenancy import current_tenant
 
 __all__ = ["ReplicaScheduler", "ReplicaSet", "dp_extent", "slice_mesh"]
 
@@ -204,21 +205,34 @@ class ReplicaScheduler:
         affinity_tokens: int = 0,
         affinity_margin: int = 2,
         affinity_capacity: int = 4096,
+        tenant_affinity_capacity: int = 1024,
     ):
         if replicas < 1:
             raise ValueError("replicas must be >= 1")
         if affinity_tokens < 0 or affinity_margin < 0 or affinity_capacity < 1:
             raise ValueError("affinity knobs must be non-negative (capacity >= 1)")
+        if tenant_affinity_capacity < 1:
+            raise ValueError("tenant_affinity_capacity must be >= 1")
         self.replicas = replicas
         self.affinity_tokens = affinity_tokens
         self.affinity_margin = affinity_margin
         self._affinity_capacity = affinity_capacity
         self._affinity: "OrderedDict[Tuple[int, ...], int]" = OrderedDict()
+        #: TENANT session affinity (ROADMAP 4(b)): tenant id -> the replica
+        #: that last served it. A tenant's recent sessions left their KV in
+        #: that replica's radix tier, so landing its next request there is a
+        #: warm prefill even when the new prompt shares no prefix the radix
+        #: PROBE can see yet (a fresh conversation). Bounded LRU — the TPU009
+        #: discipline — and margin-gated exactly like prefix affinity, so a
+        #: single heavy tenant cannot hotspot one replica while siblings idle.
+        self._tenant_affinity_capacity = tenant_affinity_capacity
+        self._tenant_affinity: "OrderedDict[str, int]" = OrderedDict()
         self._lock = threading.Lock()
         #: routing telemetry: successful submissions per replica, and how many
-        #: rode the affinity map vs plain least-loaded
+        #: rode the affinity maps vs plain least-loaded
         self.submitted = [0] * replicas
         self.affinity_hits = 0
+        self.tenant_affinity_hits = 0
 
     def _key(self, prompt: Optional[Sequence[int]]) -> Optional[Tuple[int, ...]]:
         if not self.affinity_tokens or prompt is None:
@@ -242,6 +256,9 @@ class ReplicaScheduler:
                 self._affinity = OrderedDict(
                     (key, idx) for key, idx in self._affinity.items() if idx < replicas
                 )
+                self._tenant_affinity = OrderedDict(
+                    (t, idx) for t, idx in self._tenant_affinity.items() if idx < replicas
+                )
             self.replicas = replicas
 
     def order(
@@ -251,7 +268,8 @@ class ReplicaScheduler:
         cached: Optional[Sequence[int]] = None,
         breaching: Optional[Sequence[bool]] = None,
         deprioritized: Optional[Sequence[bool]] = None,
-    ) -> "Tuple[List[int], bool]":
+        tenant: Optional[str] = None,
+    ) -> "Tuple[List[int], Any]":
         """``(indices to try best-first, head_is_affinity)``. The caller walks
         the list so a full (QueueFullError) replica falls through to the
         next-least-loaded instead of shedding work the rest of the fleet could
@@ -280,7 +298,16 @@ class ReplicaScheduler:
         disaggregated fleet (a prefill-role replica should not take
         decode-resident work unless everyone suited is full) — merges with
         ``breaching``: flagged replicas sort below every unflagged one but
-        stay in the walk order, the same degrade-don't-shed posture."""
+        stay in the walk order, the same degrade-don't-shed posture.
+
+        ``tenant`` — the submitting tenant id — arms TENANT session affinity
+        as the LAST fallback: when neither an actual radix probe nor the
+        prefix-key map produced a warm head, the replica that last served
+        this tenant is preferred under the same margin gate (its radix tier
+        holds the tenant's recent sessions' KV — the multi-turn-chat warmth a
+        prefix probe on a brand-new prompt cannot see). A tenant-affinity
+        head is flagged ``"tenant"`` (truthy, distinct from the prefix
+        paths' ``True``) so :meth:`note` can account it separately."""
         avoid = (
             [bool(flag) for flag in breaching]
             if breaching is not None and len(breaching) == len(loads)
@@ -297,7 +324,7 @@ class ReplicaScheduler:
                 preferred = min(candidates, key=lambda i: (-cached[i], loads[i], i))
                 if loads[preferred] <= loads[ranked[0]] + self.affinity_margin:
                     return [preferred] + [i for i in ranked if i != preferred], True
-            return ranked, False
+            return self._tenant_head(ranked, loads, avoid, tenant)
         key = self._key(prompt)
         if key is not None:
             with self._lock:
@@ -308,10 +335,41 @@ class ReplicaScheduler:
                 and loads[preferred] <= loads[ranked[0]] + self.affinity_margin
             ):
                 return [preferred] + [i for i in ranked if i != preferred], True
+        return self._tenant_head(ranked, loads, avoid, tenant)
+
+    def _tenant_head(
+        self,
+        ranked: "List[int]",
+        loads: Sequence[int],
+        avoid: "List[bool]",
+        tenant: Optional[str],
+    ) -> "Tuple[List[int], Any]":
+        """The tenant-session-affinity fallback head (see :meth:`order`)."""
+        if tenant is None or not ranked:
+            return ranked, False
+        with self._lock:
+            preferred = self._tenant_affinity.get(tenant)
+        if (
+            preferred is not None
+            and preferred < len(loads)
+            and not avoid[preferred]
+            and loads[preferred] <= loads[ranked[0]] + self.affinity_margin
+        ):
+            return [preferred] + [i for i in ranked if i != preferred], "tenant"
         return ranked, False
 
-    def note(self, replica: int, prompt: Optional[Sequence[int]] = None, *, affinity: bool = False) -> None:
-        """Record a successful routing decision (updates the affinity map)."""
+    def note(
+        self,
+        replica: int,
+        prompt: Optional[Sequence[int]] = None,
+        *,
+        affinity: Any = False,
+        tenant: Optional[str] = None,
+    ) -> None:
+        """Record a successful routing decision (updates the affinity maps).
+        ``affinity`` is the head flag :meth:`order` returned when this replica
+        was its head — ``True`` counts a prefix/probe hit, ``"tenant"`` a
+        tenant-session hit."""
         key = self._key(prompt)
         with self._lock:
             if replica >= len(self.submitted):
@@ -319,13 +377,20 @@ class ReplicaScheduler:
                 # microseconds; re-grow rather than drop the count
                 self.submitted.extend([0] * (replica + 1 - len(self.submitted)))
             self.submitted[replica] += 1
-            if affinity:
+            if affinity == "tenant":
+                self.tenant_affinity_hits += 1
+            elif affinity:
                 self.affinity_hits += 1
             if key is not None:
                 self._affinity[key] = replica
                 self._affinity.move_to_end(key)
                 while len(self._affinity) > self._affinity_capacity:
                     self._affinity.popitem(last=False)
+            if tenant is not None:
+                self._tenant_affinity[tenant] = replica
+                self._tenant_affinity.move_to_end(tenant)
+                while len(self._tenant_affinity) > self._tenant_affinity_capacity:
+                    self._tenant_affinity.popitem(last=False)
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
@@ -335,6 +400,8 @@ class ReplicaScheduler:
                 "affinity_tokens": self.affinity_tokens,
                 "affinity_hits": self.affinity_hits,
                 "affinity_entries": len(self._affinity),
+                "tenant_affinity_hits": self.tenant_affinity_hits,
+                "tenant_affinity_entries": len(self._tenant_affinity),
             }
 
 
@@ -745,6 +812,7 @@ class ReplicaSet:
         tenant: Optional[str] = None,
         priority: Optional[int] = None,
         export_handoff: bool = False,
+        logprobs: bool = False,
     ) -> "Iterator[np.ndarray]":
         """Route a prompt to the least-loaded replica (prefix affinity
         permitting) and return its engine's token stream. Sheds with
@@ -783,7 +851,10 @@ class ReplicaSet:
                 max_new_tokens=max_new_tokens, constraint=constraint, deadline=deadline,
                 tenant=tenant, priority=priority,
             )
-        if any(role == "prefill" for role in roles):
+        if any(role == "prefill" for role in roles) and not logprobs:
+            # logprobs requests skip the handoff pair (the logprob column does
+            # not ride the KV payload) and admit directly on a decode/mixed
+            # replica through the classic walk below
             stream = self._submit_disaggregated(
                 batchers, roles, prompt,
                 max_new_tokens=max_new_tokens, constraint=constraint, deadline=deadline,
@@ -794,7 +865,7 @@ class ReplicaSet:
         return self._submit_routed(
             batchers, roles, prompt,
             max_new_tokens=max_new_tokens, constraint=constraint, deadline=deadline,
-            req_trace=req_trace, tenant=tenant, priority=priority,
+            req_trace=req_trace, tenant=tenant, priority=priority, logprobs=logprobs,
         )
 
     def _submit_routed(
@@ -809,11 +880,16 @@ class ReplicaSet:
         req_trace: Any,
         tenant: Optional[str] = None,
         priority: Optional[int] = None,
+        logprobs: bool = False,
     ) -> "Iterator[np.ndarray]":
         """The classic least-loaded walk (PR 2), over a resize-stable snapshot.
         In a role-split fleet, prefill-role replicas are deprioritized — they
         still appear in the walk so a fleet whose decode tier is saturated
         degrades to using them rather than shedding."""
+        # the routing tenant: the explicit kwarg, else the contextvar the HTTP
+        # layer bound — resolved HERE (not just in the engine) because tenant
+        # session affinity is a routing concern
+        route_tenant = tenant if tenant is not None else current_tenant()
         loads = [batcher.load() for batcher in batchers]
         # actual per-replica cached-prefix lengths (the radix-tree probe) when
         # any engine runs a prefix cache; None keeps the LRU token-key fallback
@@ -837,7 +913,7 @@ class ReplicaSet:
             else None
         )
         order, affinity_head = self._scheduler.order(
-            loads, prompt, cached, breaching, deprioritized
+            loads, prompt, cached, breaching, deprioritized, tenant=route_tenant
         )
         if breaching is not None and any(breaching):
             # pure load order would have picked this replica; health demoted it
@@ -852,13 +928,14 @@ class ReplicaSet:
                 # a full replica's fall-through is visible on the timeline
                 req_trace.event(
                     "engine.routed", replica=replica, load=round(loads[replica], 3),
-                    affinity=affinity_head and replica == order[0],
+                    affinity=bool(affinity_head) and replica == order[0],
                     breaching=bool(breaching[replica]) if breaching is not None else False,
                 )
             try:
                 stream = batchers[replica].submit(
                     prompt, max_new_tokens=max_new_tokens, constraint=constraint,
                     deadline=deadline, tenant=tenant, priority=priority,
+                    logprobs=logprobs,
                 )
             except TenantThrottled:
                 # every replica shares the same tenant registry, so walking the
@@ -868,7 +945,11 @@ class ReplicaSet:
             except QueueFullError as exc:
                 last_exc = exc
                 continue
-            self._scheduler.note(replica, prompt, affinity=affinity_head and replica == order[0])
+            self._scheduler.note(
+                replica, prompt,
+                affinity=affinity_head if replica == order[0] else False,
+                tenant=route_tenant,
+            )
             return stream
         with self._lock:
             self.shed_queue_full += 1
@@ -984,7 +1065,10 @@ class ReplicaSet:
                             "engine.routed", replica=warm_t, load=round(loads[warm_t], 3),
                             role=roles[warm_t], cached=cached_len,
                         )
-                    self._scheduler.note(warm_t, prompt)
+                    self._scheduler.note(
+                        warm_t, prompt,
+                        tenant=tenant if tenant is not None else current_tenant(),
+                    )
                     with self._lock:
                         self.handoff_shortcuts += 1
                     return stream
@@ -1055,7 +1139,9 @@ class ReplicaSet:
                     "engine.routed", replica=t, load=round(loads[t], 3), role=roles[t],
                     handoff=True,
                 )
-            self._scheduler.note(t, payload.get("prompt"))
+            # the DECODE replica is where the tenant's session KV ends up: the
+            # tenant-affinity map records it, not the prefill leg
+            self._scheduler.note(t, payload.get("prompt"), tenant=payload.get("tenant"))
             return stream
         raise RuntimeError(
             f"no replica of {len(batchers)} could adopt the handed-off prefill"
@@ -1342,6 +1428,15 @@ class ReplicaSet:
             )
             self.scale_to(n - 1)
 
+    def tenant_slo(self) -> "Dict[str, Any]":
+        """Fleet-wide per-tenant SLO verdicts: the worst replica's entry per
+        tenant (observability/health.merge_tenant_slo) — ``{}`` when no
+        tenant carries per-tenant targets, so the section stays absent on
+        target-less fleets."""
+        from unionml_tpu.observability.health import merge_tenant_slo
+
+        return merge_tenant_slo(list(self.batchers))
+
     def tenant_census(self) -> "Dict[str, Dict[str, int]]":
         """Fleet-wide live per-tenant stream counts (multi-tenant QoS,
         ``/debug/fleet``): each replica's bounded census summed — empty when
@@ -1525,6 +1620,14 @@ class ReplicaSet:
                     }
                 }
                 if any("tenancy" in entry for entry in per_replica)
+                else {}
+            ),
+            # fleet-wide per-tenant SLO verdicts (worst replica wins per
+            # tenant); absent unless some replica tracks tenant targets —
+            # per-replica detail stays under per_replica
+            **(
+                {"tenant_slo": self.tenant_slo()}
+                if any("tenant_slo" in entry for entry in per_replica)
                 else {}
             ),
             # fleet-level sheds (all replicas full / expired before routing) on

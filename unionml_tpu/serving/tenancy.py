@@ -181,13 +181,25 @@ class TenantSpec:
     unlimited); ``burst_s`` sizes each bucket's capacity as ``rate * burst_s``
     (never below one request / one token, so a conforming tenant is never shed
     from a cold start). ``priority`` is the DEFAULT tier for the tenant's
-    requests — an explicit ``X-Priority`` header always wins."""
+    requests — an explicit ``X-Priority`` header always wins.
+
+    ``slo_ttft_p95_ms``/``slo_tbt_p99_ms``/``slo_shed_ratio`` are optional
+    PER-TENANT SLO targets (docs/observability.md "SLOs and fleet health"):
+    when any is set, every continuous engine keys a per-tenant burn-rate
+    tracker for this tenant (bounded LRU — the TPU009 discipline), its
+    verdicts ride ``stats()["tenant_slo"]`` → ``/metrics`` and ``/healthz``,
+    and the traffic replayer judges the tenant against the same numbers.
+    ``None``/0 = no per-tenant target (the tenant rides the engine-level SLO
+    alone — byte-for-byte today's behavior)."""
 
     weight: float = 1.0
     req_per_s: float = 0.0
     tokens_per_s: float = 0.0
     burst_s: float = 2.0
     priority: str = "normal"
+    slo_ttft_p95_ms: Optional[float] = None
+    slo_tbt_p99_ms: Optional[float] = None
+    slo_shed_ratio: Optional[float] = None
 
     def __post_init__(self) -> None:
         if self.weight < 0:
@@ -200,6 +212,32 @@ class TenantSpec:
             raise ValueError(
                 f"unknown priority {self.priority!r}; expected one of {sorted(PRIORITIES)}"
             )
+        for name in ("slo_ttft_p95_ms", "slo_tbt_p99_ms", "slo_shed_ratio"):
+            value = getattr(self, name)
+            if value is not None and value < 0:
+                raise ValueError(f"tenant {name} must be >= 0 (None/0 = disarmed)")
+
+    def slo_config(self) -> "Optional[Any]":
+        """This tenant's targets as an
+        :class:`~unionml_tpu.observability.slo.SLOConfig` (windows/min-samples
+        from the serve-wide ``UNIONML_TPU_SLO_*`` exports, so per-tenant and
+        engine-level evaluation share one burn-rate clock); ``None`` when no
+        per-tenant objective is armed — no tracker is ever created for such a
+        tenant, which is what keeps target-less registries byte-for-byte
+        off."""
+        if not any((self.slo_ttft_p95_ms, self.slo_tbt_p99_ms, self.slo_shed_ratio)):
+            return None
+        from unionml_tpu.observability.slo import SLOConfig
+
+        base = SLOConfig.from_env()
+        return SLOConfig(
+            ttft_p95_ms=self.slo_ttft_p95_ms or None,
+            tbt_p99_ms=self.slo_tbt_p99_ms or None,
+            shed_ratio=self.slo_shed_ratio or None,
+            fast_window_s=base.fast_window_s,
+            slow_window_s=base.slow_window_s,
+            min_samples=base.min_samples,
+        )
 
 
 class _TenantState:

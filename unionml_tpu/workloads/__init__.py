@@ -1,0 +1,26 @@
+"""Traffic record/replay: trace schema, scenario library, replayer, verdicts.
+
+The workload engine behind the ``traffic_replay`` bench lane and the
+``unionml-tpu replay`` CLI (docs/workloads.md): recorded or synthesized
+request mixes played arrival-time-faithfully through the real HTTP stack and
+judged by per-tenant SLO verdicts.
+"""
+
+from unionml_tpu.workloads.replayer import replay, replay_async  # noqa: F401
+from unionml_tpu.workloads.scenarios import (  # noqa: F401
+    SCENARIOS,
+    scenario_meta,
+    scenario_targets,
+    synthesize,
+    synthesize_text,
+)
+from unionml_tpu.workloads.traces import (  # noqa: F401
+    TraceRecorder,
+    TraceRequest,
+    active_traffic_recorder,
+    dumps_trace,
+    read_trace,
+    set_active_traffic_recorder,
+    write_trace,
+)
+from unionml_tpu.workloads.verdicts import overall_state, tenant_verdicts  # noqa: F401

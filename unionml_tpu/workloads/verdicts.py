@@ -1,0 +1,124 @@
+"""Replay verdicts: per-tenant observed-vs-target judgment for a finite run.
+
+The live SLO engine (observability/slo.py) evaluates *windows* with
+multi-window burn rates, because a server's life has no end. A replay does end
+— so its judgment is simpler and stricter: for every tenant with declared
+targets, compare the whole run's observed TTFT p95 / TBT p99 / shed ratio
+against the target, report the **burn rate** (observed/target, the same
+convention the live tracker uses), and classify:
+
+- ``pass``   — burn <= 1.0 (at or under target);
+- ``warn``   — 1.0 < burn <= ``warn_factor`` (default 1.2: over target, but
+  within the slack a noisy CPU-substrate run is allowed);
+- ``breach`` — burn > ``warn_factor``.
+
+A tenant whose objective saw fewer than ``min_samples`` observations cannot
+breach on it (the live tracker's idle-is-healthy gate, applied to a run) —
+the objective reports ``"samples"`` short and passes. Every leaf is numeric
+or a state string, never ``None`` (the /metrics exposition contract, kept
+here so a verdict block can ride straight into BENCH_ALL.json or a scrape).
+
+This is what turns a replay from *numbers* into a *judgment*: the
+``traffic_replay`` bench lane gates on "every well-behaved tenant passes
+while the hostile tenant sheds", and any future perf PR that regresses a
+tenant's latency flips that tenant's verdict — visibly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from unionml_tpu.observability.slo import STATE_CODES, worst_state
+
+__all__ = ["OBJECTIVES", "overall_state", "tenant_verdicts"]
+
+#: objective name -> (per-tenant metric section, metric key within it);
+#: shed_ratio reads the flat per-tenant counter instead of a latency window
+OBJECTIVES = ("ttft_p95_ms", "tbt_p99_ms", "shed_ratio")
+
+#: verdict states reuse the live SLO machine's vocabulary, with "pass"
+#: standing in for "ok" (a finished run is judged, not monitored)
+_STATE_BY_CODE = {0: "pass", 1: "warn", 2: "breach"}
+
+
+def _observe(metrics: "Dict[str, Any]", objective: str) -> "tuple[float, int]":
+    """(observed value, samples) for one objective from a replay's per-tenant
+    metrics block (workloads/replayer.py shape)."""
+    if objective == "ttft_p95_ms":
+        window = metrics.get("ttft_ms") or {}
+        return float(window.get("p95_ms", 0.0)), int(window.get("n", 0))
+    if objective == "tbt_p99_ms":
+        window = metrics.get("tbt_ms") or {}
+        return float(window.get("p99_ms", 0.0)), int(window.get("n", 0))
+    return float(metrics.get("shed_ratio", 0.0)), int(metrics.get("requests", 0))
+
+
+def tenant_verdicts(
+    per_tenant: "Dict[str, Dict[str, Any]]",
+    targets: "Dict[str, Dict[str, float]]",
+    *,
+    warn_factor: float = 1.2,
+    min_samples: int = 1,
+) -> "Dict[str, Dict[str, Any]]":
+    """Judge every targeted tenant: ``{tenant: {state, state_code,
+    objectives: {name: {target, observed, burn_rate, samples, state, ...}}}}``.
+
+    Tenants in ``targets`` but absent from the run are judged ``breach`` with
+    zero samples on a synthetic ``missing`` objective — a replay that never
+    exercised a tenant it promised to judge must not silently pass it."""
+    if warn_factor < 1.0:
+        raise ValueError("warn_factor must be >= 1.0 (pass ends at burn 1.0)")
+    if min_samples < 1:
+        raise ValueError("min_samples must be >= 1")
+    out: "Dict[str, Dict[str, Any]]" = {}
+    for tenant, tenant_targets in sorted(targets.items()):
+        metrics = per_tenant.get(tenant)
+        if metrics is None:
+            out[tenant] = {
+                "state": "breach",
+                "state_code": STATE_CODES["breach"],
+                "objectives": {
+                    "missing": {"samples": 0, "state": "breach", "state_code": 2}
+                },
+            }
+            continue
+        objectives: "Dict[str, Any]" = {}
+        for name in OBJECTIVES:
+            target = tenant_targets.get(name)
+            if not target:
+                continue
+            observed, samples = _observe(metrics, name)
+            burn = observed / float(target)
+            if samples < min_samples:
+                state = "pass"  # too little evidence to convict (idle-is-healthy)
+            elif burn <= 1.0:
+                state = "pass"
+            elif burn <= warn_factor:
+                state = "warn"
+            else:
+                state = "breach"
+            objectives[name] = {
+                "target": float(target),
+                "observed": round(observed, 4),
+                "burn_rate": round(burn, 3),
+                "samples": samples,
+                "state": state,
+                "state_code": STATE_CODES["breach" if state == "breach" else ("warn" if state == "warn" else "ok")],
+            }
+        worst = worst_state(
+            "breach" if entry["state"] == "breach" else ("warn" if entry["state"] == "warn" else "ok")
+            for entry in objectives.values()
+        )
+        out[tenant] = {
+            "state": _STATE_BY_CODE[STATE_CODES[worst]],
+            "state_code": STATE_CODES[worst],
+            "objectives": objectives,
+        }
+    return out
+
+
+def overall_state(verdicts: "Dict[str, Dict[str, Any]]") -> str:
+    """The run's headline judgment: the worst tenant state (``pass`` for an
+    empty verdict block — no targets declared means nothing to fail)."""
+    worst = max((entry["state_code"] for entry in verdicts.values()), default=0)
+    return _STATE_BY_CODE[int(worst)]
