@@ -44,6 +44,7 @@ SCRIPTS = {
     "disagg_serving": "bench_disagg_serving.py",
     "multitenant_qos": "bench_multitenant.py",
     "traffic_replay": "bench_traffic_replay.py",
+    "fleet_chaos": "bench_fleet_chaos.py",
     "quantized_serving": "bench_quantized_serving.py",
     "replica_serving": "bench_replica_serving.py",
     "multihost_serving": "bench_multihost.py",
@@ -94,11 +95,16 @@ if _cpu_extra - set(SCRIPTS):
 #: the four-scenario workload suite through the real HTTP stack against the
 #: same dispatch-bound synthetic regime — front-door scheduling under
 #: realistic open-loop arrivals, gated on schedule adherence and per-tenant
-#: SLO verdicts, same-substrate by construction
+#: SLO verdicts, same-substrate by construction; fleet_chaos pins the
+#: chaos-arm/no-fault tok/s parity while a seeded FaultPlan kills and
+#: restores a fleet host under recorded traffic, gated on the availability
+#: verdict (>= 0.99 per well-behaved tenant, every fault recovered, every
+#: failure clean) — the degradation posture, same-substrate by construction
 CPU_ONLY = {
     "digits", "serving", "replica_serving", "continuous_stall", "prefix_cache",
     "quantized_serving", "observability", "fleet_health", "lint", "disagg_serving",
     "multitenant_qos", "cold_start", "multihost_serving", "traffic_replay",
+    "fleet_chaos",
 } | _cpu_extra
 
 #: per-lane env overrides: lanes that reuse a script in a different mode

@@ -55,6 +55,7 @@ MODULES = [
     "unionml_tpu.serving.cluster",
     "unionml_tpu.serving.compile",
     "unionml_tpu.serving.continuous",
+    "unionml_tpu.serving.faults",
     "unionml_tpu.serving.http",
     "unionml_tpu.serving.metrics",
     "unionml_tpu.serving.openai_api",

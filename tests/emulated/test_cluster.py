@@ -19,7 +19,11 @@ Pinned here (the ISSUE 13 acceptance criteria):
   bit-identical, transfer latency captured;
 - fleet-global prefix routing lands turn 2 on the warm host;
 - killing a worker mid-fleet sheds nothing: the coordinator routes around
-  the dead host.
+  the dead host;
+- (ISSUE 15) a SIGKILLed worker REJOINS: a replacement process announces
+  into the same rendezvous dir with a fresh epoch, the reconciliation loop
+  walks it through probation (probes + warmup) back to live, and the
+  post-rejoin fleet serves token-identical to the no-fault reference.
 """
 
 import json
@@ -173,6 +177,36 @@ class _Fleet:
         self.procs[pid].kill()
         self.procs[pid].wait(timeout=30)
 
+    def spawn_replacement(self, pid: int) -> None:
+        """Start a REPLACEMENT worker for a SIGKILLed process id: it joins
+        the control plane only (no jax.distributed — the control plane is
+        out-of-band by design, so a replacement host never has to rejoin a
+        dead collective), builds the same engine single-process, and
+        announces into the same rendezvous dir with a fresh epoch."""
+        spec = {
+            "builder": "fleet_app:build_engine",
+            "kwargs": {},
+            "fleet_dir": str(self.fleet_dir),
+            "role": "mixed",
+        }
+        spec_path = self.tmp_path / f"spec-replacement{pid}.json"
+        spec_path.write_text(json.dumps(spec))
+        env = os.environ.copy()
+        env.pop("UNIONML_TPU_COORDINATOR", None)
+        env.pop("UNIONML_TPU_NUM_PROCESSES", None)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+            "UNIONML_TPU_PROCESS_ID": str(pid),
+            "PYTHONPATH": os.pathsep.join([str(self.tmp_path), str(REPO)]),
+        })
+        log = open(self.tmp_path / f"worker{pid}-replacement.log", "w")
+        self.logs.append(log)
+        self.procs.append(subprocess.Popen(
+            [sys.executable, "-m", "unionml_tpu.serving.cluster", str(spec_path)],
+            env=env, stdout=log, stderr=subprocess.STDOUT, cwd=self.tmp_path,
+        ))
+
     def close(self) -> None:
         for proc in self.procs:
             if proc.poll() is None:
@@ -291,14 +325,56 @@ def test_two_host_fleet_token_identity_prefix_routing_and_worker_death(
         assert host1_stats["prefix_cache"]["hits"] >= 1
         assert warm == _reference_tokens(reference, [turn2])[0]
 
-        # --- worker death during the session: kill host 1's PROCESS; the
-        # coordinator sheds nothing — every stream lands on host 0
+        # --- worker death MID-STREAM: submit the whole prompt set, SIGKILL
+        # host 1's process while streams are in flight, then drain. The fault
+        # contract: a stream the dead host had accepted but not started
+        # emitting is retried transparently on host 0 (token-identical); one
+        # that had already emitted raises the clean 503-shaped
+        # StreamInterrupted — and nothing hangs
+        from unionml_tpu.serving.cluster import StreamInterrupted
+
+        streams = [coordinator.submit(p) for p in PROMPTS]
         fleet.kill(1)
+        clean_errors = 0
+        for prompt, stream, want in zip(PROMPTS, streams, oracle):
+            try:
+                assert _drain(stream) == want
+            except StreamInterrupted:
+                clean_errors += 1  # emitted-then-died: clean, never silent
+        assert clean_errors <= len(PROMPTS)  # zero accepted streams LOST
+        # every subsequent submission sheds nothing: host 0 serves alone
         got = [_drain(coordinator.submit(p)) for p in PROMPTS]
         assert got == oracle
         assert coordinator.hosts[1].alive is False
         assert coordinator.stats()["live_hosts"] == 1
-        assert coordinator.host_census()[1]["alive"] is False
+        census = coordinator.host_census()
+        assert census[1]["alive"] is False
+        assert census[1]["state"] in ("suspect", "dead")
+
+        # --- kill → REJOIN through probation (the ISSUE 15 acceptance pin):
+        # a replacement worker process announces into the same rendezvous dir
+        # (fresh epoch, new port — same host id) and the coordinator's
+        # reconciliation loop walks it suspect/dead → probation → live
+        fleet.spawn_replacement(1)
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline and not coordinator.hosts[1].alive:
+            time.sleep(0.5)
+        assert coordinator.hosts[1].alive, (
+            f"replacement never rejoined: state={coordinator.hosts[1].state}\n"
+            + fleet.tail_logs()
+        )
+        assert coordinator.hosts[1].rejoins >= 1
+        stats = coordinator.stats()
+        assert stats["live_hosts"] == 2
+        assert stats["fleet"]["host_rejoins"] >= 1
+        assert stats["fleet"]["host_suspects"] >= 1
+        assert stats["fleet"]["recovery_ms"]["window"] >= 1
+        assert coordinator.host_census()[1]["state"] == "live"
+        # the post-rejoin fleet serves token-identical to the no-fault
+        # reference, and the rejoined host answers its routing probe
+        got = [_drain(coordinator.submit(p)) for p in PROMPTS]
+        assert got == oracle
+        assert 1 in coordinator._probe_all(coordinator._live(), PROMPTS[0])
     finally:
         fleet.close()
 

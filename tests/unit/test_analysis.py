@@ -1887,3 +1887,119 @@ def test_tpu014_workloads_scope_and_global_seed(tmp_path):
     result = run_lint([snippet])
     assert rule_ids(result) == ["TPU014", "TPU014"]
     assert "random.seed" in result.findings[0].message
+
+
+# --------------------------------------------------------------------- TPU015
+
+
+def test_tpu015_flags_unbounded_retry_loops(tmp_path):
+    result = lint_source(
+        tmp_path,
+        """
+        import itertools
+        from urllib.request import urlopen
+
+
+        def hammer(host):
+            while True:
+                try:
+                    host.ping()
+                    break
+                except OSError:
+                    continue
+
+
+        def hammer_http(url):
+            for _ in itertools.count():
+                urlopen(url)
+        """,
+    )
+    assert rule_ids(result) == ["TPU015", "TPU015"]
+    assert "host.ping" in result.findings[0].message
+    assert "_call_retry" in result.findings[0].message  # the fix idiom
+    assert "urlopen" in result.findings[1].message
+
+
+def test_tpu015_bounded_and_paced_loops_stay_clean(tmp_path):
+    # the three brakes: a bounded for-range envelope (the
+    # RemoteHost._call_retry shape), a Compare-bounded while (attempt counter
+    # or deadline), and an Event.wait-paced watcher loop — plus the walk of a
+    # finite host list, which is one attempt per host, not a retry
+    result = lint_source(
+        tmp_path,
+        """
+        import time
+
+
+        def walk(hosts, prompt):
+            for host in hosts:
+                host.probe(prompt)
+
+
+        def bounded_envelope(host):
+            for attempt in range(3):
+                try:
+                    return host.ping()
+                except OSError:
+                    time.sleep(0.05 * (attempt + 1))
+
+
+        def deadline_bounded(host, deadline, clock):
+            while clock() < deadline:
+                try:
+                    return host.ping()
+                except OSError:
+                    time.sleep(0.1)
+
+
+        class Reconciler:
+            def loop(self):
+                while not self._stop.wait(0.2):
+                    self.hosts[0].ping()
+        """,
+    )
+    assert rule_ids(result) == []
+
+
+def test_tpu015_sleepless_while_true_without_network_stays_clean(tmp_path):
+    # unbounded loops that never touch the network are some other rule's
+    # business (a decode engine's dispatch loop, a queue drain)
+    result = lint_source(
+        tmp_path,
+        """
+        def drain(queue):
+            while True:
+                item = queue.get()
+                if item is None:
+                    return
+        """,
+    )
+    assert rule_ids(result) == []
+
+
+def test_tpu015_nested_def_does_not_leak_pacing_or_calls(tmp_path):
+    # a sleep INSIDE a nested function does not pace the outer loop, and a
+    # network call inside a nested function is not the loop's call
+    result = lint_source(
+        tmp_path,
+        """
+        import time
+
+
+        def bad(host):
+            while True:
+                def later():
+                    time.sleep(1.0)
+                host.ping()
+
+
+        def clean(host):
+            while True:
+                def work():
+                    host.ping()
+                register(work)
+                if done():
+                    return
+        """,
+    )
+    assert rule_ids(result) == ["TPU015"]

@@ -125,6 +125,56 @@ def test_serve_cluster_flags_validate_and_export_early(cli_project, monkeypatch)
         os.environ.pop(name, None)
 
 
+def test_serve_fault_tolerance_flags_validate_and_export_early(cli_project, monkeypatch):
+    """The --fault-plan/--probe-interval/--probation-probes/--lease-ttl
+    quartet: usage errors fail NOW, and valid flags export the fault-
+    tolerance env vars under the --dp-replicas early-export contract."""
+    import os
+
+    runner = CliRunner()
+    result = runner.invoke(app, ["serve", "cli_app:model", "--fault-plan", "not json {"])
+    assert result.exit_code != 0 and "--fault-plan" in result.output
+    result = runner.invoke(app, ["serve", "cli_app:model", "--probe-interval", "0"])
+    assert result.exit_code != 0 and "--probe-interval" in result.output
+    result = runner.invoke(app, ["serve", "cli_app:model", "--probation-probes", "0"])
+    assert result.exit_code != 0 and "--probation-probes" in result.output
+    result = runner.invoke(app, ["serve", "cli_app:model", "--lease-ttl", "-1"])
+    assert result.exit_code != 0 and "--lease-ttl" in result.output
+    names = (
+        "UNIONML_TPU_FAULT_PLAN", "UNIONML_TPU_PROBE_INTERVAL_S",
+        "UNIONML_TPU_PROBATION_PROBES", "UNIONML_TPU_LEASE_TTL_S",
+    )
+    for name in names:
+        monkeypatch.delenv(name, raising=False)
+    plan = '{"events": [{"t": 0.5, "kind": "worker_kill", "host": 1}]}'
+    result = runner.invoke(
+        app,
+        ["serve", "cli_app:model", "--fault-plan", plan, "--probe-interval", "0.5",
+         "--probation-probes", "3", "--lease-ttl", "2.0",
+         "--model-path", "/does/not/exist"],  # fails AFTER the export
+    )
+    assert result.exit_code != 0
+    assert os.environ.get("UNIONML_TPU_FAULT_PLAN") == plan
+    assert os.environ.get("UNIONML_TPU_PROBE_INTERVAL_S") == "0.5"
+    assert os.environ.get("UNIONML_TPU_PROBATION_PROBES") == "3"
+    assert os.environ.get("UNIONML_TPU_LEASE_TTL_S") == "2.0"
+    for name in names:
+        # plain pop (see the cluster-flags test): the CLI set these after
+        # delenv, so monkeypatch would restore them and leak chaos into
+        # later tests
+        os.environ.pop(name, None)
+
+
+def test_replay_fault_plan_requires_self_host():
+    result = CliRunner().invoke(
+        app,
+        ["replay", "scenario:chaos_fleet", "--target", "http://127.0.0.1:9",
+         "--fault-plan", '{"events": []}'],
+    )
+    assert result.exit_code != 0
+    assert "--self-host" in result.output
+
+
 def test_app_source_files_snapshot(cli_project):
     from unionml_tpu.cli import _app_source_files
 

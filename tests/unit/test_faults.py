@@ -1,0 +1,544 @@
+"""Fleet fault tolerance (docs/serving.md "Fault tolerance"): deterministic
+fault injection, the host lifecycle state machine, stream-failure semantics,
+and coordinator failover over the rendezvous dir.
+
+Everything here runs in ONE process with real control-plane HTTP (a
+WorkerAgent behind a RemoteHost handle) — the fault plan (serving/faults.py)
+is what stands in for SIGKILL, so every transition is driven, not raced:
+
+- **plan**: schema round trip, version fencing, seeded determinism;
+- **lifecycle**: ``live → suspect`` on an injected drop, ``→ dead`` after
+  the probe-failure streak, ``→ probation → live`` once the fault window
+  closes (virtual time on an injectable clock);
+- **streams**: a host that dies before the first token costs ONE transparent
+  retry on a sibling (token-identical to the oracle); a host that dies after
+  tokens flowed raises the clean 503-shaped :class:`StreamInterrupted`;
+- **failover**: the fenced checkpoint/lease files, lease-expiry promotion of
+  the lowest-id live worker, and the zombie coordinator's writes rejected;
+- **hygiene**: graceful shutdown withdraws the rendezvous announce, and
+  stale-epoch announces from a previous fleet generation are ignored.
+
+The cross-PROCESS leg (SIGKILL a real worker subprocess, restart it, rejoin
+through probation) lives in tests/emulated/test_cluster.py.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from unionml_tpu.models import GenerationConfig, Generator, Llama, LlamaConfig
+from unionml_tpu.serving import ContinuousBatcher
+from unionml_tpu.serving.cluster import (
+    FleetCoordinator,
+    HOST_DEAD,
+    HOST_LIVE,
+    HOST_PROBATION,
+    HOST_SUSPECT,
+    LocalHost,
+    RemoteHost,
+    StreamInterrupted,
+    WorkerAgent,
+    connect_fleet,
+    lease_expired,
+    maybe_promote,
+    read_checkpoint,
+    read_lease,
+    write_checkpoint,
+    write_lease,
+)
+from unionml_tpu.serving.faults import (
+    ArmedFaultPlan,
+    FaultEvent,
+    FaultInjected,
+    FaultPlan,
+    default_chaos_plan,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    config = LlamaConfig.tiny(
+        vocab_size=96, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, hidden_dim=128,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    module = Llama(config)
+    params = module.init(jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32))["params"]
+    return module, params
+
+
+def _cfg(**overrides):
+    kwargs = dict(max_new_tokens=8, temperature=0.0, prompt_buckets=(16,))
+    kwargs.update(overrides)
+    return GenerationConfig(**kwargs)
+
+
+def _engine(tiny, cfg, **kwargs):
+    module, params = tiny
+    knobs = dict(slots=2, decode_chunk=4, block_size=8, pool_blocks=64)
+    knobs.update(kwargs)
+    return ContinuousBatcher(Generator(module, params, cfg), **knobs)
+
+
+def _drain(stream):
+    return [int(t) for chunk in stream for t in np.asarray(chunk).ravel()]
+
+
+def _expected(tiny, cfg, prompts):
+    module, params = tiny
+    gen = Generator(module, params, cfg)
+    return [list(map(int, gen([p])[0])) for p in prompts]
+
+
+PROMPTS = [[3, 1, 4, 1, 5], [9, 2, 6, 5, 3, 5, 8, 9], [7, 1]]
+
+
+class _Clock:
+    """Injectable virtual clock for armed plans (real monotonic elsewhere)."""
+
+    def __init__(self, now=0.0):
+        self.now = float(now)
+
+    def __call__(self):
+        return self.now
+
+
+# ------------------------------------------------------------------- fault plans
+
+
+def test_fault_plan_schema_round_trip_and_validation():
+    plan = FaultPlan.parse(json.dumps({
+        "version": 1, "seed": 7, "events": [
+            {"t": 1.0, "kind": "worker_kill", "host": 1, "for_s": 2.0},
+            {"t": 0.5, "kind": "rpc_drop", "host": 0},
+            {"t": 2.0, "kind": "rpc_delay", "delay_s": 0.01},
+            {"t": 3.0, "kind": "stream_cut", "host": 1, "after_tokens": 2},
+        ],
+    }))
+    assert plan.seed == 7
+    assert [e.kind for e in plan.events] == [
+        "rpc_drop", "worker_kill", "rpc_delay", "stream_cut"
+    ]  # sorted by onset
+    assert plan.horizon_s == pytest.approx(3.25)
+    assert plan.fault_times() == [0.5, 1.0, 3.0]  # rpc_delay is not disruptive
+    # canonical text survives a round trip
+    assert FaultPlan.parse(plan.dumps()).dumps() == plan.dumps()
+    with pytest.raises(ValueError, match="version"):
+        FaultPlan.parse('{"version": 99, "events": []}')
+    with pytest.raises(ValueError, match="kind"):
+        FaultPlan.parse('{"events": [{"t": 0, "kind": "meteor"}]}')
+    with pytest.raises(ValueError, match="events"):
+        FaultPlan.parse('{"seed": 1}')
+    with pytest.raises(ValueError, match="JSON"):
+        FaultPlan.parse("not json")
+
+
+def test_fault_plan_env_reader_degrades_on_garbage(monkeypatch):
+    from unionml_tpu.defaults import SERVE_FAULT_PLAN_ENV_VAR
+
+    monkeypatch.delenv(SERVE_FAULT_PLAN_ENV_VAR, raising=False)
+    assert FaultPlan.from_env() is None
+    monkeypatch.setenv(SERVE_FAULT_PLAN_ENV_VAR, '{"events": [{"t": 0, "kind": "rpc_drop"}]}')
+    plan = FaultPlan.from_env()
+    assert plan is not None and plan.events[0].kind == "rpc_drop"
+    monkeypatch.setenv(SERVE_FAULT_PLAN_ENV_VAR, "/nonexistent/plan.json")
+    assert FaultPlan.from_env() is None  # warn-and-degrade, never a crash
+    monkeypatch.setenv(SERVE_FAULT_PLAN_ENV_VAR, '{"events": "nope"}')
+    assert FaultPlan.from_env() is None
+
+
+def test_armed_plan_is_deterministic_and_windowed():
+    clock = _Clock()
+    plan = FaultPlan([
+        FaultEvent(1.0, "rpc_drop", host=1, for_s=2.0),
+        FaultEvent(5.0, "rpc_delay", host=None, for_s=1.0, delay_s=0.0),
+    ], seed=3)
+    armed = plan.arm(clock=clock)
+    armed.check_rpc(1)  # before the window: no-op
+    clock.now = 1.5
+    with pytest.raises(FaultInjected):
+        armed.check_rpc(1)
+    armed.check_rpc(0)  # scoped to host 1
+    clock.now = 3.5
+    armed.check_rpc(1)  # window closed
+    clock.now = 5.5
+    armed.check_rpc(1)  # rpc_delay with delay_s=0: counted, not raised
+    stats = armed.stats()
+    assert stats == {
+        "worker_kill": 0, "rpc_drop": 1, "rpc_delay": 1, "stream_cut": 0, "events": 2,
+    }
+    # seeded probabilistic drops: identical draw sequences for identical seeds
+    probabilistic = FaultPlan([FaultEvent(0.0, "rpc_drop", for_s=100.0, p=0.5)], seed=11)
+
+    def outcomes(armed_plan):
+        out = []
+        for _ in range(32):
+            try:
+                armed_plan.check_rpc(0)
+                out.append(False)
+            except FaultInjected:
+                out.append(True)
+        return out
+
+    first = outcomes(probabilistic.arm(clock=_Clock(1.0)))
+    second = outcomes(probabilistic.arm(clock=_Clock(1.0)))
+    assert first == second and True in first and False in first
+
+
+def test_default_chaos_plan_shape():
+    plan = default_chaos_plan(seed=5)
+    kinds = [e.kind for e in plan.events]
+    assert kinds == ["rpc_drop", "worker_kill"]
+    assert all(e.host == 1 for e in plan.events)
+    assert plan.fault_times() == [e.t for e in plan.events]
+
+
+# --------------------------------------------------------------- host lifecycle
+
+
+def test_suspect_dead_probation_live_under_injected_drop(tiny):
+    """The whole lifecycle, driven: an rpc_drop window suspects the host and
+    the probe-failure streak kills it; when the window closes, probation
+    probes + warmup bring it back — and the fleet counters/rows tell the
+    story on stats()."""
+    cfg = _cfg()
+    e0, e1 = _engine(tiny, cfg), _engine(tiny, cfg)
+    agent = WorkerAgent(e1, process_id=1).start()
+    coordinator = FleetCoordinator(
+        [LocalHost(e0, host_id=0), RemoteHost(agent.address, host_id=1)],
+        probation_probes=2, dead_after=2, probe_interval_s=0.05,
+    )
+    clock = _Clock(0.0)
+    armed = ArmedFaultPlan(
+        FaultPlan([FaultEvent(1.0, "rpc_drop", host=1, for_s=10.0)]), clock=clock
+    )
+    coordinator._faults = armed
+    coordinator.hosts[1].faults = armed
+    host = coordinator.hosts[1]
+    try:
+        # live: traffic reaches both hosts
+        assert _drain(coordinator.submit(PROMPTS[0])) == _expected(tiny, cfg, PROMPTS[:1])[0]
+        assert host.state == HOST_LIVE
+
+        clock.now = 2.0  # the drop window opens
+        got = [_drain(coordinator.submit(p)) for p in PROMPTS]
+        assert got == _expected(tiny, cfg, PROMPTS)  # routed around, zero sheds
+        assert host.state == HOST_SUSPECT
+        assert host.suspects == 1
+        assert host.rpc_retries >= 1  # the idempotent probe retried first
+
+        # reconciliation probes fail inside the window: suspect -> dead
+        coordinator.reconcile_once()
+        coordinator.reconcile_once()
+        assert host.state == HOST_DEAD
+
+        clock.now = 20.0  # the window closes; the worker is reachable again
+        coordinator.reconcile_once()
+        assert host.state == HOST_PROBATION  # first success: probation, not live
+        assert host.alive is False  # probation takes no traffic yet
+        coordinator.reconcile_once()  # second success reaches the streak + warmup
+        assert host.state == HOST_LIVE
+        assert host.rejoins == 1
+
+        stats = coordinator.stats()
+        fleet = stats["fleet"]
+        assert fleet["host_suspects"] == 1
+        assert fleet["host_rejoins"] == 1
+        assert fleet["rpc_retries"] >= 1
+        assert fleet["recovery_ms"]["window"] == 1
+        assert fleet["states"][HOST_LIVE] == 2
+        assert fleet["faults_injected"]["rpc_drop"] >= 1
+        census = coordinator.host_census()
+        assert census[1]["state"] == HOST_LIVE
+        assert census[1]["last_transition_s"] >= 0.0
+        # the rejoined host takes traffic again
+        assert _drain(coordinator.submit(PROMPTS[2])) == _expected(tiny, cfg, PROMPTS[2:])[0]
+    finally:
+        coordinator.stop_reconciler()
+        agent.close(close_engine=True)
+        e0.close(wait=False)
+
+
+def test_zero_token_stream_retries_on_sibling(tiny):
+    """A host that dies BEFORE the first token costs one transparent retry:
+    the consumer sees the full, oracle-identical stream from the sibling."""
+    cfg = _cfg()
+    e0, e1 = _engine(tiny, cfg), _engine(tiny, cfg)
+    a0 = WorkerAgent(e0, process_id=0).start()
+    a1 = WorkerAgent(e1, process_id=1).start()
+    coordinator = FleetCoordinator([
+        RemoteHost(a0.address, host_id=0), RemoteHost(a1.address, host_id=1),
+    ])
+    clock = _Clock(5.0)
+    armed = ArmedFaultPlan(
+        # cut host 0's NEXT stream before its first token, inside the window
+        FaultPlan([FaultEvent(0.0, "stream_cut", host=0, for_s=100.0, after_tokens=0)]),
+        clock=clock,
+    )
+    coordinator.hosts[0].faults = armed
+    coordinator._faults = armed
+    try:
+        got = _drain(coordinator.submit(PROMPTS[0]))  # ties route to host 0 first
+        assert got == _expected(tiny, cfg, PROMPTS[:1])[0]
+        assert coordinator.stream_retries == 1
+        assert coordinator.streams_interrupted == 0
+        assert coordinator.hosts[0].state == HOST_SUSPECT
+        assert coordinator.stats()["fleet"]["recovery_ms"]["window"] == 1
+    finally:
+        a0.close(close_engine=True)
+        a1.close(close_engine=True)
+
+
+def test_emitted_stream_interrupts_cleanly_not_silently(tiny):
+    """A host that dies AFTER tokens flowed must not hang and must not be
+    silently restitched (the sibling's sampling state differs): the stream
+    raises the 503-shaped StreamInterrupted carrying the emitted count."""
+    cfg = _cfg(max_new_tokens=16)
+    e0 = _engine(tiny, cfg)
+    a0 = WorkerAgent(e0, process_id=0).start()
+    coordinator = FleetCoordinator([RemoteHost(a0.address, host_id=0)])
+    clock = _Clock(5.0)
+    armed = ArmedFaultPlan(
+        FaultPlan([FaultEvent(0.0, "stream_cut", host=0, for_s=100.0, after_tokens=1)]),
+        clock=clock,
+    )
+    coordinator.hosts[0].faults = armed
+    try:
+        stream = coordinator.submit(PROMPTS[0])
+        received = []
+        with pytest.raises(StreamInterrupted) as excinfo:
+            for chunk in stream:
+                received.extend(int(t) for t in np.asarray(chunk).ravel())
+        assert received  # tokens DID flow before the cut
+        assert excinfo.value.emitted == len(received)
+        assert excinfo.value.status == 503
+        assert coordinator.streams_interrupted == 1
+        assert coordinator.stream_retries == 0
+    finally:
+        a0.close(close_engine=True)
+
+
+# ------------------------------------------------------- checkpoint, lease, fencing
+
+
+def test_checkpoint_and_lease_fencing_rejects_zombie_epoch(tmp_path):
+    root = tmp_path / "fleet"
+    assert write_checkpoint(root, epoch=2, num_hosts=2, roster=[]) is True
+    assert read_checkpoint(root)["epoch"] == 2
+    # a zombie (lower epoch) cannot clobber the successor's checkpoint
+    assert write_checkpoint(root, epoch=1, num_hosts=2, roster=[]) is False
+    assert read_checkpoint(root)["epoch"] == 2
+    # same epoch re-writes (the owner's own heartbeat) are allowed
+    assert write_checkpoint(root, epoch=2, num_hosts=2, roster=[], failovers=1) is True
+    assert write_lease(root, epoch=2, owner=0, ttl_s=30.0) is True
+    assert write_lease(root, epoch=1, owner=1, ttl_s=30.0) is False
+    lease = read_lease(root)
+    assert lease["epoch"] == 2 and lease["owner"] == 0
+    assert lease_expired(lease) is False
+    assert lease_expired(None) is True
+    assert lease_expired({"expires_at": 1.0}) is True
+
+
+def test_lease_expiry_promotes_lowest_id_worker_with_fencing(tiny, tmp_path):
+    """Coordinator failover end to end: coordinator A (epoch N) stops
+    heartbeating; once the lease expires, worker 1 — the lowest-id live
+    worker — promotes via connect_fleet with the epoch bumped, and A's
+    subsequent rendezvous writes are rejected (fencing). A stream accepted
+    on the surviving host before the failover drains untouched."""
+    cfg = _cfg()
+    root = tmp_path / "fleet"
+    e0, e1 = _engine(tiny, cfg), _engine(tiny, cfg)
+    agent1 = WorkerAgent(e1, process_id=1).start()
+    agent1.announce(root)
+    coordinator_a = connect_fleet(
+        root, num_hosts=2, timeout_s=10.0, local_engine=e0, local_process_id=0,
+        lease_ttl_s=0.2, start_reconciler=False,
+    )
+    try:
+        assert coordinator_a.epoch == 1
+        assert read_lease(root)["epoch"] == 1
+        # a stream accepted before the failover, drained after it: untouched
+        inflight = coordinator_a.hosts[1].submit(PROMPTS[1])
+
+        # while the lease is FRESH, promotion stands down
+        assert maybe_promote(
+            root, local_engine=e1, local_process_id=1, timeout_s=1.0
+        ) is None
+
+        time.sleep(0.3)  # coordinator A "dies": no heartbeat; the lease expires
+        assert lease_expired(read_lease(root)) is True
+        coordinator_b = maybe_promote(
+            root, local_engine=e1, local_process_id=1, timeout_s=1.0,
+            start_reconciler=False,
+        )
+        assert coordinator_b is not None
+        try:
+            assert coordinator_b.epoch == 2
+            assert coordinator_b.coordinator_failovers == 1
+            assert read_checkpoint(root)["epoch"] == 2
+            assert read_checkpoint(root)["failovers"] == 1
+            assert coordinator_b.stats()["fleet"]["coordinator_failovers"] == 1
+            # the zombie's writes are rejected, and its own heartbeat path
+            # observes the fence
+            assert write_lease(root, epoch=coordinator_a.epoch, owner=0, ttl_s=0.2) is False
+            coordinator_a._heartbeat_lease()
+            assert coordinator_a.fenced is True
+            assert read_lease(root)["epoch"] == 2
+            # host 0 (coordinator A's local engine) never announced: the
+            # promoted roster carries it dead, host 1 serves
+            assert coordinator_b.hosts[0].state == HOST_DEAD
+            assert _drain(coordinator_b.submit(PROMPTS[0])) == _expected(tiny, cfg, PROMPTS[:1])[0]
+            # the pre-failover stream finishes exactly
+            assert _drain(inflight) == _expected(tiny, cfg, PROMPTS[1:2])[0]
+        finally:
+            coordinator_b.stop_reconciler()
+    finally:
+        coordinator_a.stop_reconciler()
+        agent1.close(close_engine=True)
+        e0.close(wait=False)
+
+
+def test_promotion_defers_to_lower_id_live_worker(tiny, tmp_path):
+    cfg = _cfg()
+    root = tmp_path / "fleet"
+    e0, e1 = _engine(tiny, cfg), _engine(tiny, cfg)
+    agent0 = WorkerAgent(e0, process_id=0).start()
+    agent0.announce(root)
+    write_checkpoint(root, epoch=1, num_hosts=2, roster=[])
+    write_lease(root, epoch=1, owner=0, ttl_s=0.05)
+    time.sleep(0.1)  # expired — but worker 0 is alive and lower-id
+    try:
+        assert maybe_promote(
+            root, local_engine=e1, local_process_id=1, timeout_s=1.0
+        ) is None
+    finally:
+        agent0.close(close_engine=True)
+        e1.close(wait=False)
+
+
+# ------------------------------------------------------------ rendezvous hygiene
+
+
+def test_graceful_shutdown_withdraws_announce(tiny, tmp_path):
+    cfg = _cfg()
+    root = tmp_path / "fleet"
+    engine = _engine(tiny, cfg)
+    agent = WorkerAgent(engine, process_id=0).start()
+    path = agent.announce(root)
+    assert path.exists()
+    agent.close(close_engine=True)
+    assert not path.exists()  # a restarted fleet can never ping this address
+
+
+def test_connect_fleet_rejects_stale_epoch_announces(tiny, tmp_path):
+    """Announces stamped below the persisted checkpoint epoch are a previous
+    fleet generation's leftovers: connect_fleet must time out rather than
+    ping a dead address — and a FRESH announce (stamped from the current
+    checkpoint) connects normally."""
+    cfg = _cfg()
+    root = tmp_path / "fleet"
+    root.mkdir()
+    write_checkpoint(root, epoch=3, num_hosts=1, roster=[])
+    # a stale generation-1 leftover pointing at a long-dead port
+    (root / "host-0.json").write_text(json.dumps({
+        "process_id": 0, "host": "127.0.0.1", "port": 9, "pid": 1, "epoch": 1,
+    }))
+    with pytest.raises(TimeoutError):
+        connect_fleet(root, num_hosts=1, timeout_s=0.4, start_reconciler=False)
+    engine = _engine(tiny, cfg)
+    agent = WorkerAgent(engine, process_id=0).start()
+    agent.announce(root)  # stamps the checkpoint's epoch (3)
+    coordinator = connect_fleet(root, num_hosts=1, timeout_s=10.0, start_reconciler=False)
+    try:
+        assert coordinator.hosts[0].epoch == 3
+        assert coordinator.epoch == 4  # floor + 1
+    finally:
+        agent.close(close_engine=True)
+
+
+def test_reconciler_rebinds_replacement_worker_through_probation(tiny, tmp_path):
+    """The in-process replacement story the emulated suite pins across real
+    processes: the worker dies (dead), a NEW incarnation announces at a new
+    address with a fresh epoch, and reconciliation rebinds the handle through
+    probation back to live — token-identical service resumes."""
+    cfg = _cfg()
+    root = tmp_path / "fleet"
+    e0, e1 = _engine(tiny, cfg), _engine(tiny, cfg)
+    agent = WorkerAgent(e1, process_id=1).start()
+    agent.announce(root)
+    coordinator = connect_fleet(
+        root, num_hosts=2, timeout_s=10.0, local_engine=e0, local_process_id=0,
+        start_reconciler=False, probation_probes=2, dead_after=2,
+    )
+    host = coordinator.hosts[1]
+    try:
+        agent.close(close_engine=False)  # the worker process "dies" (announce withdrawn)
+        with pytest.raises(Exception):
+            host.ping(timeout=1.0)
+        assert host.state == HOST_SUSPECT
+        coordinator.reconcile_once()
+        coordinator.reconcile_once()
+        assert host.state == HOST_DEAD
+
+        replacement = WorkerAgent(e1, process_id=1).start()  # new port, same id
+        replacement.announce(root)
+        try:
+            coordinator.reconcile_once()  # scan rebinds + first probation probe
+            assert host.state == HOST_PROBATION
+            assert host.address == replacement.address
+            coordinator.reconcile_once()
+            assert host.state == HOST_LIVE
+            assert host.rejoins == 1
+            got = [_drain(coordinator.submit(p)) for p in PROMPTS]
+            assert got == _expected(tiny, cfg, PROMPTS)
+            # the rebound host is probed and routable again (sequential
+            # submits tie-break to host 0; the probe proves readmission)
+            probes = coordinator._probe_all(coordinator._live(), PROMPTS[0])
+            assert 1 in probes
+        finally:
+            replacement.close(close_engine=True)
+    finally:
+        coordinator.stop_reconciler()
+        e0.close(wait=False)
+
+
+# ------------------------------------------------------------------ surfaces
+
+
+def test_fleet_stats_section_is_none_free_and_prometheus_renders(tiny):
+    from unionml_tpu.observability.prometheus import render
+
+    cfg = _cfg()
+    engine = _engine(tiny, cfg)
+    coordinator = FleetCoordinator([LocalHost(engine, host_id=0)])
+    try:
+        stats = coordinator.stats()
+        fleet = stats["fleet"]
+        assert fleet["epoch"] == 0 and fleet["fenced"] == 0
+        assert fleet["recovery_ms"] == {"window": 0}
+        assert "faults_injected" not in fleet  # absent without a plan, never None
+
+        def no_none(obj):
+            if isinstance(obj, dict):
+                return all(no_none(v) for v in obj.values())
+            if isinstance(obj, list):
+                return all(no_none(v) for v in obj)
+            return obj is not None
+
+        # the NEW surfaces are strictly None-free (pre-existing engine gauges
+        # like rows_per_dispatch may be None pre-traffic; the exposition
+        # renderer skips those by contract)
+        assert no_none(fleet)
+        assert no_none(coordinator.host_census())
+        assert no_none(coordinator.replica_loads())
+        text = render({"generation": stats})
+        assert "fleet" in text and " None" not in text
+        health = coordinator.health()
+        assert health["replicas"][0]["host_state"] == HOST_LIVE
+        assert health["replicas"][0]["last_transition_s"] == 0.0
+    finally:
+        engine.close(wait=False)

@@ -660,3 +660,66 @@ def test_engine_logprobs_stream_and_fences(tiny):
             engine.submit([3], logprobs=True, export_handoff=True)
     finally:
         engine.close()
+
+
+# ------------------------------------------------------------- chaos availability
+
+
+def test_availability_judgment_math():
+    from unionml_tpu.workloads.verdicts import availability
+
+    samples = [
+        # tenant a: 3 ok, launched around one fault at t=1.0
+        {"tenant": "a", "status": 200, "start_s": 0.2, "ttft_s": 0.05},
+        {"tenant": "a", "status": 200, "start_s": 1.4, "ttft_s": 0.25},
+        {"tenant": "a", "status": 200, "start_s": 2.0, "ttft_s": 0.05},
+        # tenant b: one clean error (503 record) and one hang (no status)
+        {"tenant": "b", "status": 200, "start_s": 0.5, "ttft_s": 0.05},
+        {"tenant": "b", "status": 503, "start_s": 1.1, "ttft_s": None},
+        {"tenant": "b", "status": None, "start_s": 1.2, "ttft_s": None},
+    ]
+    out = availability(samples, fault_times_s=[1.0], target=0.99)
+    assert out["requests"] == 6 and out["ok"] == 4
+    assert out["success_ratio"] == pytest.approx(4 / 6, abs=1e-4)
+    assert out["clean_errors"] == 1 and out["hangs"] == 1
+    assert out["clean_error_ratio"] == 0.5
+    assert out["per_tenant"]["a"]["success_ratio"] == 1.0
+    assert out["per_tenant"]["a"]["meets_target"] == 1
+    assert out["per_tenant"]["b"]["meets_target"] == 0
+    # recovery = first post-fault launch that streamed: a's t=1.4 + 0.25 TTFT
+    assert out["recovery"] == [
+        {"fault_t_s": 1.0, "recovered": 1, "recovery_ms": pytest.approx(650.0, abs=1.0)}
+    ]
+    assert out["recovery_ms_max"] == pytest.approx(650.0, abs=1.0)
+
+    # no failures, no faults: both ratios saturate at 1.0 and recovery is absent
+    clean = availability(
+        [{"tenant": "a", "status": 200, "start_s": 0.0, "ttft_s": 0.01}]
+    )
+    assert clean["success_ratio"] == 1.0 and clean["clean_error_ratio"] == 1.0
+    assert "recovery" not in clean
+
+    # an unrecovered fault reports recovered: 0 with NO recovery_ms key
+    # (absent, never None — the exposition contract)
+    dark = availability(
+        [{"tenant": "a", "status": 503, "start_s": 2.0, "ttft_s": None}],
+        fault_times_s=[1.5],
+    )
+    assert dark["recovery"] == [{"fault_t_s": 1.5, "recovered": 0}]
+
+
+def test_replay_report_carries_availability_when_faults_given(tiny):
+    """The replay plumb: fault_times_s adds the availability section built
+    from the records' real launch offsets and TTFTs."""
+    from unionml_tpu.workloads import replay, synthesize
+
+    app, engine = _app(tiny, max_waiting=64)
+    try:
+        requests = synthesize("chaos_fleet", 3, requests_per_tenant=2, duration_s=0.4)
+        report = replay(requests, app=app, fault_times_s=[0.05])
+        availability_block = report["availability"]
+        assert availability_block["requests"] == len(requests)
+        assert set(availability_block["per_tenant"]) == {"chaos-a", "chaos-b"}
+        assert availability_block["recovery"][0]["fault_t_s"] == 0.05
+    finally:
+        engine.close()

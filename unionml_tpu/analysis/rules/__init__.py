@@ -23,6 +23,7 @@ from unionml_tpu.analysis.rules.tpu011_recompile import RecompileHazard
 from unionml_tpu.analysis.rules.tpu012_contextvar import ContextvarExecutorHole
 from unionml_tpu.analysis.rules.tpu013_locked_collectives import BlockingCollectiveUnderLock
 from unionml_tpu.analysis.rules.tpu014_unseeded_random import UnseededRandomness
+from unionml_tpu.analysis.rules.tpu015_unbounded_retry import UnboundedNetworkRetry
 
 __all__ = ["RULES"]
 
@@ -43,5 +44,6 @@ RULES = {
         ContextvarExecutorHole,
         BlockingCollectiveUnderLock,
         UnseededRandomness,
+        UnboundedNetworkRetry,
     )
 }

@@ -449,6 +449,28 @@ def fetch_model(
     help="req/s bucket rate for identified tenants not named in --tenant-config "
     "(anonymous traffic is never bucket-limited); 0 = unlimited",
 )
+@click.option(
+    "--fault-plan", "fault_plan", default=None, metavar="PLAN",
+    help="deterministic fault injection (docs/serving.md 'Fault tolerance'): "
+    "a FaultPlan JSON file (or the JSON inline) of seeded worker_kill/"
+    "rpc_drop/rpc_delay/stream_cut events keyed on virtual time and host id; "
+    "exported as UNIONML_TPU_FAULT_PLAN before the app module imports",
+)
+@click.option(
+    "--probe-interval", default=None, type=float,
+    help="seconds between fleet reconciliation ticks (lease heartbeat, "
+    "suspect/dead re-probes, rendezvous announce scans)",
+)
+@click.option(
+    "--probation-probes", default=None, type=int,
+    help="consecutive successful probes a returning host must pass in "
+    "probation before it takes traffic again",
+)
+@click.option(
+    "--lease-ttl", default=None, type=float,
+    help="coordinator heartbeat-lease TTL in seconds; workers promote the "
+    "lowest-id live worker when the lease expires",
+)
 def serve(
     app_ref: str,
     model_path: Optional[Path],
@@ -494,6 +516,10 @@ def serve(
     slo_shed_ratio: Optional[float],
     tenant_config: Optional[Path],
     default_tenant_rate: Optional[float],
+    fault_plan: Optional[str],
+    probe_interval: Optional[float],
+    probation_probes: Optional[int],
+    lease_ttl: Optional[float],
 ) -> None:
     """Start the HTTP prediction service (reference cli.py:172-205).
 
@@ -595,6 +621,14 @@ def serve(
     — and processes > 0 run their engines behind a loopback control server.
     Same early-export contract as ``--dp-replicas``
     (``UNIONML_TPU_COORDINATOR``/``NUM_PROCESSES``/``PROCESS_ID``).
+
+    Fault tolerance (docs/serving.md "Fault tolerance"): ``--probe-interval``
+    / ``--probation-probes`` / ``--lease-ttl`` tune the fleet coordinator's
+    host lifecycle (a transport failure suspects a host, probation probes +
+    warmup readmit it) and the coordinator heartbeat lease workers watch for
+    failover; ``--fault-plan`` arms a deterministic chaos schedule
+    (serving/faults.py) for drills and the ``fleet_chaos`` bench lane. Same
+    early-export contract as ``--dp-replicas``.
 
     Multi-tenant QoS (docs/serving.md "Multi-tenant QoS"):
     ``--tenant-config tenants.json`` / ``--default-tenant-rate R`` arm the
@@ -749,6 +783,37 @@ def serve(
             if default_tenant_rate < 0:
                 raise click.ClickException("--default-tenant-rate must be >= 0 (0 = unlimited)")
             os.environ[_defaults.SERVE_DEFAULT_TENANT_RATE_ENV_VAR] = repr(default_tenant_rate)
+    if (
+        fault_plan is not None or probe_interval is not None
+        or probation_probes is not None or lease_ttl is not None
+    ):
+        # fleet fault-tolerance knobs (docs/serving.md "Fault tolerance"):
+        # validate NOW (a typo'd explicit flag is a usage error), then export
+        # before the app module imports — the --dp-replicas contract
+        from unionml_tpu import defaults as _defaults
+        from unionml_tpu.serving.faults import FaultPlan as _FaultPlan
+
+        if fault_plan is not None:
+            try:
+                if fault_plan.lstrip().startswith("{"):
+                    _FaultPlan.parse(fault_plan)
+                else:
+                    _FaultPlan.load(fault_plan)
+            except (OSError, ValueError) as exc:
+                raise click.ClickException(f"--fault-plan: {exc}")
+            os.environ[_defaults.SERVE_FAULT_PLAN_ENV_VAR] = fault_plan
+        if probe_interval is not None:
+            if probe_interval <= 0:
+                raise click.ClickException("--probe-interval must be > 0 seconds")
+            os.environ[_defaults.FLEET_PROBE_INTERVAL_S_ENV_VAR] = repr(probe_interval)
+        if probation_probes is not None:
+            if probation_probes < 1:
+                raise click.ClickException("--probation-probes must be >= 1")
+            os.environ[_defaults.FLEET_PROBATION_PROBES_ENV_VAR] = str(probation_probes)
+        if lease_ttl is not None:
+            if lease_ttl <= 0:
+                raise click.ClickException("--lease-ttl must be > 0 seconds")
+            os.environ[_defaults.FLEET_LEASE_TTL_S_ENV_VAR] = repr(lease_ttl)
     # observability knobs: same early-export contract as --dp-replicas (the
     # serving app reads them at construction; reload/fork children inherit)
     if trace is not None or flight_recorder_size is not None or profile_dir is not None:
@@ -901,6 +966,13 @@ def serve(
     "--out", default=None, type=click.Path(dir_okay=False, path_type=Path),
     help="write the report JSON here as well as stdout",
 )
+@click.option(
+    "--fault-plan", "fault_plan", default=None, metavar="PLAN",
+    help="chaos mode (--self-host only): arm this FaultPlan (JSON file or "
+    "inline) on the app's fleet coordinator when the replay starts, and add "
+    "the availability section (success/clean-error ratios, per-fault "
+    "recovery-to-first-routed-token) to the report",
+)
 def replay_cmd(
     trace: str,
     target: Optional[str],
@@ -911,6 +983,7 @@ def replay_cmd(
     concurrency: int,
     grace_ms: float,
     out: Optional[Path],
+    fault_plan: Optional[str],
 ) -> None:
     """Replay a traffic trace through the real HTTP stack and judge it.
 
@@ -958,6 +1031,22 @@ def replay_cmd(
                 targets = scenario_targets(str(meta["scenario"]))
             except ValueError:
                 targets = None
+    plan = None
+    if fault_plan is not None:
+        if self_host is None:
+            raise click.ClickException(
+                "--fault-plan needs --self-host (the plan arms the app's own fleet "
+                "coordinator; a --target server arms its own via serve --fault-plan)"
+            )
+        from unionml_tpu.serving.faults import FaultPlan
+
+        try:
+            if fault_plan.lstrip().startswith("{"):
+                plan = FaultPlan.parse(fault_plan)
+            else:
+                plan = FaultPlan.load(fault_plan)
+        except (OSError, ValueError) as exc:
+            raise click.ClickException(f"--fault-plan: {exc}")
     serving = None
     if self_host is not None:
         if model_path is not None:
@@ -974,6 +1063,17 @@ def replay_cmd(
 
         serving = located if isinstance(located, ServingApp) else located.serve()
         serving.startup()
+    fault_times = None
+    if plan is not None:
+        engine = getattr(serving.model, "generation_batcher", None)
+        arm = getattr(engine, "arm_faults", None)
+        if not callable(arm):
+            raise click.ClickException(
+                "--fault-plan needs a fleet coordinator behind the app "
+                "(serve --num-hosts; a single-engine app has no host lifecycle to chaos)"
+            )
+        arm(plan)  # virtual time starts now — the replay launches immediately
+        fault_times = plan.fault_times()
     report = replay(
         requests,
         app=serving,
@@ -983,6 +1083,7 @@ def replay_cmd(
         grace_s=grace_ms / 1000.0,
         targets=targets,
         meta=meta,
+        fault_times_s=fault_times,
     )
     rendered = json.dumps(report, indent=2)
     click.echo(rendered)

@@ -215,6 +215,36 @@ FLEET_DIR_ENV_VAR = "UNIONML_TPU_FLEET_DIR"
 #: unset/empty = every host mixed. Garbage warns and degrades to symmetric.
 FLEET_HOST_ROLES_ENV_VAR = "UNIONML_TPU_HOST_ROLES"
 
+# ----------------------------------------------------------- fleet fault tolerance
+# Host-lifecycle / failover / fault-injection knobs (serving/cluster.py,
+# serving/faults.py, docs/serving.md "Fault tolerance"). Same early-export
+# contract as SERVE_DP_REPLICAS_ENV_VAR: the serve CLI sets these before the
+# app module imports, and the coordinator/worker read them at construction.
+
+#: a deterministic fault plan (serving/faults.py): a path to a plan JSON, or
+#: the JSON inline (starts with ``{``). Unset = no injection. A garbage value
+#: warns and degrades to no plan — chaos must be opt-in, never accidental.
+SERVE_FAULT_PLAN_ENV_VAR = "UNIONML_TPU_FAULT_PLAN"
+
+#: seconds between coordinator reconciliation ticks (lease heartbeat,
+#: suspect/dead re-probes, rendezvous-dir announce scans).
+FLEET_PROBE_INTERVAL_S_ENV_VAR = "UNIONML_TPU_PROBE_INTERVAL_S"
+FLEET_PROBE_INTERVAL_S = 1.0
+
+#: consecutive successful probes a returning host must pass in probation
+#: before it takes traffic again.
+FLEET_PROBATION_PROBES_ENV_VAR = "UNIONML_TPU_PROBATION_PROBES"
+FLEET_PROBATION_PROBES = 2
+
+#: consecutive probe failures that move a suspect host to dead.
+FLEET_DEAD_AFTER_PROBES_ENV_VAR = "UNIONML_TPU_DEAD_AFTER_PROBES"
+FLEET_DEAD_AFTER_PROBES = 3
+
+#: coordinator heartbeat-lease TTL (seconds): workers treat a lease older
+#: than this as an expired coordinator and the lowest-id live worker promotes.
+FLEET_LEASE_TTL_S_ENV_VAR = "UNIONML_TPU_LEASE_TTL_S"
+FLEET_LEASE_TTL_S = 5.0
+
 
 def distributed_coordinator() -> "str | None":
     """The ``jax.distributed`` coordinator address (``host:port``); None =
@@ -248,6 +278,38 @@ def fleet_dir() -> str:
     if raw is None or not raw.strip():
         return ".unionml_fleet"
     return raw.strip()
+
+
+def serve_fault_plan() -> "str | None":
+    """The fault-plan spec (``UNIONML_TPU_FAULT_PLAN``): a path or inline
+    JSON; None = no injection. Validity is the consumer's concern —
+    ``FaultPlan.from_env`` warns and degrades on garbage (the serve-export
+    contract), never crashes serve at app-import time."""
+    raw = os.environ.get(SERVE_FAULT_PLAN_ENV_VAR)
+    if raw is None or not raw.strip():
+        return None
+    return raw.strip()
+
+
+def fleet_probe_interval_s() -> float:
+    """Seconds between coordinator reconciliation ticks; garbage warns and
+    degrades to the default (the env_float contract)."""
+    return env_float(FLEET_PROBE_INTERVAL_S_ENV_VAR, FLEET_PROBE_INTERVAL_S, minimum=0.05)
+
+
+def fleet_probation_probes() -> int:
+    """Consecutive probe successes a returning host needs before going live."""
+    return env_int(FLEET_PROBATION_PROBES_ENV_VAR, FLEET_PROBATION_PROBES, minimum=1)
+
+
+def fleet_dead_after_probes() -> int:
+    """Consecutive probe failures that move a suspect host to dead."""
+    return env_int(FLEET_DEAD_AFTER_PROBES_ENV_VAR, FLEET_DEAD_AFTER_PROBES, minimum=1)
+
+
+def fleet_lease_ttl_s() -> float:
+    """Coordinator heartbeat-lease TTL in seconds."""
+    return env_float(FLEET_LEASE_TTL_S_ENV_VAR, FLEET_LEASE_TTL_S, minimum=0.1)
 
 
 def fleet_host_roles() -> "dict[str, int]":

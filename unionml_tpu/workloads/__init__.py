@@ -23,4 +23,8 @@ from unionml_tpu.workloads.traces import (  # noqa: F401
     set_active_traffic_recorder,
     write_trace,
 )
-from unionml_tpu.workloads.verdicts import overall_state, tenant_verdicts  # noqa: F401
+from unionml_tpu.workloads.verdicts import (  # noqa: F401
+    availability,
+    overall_state,
+    tenant_verdicts,
+)

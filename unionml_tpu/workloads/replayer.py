@@ -45,7 +45,7 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 from unionml_tpu._logging import logger
 from unionml_tpu.workloads.traces import TraceRequest
-from unionml_tpu.workloads.verdicts import overall_state, tenant_verdicts
+from unionml_tpu.workloads.verdicts import availability, overall_state, tenant_verdicts
 
 __all__ = ["replay", "replay_async"]
 
@@ -103,8 +103,8 @@ class _Record:
     """One replayed request's outcome (plain attrs; rendered into the report)."""
 
     __slots__ = (
-        "tenant", "status", "shed", "error", "lag_s", "ttft_s", "tbt_s",
-        "e2e_s", "tokens", "retry_after",
+        "tenant", "status", "shed", "error", "lag_s", "start_s", "ttft_s",
+        "tbt_s", "e2e_s", "tokens", "retry_after",
     )
 
     def __init__(self, tenant: Optional[str]):
@@ -113,6 +113,9 @@ class _Record:
         self.shed = False
         self.error = False
         self.lag_s = 0.0
+        #: actual launch offset from replay t0 (the availability section's
+        #: time base — fault plans are keyed on the same virtual clock)
+        self.start_s = 0.0
         self.ttft_s: Optional[float] = None
         self.tbt_s: "List[float]" = []
         self.e2e_s: Optional[float] = None
@@ -335,13 +338,18 @@ async def replay_async(
     grace_s: float = 0.25,
     targets: "Optional[Dict[str, Dict[str, float]]]" = None,
     meta: "Optional[Dict[str, Any]]" = None,
+    fault_times_s: "Optional[Sequence[float]]" = None,
 ) -> "Dict[str, Any]":
     """Replay ``requests`` open-loop and return the report dict. Exactly one
     of ``app`` (a started ServingApp — in-process HTTP dispatch) or ``target``
     (a base URL) must be given. ``rate_scale`` compresses (>1) or stretches
     (<1) the arrival schedule; ``concurrency`` bounds in-flight requests (a
     safety valve — hitting it shows up as schedule lag, not silence);
-    ``targets`` adds the per-tenant verdict block."""
+    ``targets`` adds the per-tenant verdict block. ``fault_times_s`` (a
+    chaos run's fault onsets, on the replay's own virtual clock — arm the
+    FaultPlan when the replay starts) adds the ``availability`` section:
+    success ratio, clean-error ratio, and per-fault
+    recovery-to-first-routed-token (workloads/verdicts.py)."""
     if (app is None) == (target is None):
         raise ValueError("pass exactly one of app= (self-hosted) or target= (URL)")
     if concurrency < 1:
@@ -382,7 +390,8 @@ async def replay_async(
             if session is not None:
                 effective_planned = max(planned, session.done_at)
             async with semaphore:
-                record.lag_s = max((time.monotonic() - t0) - effective_planned, 0.0)
+                record.start_s = time.monotonic() - t0
+                record.lag_s = max(record.start_s - effective_planned, 0.0)
                 prompt = _materialize_prompt(request)
                 if session is not None and request.turn:
                     prompt = list(session.history) + prompt
@@ -407,7 +416,8 @@ async def replay_async(
             executor.shutdown(wait=False)
     wall = time.monotonic() - t0
     return _report(
-        records, wall, grace_s=grace_s, rate_scale=rate_scale, targets=targets, meta=meta
+        records, wall, grace_s=grace_s, rate_scale=rate_scale, targets=targets,
+        meta=meta, fault_times_s=fault_times_s,
     )
 
 
@@ -419,6 +429,7 @@ def _report(
     rate_scale: float,
     targets: "Optional[Dict[str, Dict[str, float]]]",
     meta: "Optional[Dict[str, Any]]",
+    fault_times_s: "Optional[Sequence[float]]" = None,
 ) -> "Dict[str, Any]":
     per_tenant: "Dict[str, Dict[str, Any]]" = {}
     by_tenant: "Dict[str, List[_Record]]" = {}
@@ -464,6 +475,19 @@ def _report(
         verdicts = tenant_verdicts(per_tenant, targets)
         report["verdicts"] = verdicts
         report["verdict_state"] = overall_state(verdicts)
+    if fault_times_s is not None:
+        report["availability"] = availability(
+            (
+                {
+                    "tenant": r.tenant,
+                    "status": r.status,
+                    "start_s": r.start_s,
+                    "ttft_s": r.ttft_s,
+                }
+                for r in records
+            ),
+            fault_times_s=fault_times_s,
+        )
     return report
 
 
@@ -477,9 +501,11 @@ def replay(
     grace_s: float = 0.25,
     targets: "Optional[Dict[str, Dict[str, float]]]" = None,
     meta: "Optional[Dict[str, Any]]" = None,
+    fault_times_s: "Optional[Sequence[float]]" = None,
 ) -> "Dict[str, Any]":
     """The sync entry point (owns its event loop): see :func:`replay_async`."""
     return asyncio.run(replay_async(
         requests, app=app, target=target, concurrency=concurrency,
         rate_scale=rate_scale, grace_s=grace_s, targets=targets, meta=meta,
+        fault_times_s=fault_times_s,
     ))

@@ -123,6 +123,32 @@ def _burst_tenants(rng: "random.Random", params: "Dict[str, Any]") -> "List[Trac
     return out
 
 
+def _chaos_fleet(rng: "random.Random", params: "Dict[str, Any]") -> "List[TraceRequest]":
+    """Steady, well-behaved cadences from two tenants across the whole
+    window — deliberately unremarkable traffic, because the drama comes from
+    OUTSIDE the trace: the mix is replayed while a seeded FaultPlan
+    (serving/faults.py, e.g. ``default_chaos_plan``) kills and restores a
+    fleet host. The availability verdict (success ratio, clean-error ratio,
+    recovery-to-first-routed-token) is what judges the fleet's lifecycle
+    machinery; requests spanning the kill window are the ones that must
+    route around, retry zero-token streams, and never hang."""
+    vocab = int(params["vocab"])
+    duration = float(params["duration_s"])
+    per_tenant = int(params["requests_per_tenant"])
+    out: "List[TraceRequest]" = []
+    for w, tenant in enumerate(params["tenants"]):
+        phase = rng.uniform(0.0, duration / max(per_tenant, 1) / 2)
+        for i in range(per_tenant):
+            out.append(TraceRequest(
+                t=phase + i * (duration / max(per_tenant, 1)),
+                route="/v1/completions",
+                prompt=_prompt(rng, rng.randint(*params["prompt_tokens"]), vocab),
+                max_tokens=int(params["max_tokens"]),
+                tenant=str(tenant),
+            ))
+    return out
+
+
 def _deadline_heavy(rng: "random.Random", params: "Dict[str, Any]") -> "List[TraceRequest]":
     """Tight per-request deadlines, a fraction infeasible by construction —
     the shed paths (before enqueue, while waiting, mid-prefill) must answer
@@ -182,6 +208,21 @@ SCENARIOS: "Dict[str, Dict[str, Any]]" = {
             "wb-0": {"tbt_p99_ms": 5000.0, "shed_ratio": 0.01},
             "wb-1": {"tbt_p99_ms": 5000.0, "shed_ratio": 0.01},
             "wb-2": {"tbt_p99_ms": 5000.0, "shed_ratio": 0.01},
+        },
+    },
+    "chaos_fleet": {
+        "builder": _chaos_fleet,
+        "params": {
+            "vocab": 90, "duration_s": 3.0, "requests_per_tenant": 12,
+            "tenants": ("chaos-a", "chaos-b"), "prompt_tokens": (4, 8),
+            "max_tokens": 5,
+        },
+        # the latency targets are generous (a kill-and-rejoin may cost a
+        # beat); the availability gate — success ratio >= 0.99 per tenant —
+        # rides the replay's availability section, not these verdicts
+        "targets": {
+            "chaos-a": {"ttft_p95_ms": 10000.0, "shed_ratio": 0.01},
+            "chaos-b": {"ttft_p95_ms": 10000.0, "shed_ratio": 0.01},
         },
     },
     "deadline_heavy": {

@@ -26,11 +26,11 @@ tenant's latency flips that tenant's verdict — visibly.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Iterable, Optional, Sequence
 
 from unionml_tpu.observability.slo import STATE_CODES, worst_state
 
-__all__ = ["OBJECTIVES", "overall_state", "tenant_verdicts"]
+__all__ = ["OBJECTIVES", "availability", "overall_state", "tenant_verdicts"]
 
 #: objective name -> (per-tenant metric section, metric key within it);
 #: shed_ratio reads the flat per-tenant counter instead of a latency window
@@ -122,3 +122,84 @@ def overall_state(verdicts: "Dict[str, Dict[str, Any]]") -> str:
     empty verdict block — no targets declared means nothing to fail)."""
     worst = max((entry["state_code"] for entry in verdicts.values()), default=0)
     return _STATE_BY_CODE[int(worst)]
+
+
+def availability(
+    samples: "Iterable[Dict[str, Any]]",
+    *,
+    fault_times_s: "Sequence[float]" = (),
+    target: float = 0.99,
+) -> "Dict[str, Any]":
+    """The chaos-replay judgment: did the fleet degrade *gracefully*?
+
+    ``samples`` is one dict per replayed request (the replayer's shape):
+    ``tenant``, ``status`` (HTTP status, or ``None`` for a transport-level
+    failure — the unclean kind), ``start_s`` (launch offset from replay t0)
+    and ``ttft_s`` (``None`` when no token arrived). Three judgments:
+
+    - **success ratio** — fraction of requests answered 200, overall and per
+      tenant (the per-tenant view is what the ``fleet_chaos`` lane gates at
+      ``target`` for well-behaved tenants: a kill-and-rejoin plan may cost a
+      beat of latency, not answers);
+    - **clean-error ratio** — of the requests that did NOT succeed, the
+      fraction that failed *cleanly* (a real HTTP error record — the
+      coordinator's 503-shaped :class:`StreamInterrupted` posture) rather
+      than a hang or transport drop (1.0 when nothing failed);
+    - **recovery** — for each fault onset in ``fault_times_s``, the virtual
+      milliseconds until the first request LAUNCHED after the fault got its
+      first routed token (``recovered: 0`` and no ``recovery_ms`` key when
+      nothing after that fault ever streamed — absent, never ``None``).
+
+    Every leaf is numeric or bool-as-int — the /metrics exposition contract,
+    so an availability block rides straight into BENCH_ALL.json."""
+    rows = list(samples)
+    per_tenant: "Dict[str, Dict[str, Any]]" = {}
+    ok = hangs = clean = 0
+    for row in rows:
+        tenant = str(row.get("tenant") or "anonymous")
+        entry = per_tenant.setdefault(tenant, {"requests": 0, "ok": 0})
+        entry["requests"] += 1
+        if row.get("status") == 200:
+            ok += 1
+            entry["ok"] += 1
+        elif row.get("status") is None:
+            hangs += 1
+        else:
+            clean += 1
+    for entry in per_tenant.values():
+        entry["success_ratio"] = (
+            round(entry["ok"] / entry["requests"], 4) if entry["requests"] else 1.0
+        )
+        entry["meets_target"] = int(entry["success_ratio"] >= target)
+    recovery: "list[Dict[str, Any]]" = []
+    for fault_t in sorted(float(t) for t in fault_times_s):
+        first: "Optional[float]" = None
+        for row in rows:
+            start = row.get("start_s")
+            ttft = row.get("ttft_s")
+            if start is None or ttft is None or float(start) < fault_t:
+                continue
+            arrived = float(start) + float(ttft)
+            if first is None or arrived < first:
+                first = arrived
+        entry = {"fault_t_s": round(fault_t, 3), "recovered": int(first is not None)}
+        if first is not None:
+            entry["recovery_ms"] = round(max(first - fault_t, 0.0) * 1e3, 3)
+        recovery.append(entry)
+    failed = len(rows) - ok
+    out: "Dict[str, Any]" = {
+        "requests": len(rows),
+        "ok": ok,
+        "success_ratio": round(ok / len(rows), 4) if rows else 1.0,
+        "clean_errors": clean,
+        "hangs": hangs,
+        "clean_error_ratio": round(clean / failed, 4) if failed else 1.0,
+        "target": float(target),
+        "per_tenant": per_tenant,
+    }
+    if recovery:
+        out["recovery"] = recovery
+        recovered = [e["recovery_ms"] for e in recovery if "recovery_ms" in e]
+        if recovered:
+            out["recovery_ms_max"] = max(recovered)
+    return out
