@@ -11,6 +11,12 @@ Tracked across rounds:
   checks, warm pays only the hash check and the whole-program rule passes;
 - ``index_build_ms`` — the project-index construction cost alone (one fused
   traversal per file), which rides the tier-1 gate's 5 s budget;
+- ``cfg_build_ms`` — time spent building per-function CFGs during the cold
+  run (the flow layer's fixed cost: exception-edge construction plus the
+  splitting-style finally/with duplication);
+- ``flow_files_per_sec`` — files/sec through the flow rules alone
+  (TPU002/TPU015/TPU016-TPU019 on a warm index), isolating the dataflow
+  worklist cost from parse and the cheap syntactic rules;
 - ``suppressed_findings`` — every ``# tpu-lint: disable=`` carries a written
   justification, and the count should only go down round over round (a rising
   count means suppressions are becoming the path of least resistance);
@@ -41,14 +47,18 @@ def main() -> None:
     from unionml_tpu.analysis import build_index, clear_index_cache, run_lint
     from unionml_tpu.analysis.engine import iter_py_files
 
+    from unionml_tpu.analysis.cfg import consume_build_time_ms
+
     paths = [ROOT / tree for tree in TREES if (ROOT / tree).exists()]
     files = iter_py_files(paths)
 
     # cold: empty cache — parse + summary build + every rule check
     clear_index_cache()
+    consume_build_time_ms()  # reset: don't attribute import-time CFG work here
     cold_start = time.perf_counter()
     result = run_lint(paths)
     cold_wall = time.perf_counter() - cold_start
+    cfg_build_ms = consume_build_time_ms()
 
     # index build alone, warm-adjacent (fresh cache, no rule checks)
     clear_index_cache()
@@ -62,10 +72,20 @@ def main() -> None:
         start = time.perf_counter()
         result = run_lint(paths)
         best = min(best, time.perf_counter() - start)
+    # flow rules alone on a warm index: the dataflow worklist cost in isolation
+    flow_rules = ("TPU002", "TPU015", "TPU016", "TPU017", "TPU018", "TPU019")
+    flow_best = float("inf")
+    for _ in range(REPEATS):
+        start = time.perf_counter()
+        flow_result = run_lint(paths, select=flow_rules)
+        flow_best = min(flow_best, time.perf_counter() - start)
+
     gated = run_lint([ROOT / "unionml_tpu"])
     log(
         f"lint: {result.files} files cold {cold_wall:.3f}s / warm {best:.3f}s "
-        f"(index build {index_build_s * 1000:.0f}ms), {len(result.findings)} active / "
+        f"(index build {index_build_s * 1000:.0f}ms, CFG build {cfg_build_ms:.0f}ms, "
+        f"flow rules {flow_result.files / flow_best if flow_best > 0 else 0.0:.0f} files/s), "
+        f"{len(result.findings)} active / "
         f"{len(result.suppressed)} suppressed findings ({len(gated.findings)} active in the gated tree)"
     )
     emit(
@@ -77,6 +97,8 @@ def main() -> None:
         lint_wall_s=round(best, 4),
         cold_wall_s=round(cold_wall, 4),
         index_build_ms=round(index_build_s * 1000.0, 1),
+        cfg_build_ms=round(cfg_build_ms, 1),
+        flow_files_per_sec=round(flow_result.files / flow_best, 1) if flow_best > 0 else 0.0,
         index_cache_hits=result.index_stats.get("hits", 0),
         index_cache_misses=result.index_stats.get("misses", 0),
         files=result.files,

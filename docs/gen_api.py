@@ -77,6 +77,8 @@ MODULES = [
     "unionml_tpu.analysis",
     "unionml_tpu.analysis.engine",
     "unionml_tpu.analysis.project",
+    "unionml_tpu.analysis.cfg",
+    "unionml_tpu.analysis.dataflow",
     "unionml_tpu.artifact",
     "unionml_tpu.distributed",
     "unionml_tpu.remote",

@@ -167,8 +167,31 @@ def test_tpu002_flags_use_after_donate(tmp_path):
             return state
         """,
     )
-    assert rule_ids(result) == ["TPU002"]
-    assert "'state'" in result.findings[0].message
+    # two findings: the loop back edge carries the donation into the next
+    # iteration's `compiled(state, batch)` (a donated buffer passed again),
+    # and the donation reaches `return state`
+    assert rule_ids(result) == ["TPU002", "TPU002"]
+    assert all("'state'" in f.message for f in result.findings)
+
+
+def test_tpu002_path_sensitive_branches(tmp_path):
+    # a load on the branch the donation did NOT take is clean; the line-order
+    # heuristic this replaced would have flagged it
+    result = lint_source(
+        tmp_path,
+        """
+        import jax
+
+        def step_once(state, batch, step_fn, dry_run):
+            compiled = jax.jit(step_fn, donate_argnums=0)
+            if dry_run:
+                compiled(state, batch)
+            else:
+                print(state)
+            return None
+        """,
+    )
+    assert rule_ids(result) == []
 
 
 def test_tpu002_near_miss_rebound_and_variable_argnums(tmp_path):
@@ -230,9 +253,12 @@ def test_tpu002_attribute_jit_and_decorator(tmp_path):
             return carry
         """,
     )
-    assert rule_ids(result) == ["TPU002", "TPU002"]
+    # Engine.bad's `cache.shape`, plus two in module_level: the loop back
+    # edge carries the donation into the next iteration's `update(carry, x)`
+    # and the donation reaches `return carry`
+    assert rule_ids(result) == ["TPU002", "TPU002", "TPU002"]
     lines = sorted(finding.line for finding in result.findings)
-    assert len(lines) == 2  # Engine.bad's `cache.shape` + module_level's `carry`
+    assert len(lines) == 3
 
 
 # --------------------------------------------------------------------- TPU003
@@ -1521,9 +1547,11 @@ def test_tpu002_cross_module_donor(tmp_path):
             """,
         },
     )
-    assert rule_ids(result) == ["TPU002"]
-    assert result.findings[0].path.endswith("train.py")
-    assert "'state'" in result.findings[0].message
+    # two findings in train(): the loop back edge carries the donation into
+    # the next iteration's `update(state, x)`, and it reaches `return state`
+    assert rule_ids(result) == ["TPU002", "TPU002"]
+    assert all(f.path.endswith("train.py") for f in result.findings)
+    assert all("'state'" in f.message for f in result.findings)
 
 
 def test_project_rule_findings_respect_suppressions(tmp_path):
@@ -1775,7 +1803,9 @@ def test_sarif_reporter_round_trip(tmp_path):
     assert active[0]["ruleId"] == "TPU005"
     region = active[0]["locations"][0]["physicalLocation"]["region"]
     assert region["startLine"] == 4 and region["startColumn"] >= 1
-    assert suppressed[0]["suppressions"] == [{"kind": "inSource"}]
+    assert suppressed[0]["suppressions"] == [
+        {"kind": "inSource", "justification": "# tpu-lint: disable"}
+    ]
     assert run["invocations"][0]["executionSuccessful"] is True
 
 
@@ -2003,3 +2033,538 @@ def test_tpu015_nested_def_does_not_leak_pacing_or_calls(tmp_path):
         """,
     )
     assert rule_ids(result) == ["TPU015"]
+
+
+# ------------------------------------------------------- CFG construction
+
+
+def _cfg_of(source, name="f"):
+    import ast
+
+    from unionml_tpu.analysis.cfg import build_cfg
+
+    tree = ast.parse(textwrap.dedent(source))
+    func = next(
+        n
+        for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) and n.name == name
+    )
+    return build_cfg(func)
+
+
+def _nodes_calling(cfg, fname):
+    import ast
+
+    out = []
+    for node in cfg.statement_nodes():
+        for expr in node.exprs:
+            if expr is None:
+                continue
+            for sub in ast.walk(expr):
+                if (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Name)
+                    and sub.func.id == fname
+                ):
+                    out.append(node)
+    return out
+
+
+def test_cfg_try_finally_with_return_threads_the_finally():
+    # the finally body runs on the return path: the release node's successors
+    # reach function EXIT, and no path skips it
+    cfg = _cfg_of(
+        """
+        def f(x, release):
+            try:
+                return x
+            finally:
+                release()
+        """
+    )
+    releases = _nodes_calling(cfg, "release")
+    assert releases, "finally body missing from CFG"
+    assert any(
+        dst == cfg.exit for node in releases for dst, _ in node.succs
+    ), "return continuation does not pass through the finally"
+
+
+def test_cfg_try_finally_with_break_exits_the_loop():
+    # break inside try/finally: the finally copy on the break continuation
+    # leads OUT of the loop (to `done()`), not back to the header
+    cfg = _cfg_of(
+        """
+        def f(items, release, done):
+            for item in items:
+                try:
+                    break
+                finally:
+                    release()
+            done()
+        """
+    )
+    done_nids = {n.nid for n in _nodes_calling(cfg, "done")}
+    assert done_nids
+    assert any(
+        dst in done_nids for node in _nodes_calling(cfg, "release") for dst, _ in node.succs
+    ), "break continuation does not leave the loop after the finally"
+
+
+def test_cfg_nested_handlers_with_reraise_route_to_outer_catch_all():
+    # the inner handler's bare `raise` lands in the OUTER handler; with the
+    # outer being a catch-all and nothing else raising, the function cannot
+    # terminate by exception
+    cfg = _cfg_of(
+        """
+        def f(work):
+            try:
+                try:
+                    work()
+                except ValueError:
+                    raise
+            except Exception:
+                x = 1
+        """
+    )
+    assert cfg.nodes[cfg.raise_node].preds == []
+
+
+def test_cfg_with_tuple_target_and_split_exits():
+    import ast
+
+    # `with make() as (a, b):` — both names are bound at the with header, and
+    # the splitting-style __exit__ gives the normal and exception
+    # continuations their own with_exit nodes
+    cfg = _cfg_of(
+        """
+        def f(make, use):
+            with make() as (a, b):
+                use(a, b)
+        """
+    )
+    header = next(n for n in cfg.statement_nodes() if isinstance(n.stmt, ast.With))
+    bound = {
+        sub.id
+        for expr in header.exprs
+        if expr is not None
+        for sub in ast.walk(expr)
+        if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store)
+    }
+    assert bound == {"a", "b"}
+    exits = [n for n in cfg.statement_nodes() if n.kind == "with_exit"]
+    assert len(exits) == 2  # one for normal completion, one for the exc path
+    kinds = {kind for n in exits for _, kind in n.succs}
+    assert "exc" in kinds  # the exception continuation keeps raising
+
+
+def test_cfg_while_else_runs_on_normal_exit():
+    cfg = _cfg_of(
+        """
+        def f(n, finish, after):
+            while n > 0:
+                n -= 1
+            else:
+                finish()
+            after()
+        """
+    )
+    assert cfg.back_edges, "loop has no back edge"
+    finish = _nodes_calling(cfg, "finish")
+    assert finish, "while/else body missing"
+    # else runs off the loop's FALSE edge, then falls through to after()
+    assert any(kind == "false" for _, kind in finish[0].preds)
+    after_nids = {n.nid for n in _nodes_calling(cfg, "after")}
+    assert any(dst in after_nids for dst, _ in finish[0].succs)
+
+
+def test_cfg_yield_inside_with_is_a_marked_suspension():
+    cfg = _cfg_of(
+        """
+        def f(lock):
+            with lock:
+                yield 1
+        """
+    )
+    yields = [n for n in cfg.statement_nodes() if n.is_yield]
+    assert len(yields) == 1
+    # the suspension sits between the with header and its exit
+    import ast
+
+    header = next(n for n in cfg.statement_nodes() if isinstance(n.stmt, ast.With))
+    assert any(src == header.nid for src, _ in yields[0].preds)
+
+
+# ------------------------------------------------------- dataflow + dominators
+
+
+def test_dataflow_exception_edge_drops_the_statements_own_gen():
+    # acquire-style fact: generated when the statement COMPLETES, so the exc
+    # edge out of the generating statement must not carry it
+    import ast
+
+    from unionml_tpu.analysis.dataflow import Problem, solve_forward
+
+    cfg = _cfg_of(
+        """
+        def f(acquire, use):
+            h = acquire()
+            use(h)
+        """
+    )
+
+    class Acquired(Problem):
+        def gen_kill(self, node):
+            gen = set()
+            if node.stmt is not None and isinstance(node.stmt, ast.Assign):
+                gen.add("h")
+            return gen, set()
+
+    sol = solve_forward(cfg, Acquired())
+    use_node = _nodes_calling(cfg, "use")[0]
+    assert "h" in sol.in_facts(use_node.nid)  # normal path has the fact
+    # but the exception exit only sees facts from use(h)'s OWN exc edge —
+    # the assign's exc edge (acquire() itself raised) carries nothing
+    assert sol.at_raise == frozenset({"h"})
+
+
+def test_dominators_branch_join():
+    import ast
+
+    from unionml_tpu.analysis.dataflow import dominators
+
+    cfg = _cfg_of(
+        """
+        def f(cond, a, b, join):
+            if cond:
+                a()
+            else:
+                b()
+            join()
+        """
+    )
+    dom = dominators(cfg)
+    header = next(n for n in cfg.statement_nodes() if isinstance(n.stmt, ast.If))
+    a_node = _nodes_calling(cfg, "a")[0]
+    join_node = _nodes_calling(cfg, "join")[0]
+    assert header.nid in dom[join_node.nid]  # the test runs on every path
+    assert a_node.nid not in dom[join_node.nid]  # one branch does not
+    assert join_node.nid in dom[join_node.nid]  # reflexive
+
+
+# --------------------------------------------------------------------- TPU016
+
+
+def test_tpu016_flags_connection_leaked_on_exception_path(tmp_path):
+    # request()/getresponse() can raise after the connection exists — without
+    # a try/except-close the socket leaks on every error
+    result = lint_source(
+        tmp_path,
+        """
+        from http.client import HTTPConnection
+
+        def fetch(host, payload):
+            conn = HTTPConnection(host)
+            conn.request("POST", "/step", payload)
+            resp = conn.getresponse()
+            body = resp.read()
+            conn.close()
+            return body
+        """,
+    )
+    assert "TPU016" in rule_ids(result)
+    assert "conn" in result.findings[0].message
+
+
+def test_tpu016_near_miss_guarded_and_with_managed(tmp_path):
+    # the two clean shapes: close in an except-reraise guard, and the context
+    # manager (guaranteed release through with_exit on every continuation)
+    result = lint_source(
+        tmp_path,
+        """
+        from http.client import HTTPConnection
+
+        def fetch(host, payload):
+            conn = HTTPConnection(host)
+            try:
+                conn.request("POST", "/step", payload)
+                body = conn.getresponse().read()
+            except BaseException:
+                conn.close()
+                raise
+            conn.close()
+            return body
+
+        def read_config(path):
+            with open(path) as handle:
+                return handle.read()
+        """,
+    )
+    assert rule_ids(result) == []
+
+
+# --------------------------------------------------------------------- TPU017
+
+
+def test_tpu017_flags_charge_without_refund_on_exception(tmp_path):
+    result = lint_source(
+        tmp_path,
+        """
+        def submit(registry, tenant, grammar, compile_grammar):
+            retry_after = registry.try_admit(tenant)
+            if retry_after is not None:
+                raise RuntimeError("throttled")
+            compile_grammar(grammar)
+            return True
+        """,
+    )
+    assert rule_ids(result) == ["TPU017"]
+    assert "refund" in result.findings[0].message
+
+
+def test_tpu017_near_miss_refund_in_except_and_shed_path(tmp_path):
+    # the canonical shapes stay clean: refund-and-reraise, and the shed path
+    # (non-None retry_after means the bucket was NOT debited)
+    result = lint_source(
+        tmp_path,
+        """
+        def submit(registry, tenant, grammar, compile_grammar):
+            retry_after = registry.try_admit(tenant)
+            if retry_after is not None:
+                raise RuntimeError("throttled")
+            try:
+                compile_grammar(grammar)
+            except BaseException:
+                registry.refund(tenant)
+                raise
+            return True
+        """,
+    )
+    assert rule_ids(result) == []
+
+
+# --------------------------------------------------------------------- TPU018
+
+
+def test_tpu018_flags_yield_while_holding_lock(tmp_path):
+    result = lint_source(
+        tmp_path,
+        """
+        import threading
+
+        class Streamer:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def stream(self, chunks):
+                with self._lock:
+                    for chunk in chunks:
+                        yield chunk
+        """,
+    )
+    assert "TPU018" in rule_ids(result)
+
+
+def test_tpu018_near_miss_snapshot_then_yield(tmp_path):
+    # copy under the lock, yield outside it — the consumer can stall forever
+    # without holding up writers
+    result = lint_source(
+        tmp_path,
+        """
+        import threading
+
+        class Streamer:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._chunks = []
+
+            def stream(self):
+                with self._lock:
+                    snapshot = list(self._chunks)
+                for chunk in snapshot:
+                    yield chunk
+        """,
+    )
+    assert rule_ids(result) == []
+
+
+# --------------------------------------------------------------------- TPU019
+
+
+def test_tpu019_flags_early_return_leaking_handle(tmp_path):
+    result = lint_source(
+        tmp_path,
+        """
+        def read_config(path, strict):
+            handle = open(path)
+            if strict:
+                return None
+            handle.close()
+            return True
+        """,
+    )
+    assert rule_ids(result) == ["TPU019"]
+
+
+def test_tpu019_near_miss_returning_the_resource_or_closing_first(tmp_path):
+    # returning the handle transfers ownership to the caller; closing before
+    # the early return is the fix the rule asks for
+    result = lint_source(
+        tmp_path,
+        """
+        def open_config(path, strict):
+            handle = open(path)
+            if strict:
+                return handle
+            handle.close()
+            return None
+
+        def peek_config(path, strict):
+            handle = open(path)
+            if strict:
+                handle.close()
+                return None
+            handle.close()
+            return True
+        """,
+    )
+    assert rule_ids(result) == []
+
+
+# --------------------------------------- TPU015 dominance of the in-body bound
+
+
+def test_tpu015_in_body_bound_dominating_the_back_edge_is_clean(tmp_path):
+    result = lint_source(
+        tmp_path,
+        """
+        def reconnect(host):
+            attempt = 0
+            while True:
+                resp = host.ping()
+                if resp:
+                    return resp
+                if attempt >= 5:
+                    raise RuntimeError("gave up")
+                attempt += 1
+        """,
+    )
+    assert rule_ids(result) == []
+
+
+def test_tpu015_bound_buried_under_rare_flag_still_flags(tmp_path):
+    # the bound test only runs when `flag` flips — it does not dominate the
+    # back edge, so the loop is effectively unbounded
+    result = lint_source(
+        tmp_path,
+        """
+        def reconnect(host, flag):
+            attempt = 0
+            while True:
+                resp = host.ping()
+                if flag:
+                    if attempt >= 5:
+                        break
+                attempt += 1
+        """,
+    )
+    assert rule_ids(result) == ["TPU015"]
+
+
+# ----------------------------------------------------- baseline + disable-file
+
+
+def test_baseline_records_then_reports_only_new(tmp_path, capsys):
+    target = tmp_path / "mod.py"
+    target.write_text(
+        textwrap.dedent(
+            """
+            import os
+
+            A = int(os.environ.get("A", "0"))
+            """
+        )
+    )
+    baseline = tmp_path / "lint-baseline.json"
+    assert (
+        lint_main([str(target), "--baseline", str(baseline), "--update-baseline"]) == 0
+    )
+    capsys.readouterr()
+    # known finding absorbed; exit 0 even though the finding still exists
+    assert lint_main([str(target), "--baseline", str(baseline)]) == 0
+    out = capsys.readouterr().out
+    assert "0 finding(s)" in out and "1 baselined" in out
+    # a NEW finding (second env read) still fails the gate
+    target.write_text(target.read_text() + 'B = int(os.environ.get("B", "0"))\n')
+    assert lint_main([str(target), "--baseline", str(baseline)]) == 1
+    out = capsys.readouterr().out
+    assert "1 finding(s)" in out and "1 baselined" in out
+
+
+def test_baseline_missing_file_is_a_usage_error(tmp_path, capsys):
+    target = tmp_path / "mod.py"
+    target.write_text("x = 1\n")
+    assert lint_main([str(target), "--baseline", str(tmp_path / "absent.json")]) == 2
+    assert "does not exist" in capsys.readouterr().err
+
+
+def test_baseline_sarif_carries_baseline_state(tmp_path, capsys):
+    target = tmp_path / "mod.py"
+    target.write_text(
+        textwrap.dedent(
+            """
+            import os
+
+            A = int(os.environ.get("A", "0"))
+            """
+        )
+    )
+    baseline = tmp_path / "bl.json"
+    lint_main([str(target), "--baseline", str(baseline), "--update-baseline"])
+    capsys.readouterr()
+    target.write_text(target.read_text() + 'B = int(os.environ.get("B", "0"))\n')
+    lint_main([str(target), "--baseline", str(baseline), "--format", "sarif"])
+    payload = json.loads(capsys.readouterr().out)
+    states = sorted(r["baselineState"] for r in payload["runs"][0]["results"])
+    assert states == ["new", "unchanged"]
+
+
+def test_disable_file_suppresses_both_passes(tmp_path):
+    # per-file rule (TPU005) and project rule (TPU017) both honor the header
+    # comment; the un-listed rule still fires
+    result = lint_pkg(
+        tmp_path,
+        {
+            "mod.py": """
+            # tpu-lint: disable-file=TPU005, TPU017
+            import os
+
+            A = int(os.environ.get("A", "0"))
+
+            def submit(registry, tenant, work):
+                retry_after = registry.try_admit(tenant)
+                if retry_after is not None:
+                    raise RuntimeError("throttled")
+                work()
+                return True
+            """,
+        },
+    )
+    assert rule_ids(result) == []
+    assert sorted(f.rule for f in result.suppressed) == ["TPU005", "TPU017"]
+
+
+def test_disable_file_only_honored_in_first_five_lines(tmp_path):
+    result = lint_source(
+        tmp_path,
+        """
+        import os
+
+        A = 1
+        B = 2
+        C = 3
+        # tpu-lint: disable-file=TPU005
+        D = int(os.environ.get("D", "0"))
+        """,
+    )
+    assert rule_ids(result) == ["TPU005"]
+    assert result.suppressed == []

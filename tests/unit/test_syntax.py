@@ -129,3 +129,57 @@ def test_lint_gate_fails_on_seeded_lock_cycle(tmp_path):
     assert [finding.rule for finding in result.findings] == ["TPU010"]
     assert "lock-order cycle" in result.findings[0].message
     assert lint_main([str(pkg)]) == 1
+
+
+def test_lint_gate_fails_on_seeded_flow_violations(tmp_path):
+    """The exception-path flow rules gate too: one seeded fixture per rule
+    (TPU016 leak-on-exception, TPU017 charge-without-refund, TPU018
+    lock-held-across-yield, TPU019 unreleased-on-early-return) must fail
+    through the same entry points the CI/CLI use — these are the classes the
+    syntactic rules structurally cannot see without a CFG."""
+    from unionml_tpu.analysis import run_lint
+    from unionml_tpu.analysis.engine import main as lint_main
+
+    pkg = tmp_path / "flowpkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "leak.py").write_text(  # TPU016: request() raises -> conn leaks
+        "from http.client import HTTPConnection\n\n\n"
+        "def fetch(host, payload):\n"
+        "    conn = HTTPConnection(host)\n"
+        '    conn.request("POST", "/step", payload)\n'
+        "    body = conn.getresponse().read()\n"
+        "    conn.close()\n"
+        "    return body\n"
+    )
+    (pkg / "charge.py").write_text(  # TPU017: charged, then an unguarded raise path
+        "def submit(registry, tenant, grammar, compile_grammar):\n"
+        "    retry_after = registry.try_admit(tenant)\n"
+        "    if retry_after is not None:\n"
+        '        raise RuntimeError("throttled")\n'
+        "    compile_grammar(grammar)\n"
+        "    return True\n"
+    )
+    (pkg / "stream.py").write_text(  # TPU018: consumer stalls -> lock held forever
+        "import threading\n\n\n"
+        "class Streamer:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n\n"
+        "    def stream(self, chunks):\n"
+        "        with self._lock:\n"
+        "            for chunk in chunks:\n"
+        "                yield chunk\n"
+    )
+    (pkg / "early.py").write_text(  # TPU019: early return skips the close
+        "def read_config(path, strict):\n"
+        "    handle = open(path)\n"
+        "    if strict:\n"
+        "        return None\n"
+        "    handle.close()\n"
+        "    return True\n"
+    )
+    result = run_lint([pkg])
+    assert not result.clean
+    seeded = {finding.rule for finding in result.findings}
+    assert {"TPU016", "TPU017", "TPU018", "TPU019"} <= seeded
+    assert lint_main([str(pkg)]) == 1

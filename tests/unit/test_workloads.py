@@ -213,7 +213,8 @@ def test_recorder_hashed_mode_never_writes_ids(tiny, tmp_path):
         body = json.dumps({"prompt": [7, 7, 7, 7], "max_tokens": 2}).encode()
         _dispatch(app, "POST", "/v1/completions", body)
         path = app.traffic_recorder.close()
-        text = open(path).read()
+        with open(path) as fh:
+            text = fh.read()
         assert "[7," not in text and '"prompt"' not in text
         meta, requests = read_trace(path)
         assert meta["hashed_prompts"] is True

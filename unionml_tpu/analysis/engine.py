@@ -19,11 +19,17 @@ table, class hierarchy, call graph, per-function lock/jit/contextvar facts),
 which the engine builds once per run from a content-hash cache — a warm run
 re-summarizes only edited files, keeping the tier-1 gate inside its 5 s
 budget. Findings from both protocols funnel through per-line
-``# tpu-lint: disable=RULE`` suppressions into a :class:`LintResult`.
-Reporters render text (``path:line: RULE id: message``), a stable JSON schema
+``# tpu-lint: disable=RULE`` and file-level ``# tpu-lint: disable-file=RULE``
+(first five lines of a module) suppressions into a :class:`LintResult`; a
+JSON baseline (``--baseline``) can additionally absorb known findings so a
+stricter rule lands without a same-PR repo sweep — baselined findings are
+reported separately and do not fail the gate. Reporters render text
+(``path:line: RULE id: message``), a stable JSON schema
 (``{"findings": [...], "counts": ...}``, version 1), or SARIF 2.1.0 for CI
-annotation surfaces. Exit codes: 0 clean (justified suppressions included),
-1 findings, 2 usage/parse errors.
+annotation surfaces (suppression records carry which mechanism fired;
+baseline runs annotate ``baselineState``). Exit codes: 0 clean (justified
+suppressions and baselined findings included), 1 findings, 2 usage/parse
+errors.
 """
 
 from __future__ import annotations
@@ -43,16 +49,25 @@ __all__ = [
     "LintResult",
     "Rule",
     "all_rules",
+    "apply_baseline",
+    "load_baseline",
     "main",
     "render_json",
     "render_sarif",
     "render_text",
     "run_lint",
+    "write_baseline",
 ]
 
 #: ``# tpu-lint: disable=TPU001`` or ``disable=TPU001,TPU003`` or ``disable=all``,
 #: anywhere on the offending line (typically a trailing comment)
 _SUPPRESS_RE = re.compile(r"#\s*tpu-lint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+#: ``# tpu-lint: disable-file=TPU016`` (or a comma list, or ``all``) — whole-file
+#: opt-out, honored only within the first :data:`_FILE_SUPPRESS_WINDOW` lines so
+#: the opt-out is visible at the top of the module, next to the docstring
+_FILE_SUPPRESS_RE = re.compile(r"#\s*tpu-lint:\s*disable-file=([A-Za-z0-9_,\s]+)")
+_FILE_SUPPRESS_WINDOW = 5
 
 
 @dataclasses.dataclass(frozen=True)
@@ -128,6 +143,16 @@ class LintResult:
     #: project-index cache accounting for this run ({"hits": n, "misses": m});
     #: the benchmark lane reports these to pin the incremental contract
     index_stats: "Dict[str, int]" = dataclasses.field(default_factory=dict)
+    #: findings absorbed by a ``--baseline`` file (known debt, not new): kept
+    #: out of ``findings`` so they do not fail the gate, reported separately
+    baselined: "List[Finding]" = dataclasses.field(default_factory=list)
+    #: True once :func:`apply_baseline` ran — SARIF then annotates every
+    #: result with ``baselineState`` (new vs unchanged)
+    baseline_applied: bool = False
+    #: (rule, path, line, col) of suppressed findings silenced by a file-level
+    #: ``disable-file`` comment rather than a per-line one — SARIF suppression
+    #: records name the mechanism so dashboards can audit each budget
+    file_suppressed_keys: "set" = dataclasses.field(default_factory=set)
 
     @property
     def clean(self) -> bool:
@@ -170,6 +195,19 @@ def _suppressions(source: str) -> "Dict[int, set]":
             continue
         ids = {part.strip().upper() for part in match.group(1).split(",") if part.strip()}
         out[lineno] = ids
+    return out
+
+
+def _file_suppressions(source: str) -> "set":
+    """Rule ids (or {"ALL"}) disabled for the whole file via
+    ``# tpu-lint: disable-file=...`` within the first five lines."""
+    out: "set" = set()
+    for line in source.splitlines()[:_FILE_SUPPRESS_WINDOW]:
+        match = _FILE_SUPPRESS_RE.search(line)
+        if match is not None:
+            out |= {
+                part.strip().upper() for part in match.group(1).split(",") if part.strip()
+            }
     return out
 
 
@@ -223,7 +261,13 @@ def run_lint(
     def reported(path: str) -> bool:
         return only_set is None or str(Path(path).resolve()) in only_set
 
-    def place(finding: Finding, disabled: "Dict[int, set]") -> None:
+    def place(finding: Finding, disabled: "Dict[int, set]", file_disabled: "set") -> None:
+        if finding.rule in file_disabled or "ALL" in file_disabled:
+            result.suppressed.append(finding)
+            result.file_suppressed_keys.add(
+                (finding.rule, finding.path, finding.line, finding.col)
+            )
+            return
         ids = disabled.get(finding.line, ())
         if finding.rule in ids or "ALL" in ids:
             result.suppressed.append(finding)
@@ -243,7 +287,7 @@ def run_lint(
                 cached = rule.check(summary.tree, summary.path)
                 summary.rule_findings[rule.id] = cached
             for finding in cached:
-                place(finding, summary.suppressions)
+                place(finding, summary.suppressions, summary.file_suppressions)
 
     # whole-program pass: every rule gets the index; findings land in the
     # file they point at, under that file's suppression comments
@@ -252,7 +296,11 @@ def run_lint(
             if not reported(finding.path):
                 continue
             owner = index.by_path.get(finding.path)
-            place(finding, owner.suppressions if owner is not None else {})
+            place(
+                finding,
+                owner.suppressions if owner is not None else {},
+                owner.file_suppressions if owner is not None else set(),
+            )
 
     result.findings = _dedupe(result.findings)
     result.suppressed = _dedupe(result.suppressed)
@@ -276,6 +324,64 @@ def _dedupe(findings: "List[Finding]") -> "List[Finding]":
     return out
 
 
+# ------------------------------------------------------------------- baseline
+#
+# A baseline is a JSON multiset of known findings. Entries are keyed on
+# (rule, path, message) — deliberately NOT line/col, so unrelated edits that
+# shift a known finding up or down the file do not resurface it — with a
+# count, so introducing a SECOND instance of an already-baselined finding in
+# the same file still fails the gate.
+
+
+def write_baseline(result: LintResult, path: "str | Path") -> None:
+    """Record ``result``'s active findings as the new baseline at ``path``."""
+    counts: "Dict[Tuple[str, str, str], int]" = {}
+    for finding in result.findings:
+        key = (finding.rule, finding.path, finding.message)
+        counts[key] = counts.get(key, 0) + 1
+    payload = {
+        "version": 1,
+        "entries": [
+            {"rule": rule, "path": fpath, "message": message, "count": count}
+            for (rule, fpath, message), count in sorted(counts.items())
+        ],
+    }
+    Path(path).write_text(json.dumps(payload, indent=2) + "\n")
+
+
+def load_baseline(path: "str | Path") -> "Dict[Tuple[str, str, str], int]":
+    """Parse a baseline file into its (rule, path, message) -> count multiset."""
+    try:
+        payload = json.loads(Path(path).read_text())
+    except (OSError, json.JSONDecodeError) as exc:
+        raise ValueError(f"unreadable baseline {path}: {exc}") from exc
+    entries = payload.get("entries") if isinstance(payload, dict) else None
+    if not isinstance(entries, list):
+        raise ValueError(f"baseline {path} has no 'entries' list")
+    out: "Dict[Tuple[str, str, str], int]" = {}
+    for entry in entries:
+        key = (str(entry["rule"]), str(entry["path"]), str(entry["message"]))
+        out[key] = out.get(key, 0) + int(entry.get("count", 1))
+    return out
+
+
+def apply_baseline(result: LintResult, baseline: "Dict[Tuple[str, str, str], int]") -> None:
+    """Move findings matched by ``baseline`` from ``findings`` to ``baselined``
+    (in place). Matching consumes baseline budget: the N+1th instance of a
+    finding baselined N times is still new."""
+    remaining = dict(baseline)
+    fresh: "List[Finding]" = []
+    for finding in result.findings:
+        key = (finding.rule, finding.path, finding.message)
+        if remaining.get(key, 0) > 0:
+            remaining[key] -= 1
+            result.baselined.append(finding)
+        else:
+            fresh.append(finding)
+    result.findings = fresh
+    result.baseline_applied = True
+
+
 def render_text(result: LintResult, *, show_suppressed: bool = False) -> str:
     lines = [finding.render() for finding in result.findings]
     if show_suppressed:
@@ -286,6 +392,8 @@ def render_text(result: LintResult, *, show_suppressed: bool = False) -> str:
         f"{len(result.findings)} finding(s), {len(result.suppressed)} suppressed, "
         f"{result.files} file(s) checked"
     )
+    if result.baseline_applied:
+        summary += f", {len(result.baselined)} baselined"
     lines.append(summary)
     return "\n".join(lines)
 
@@ -302,6 +410,8 @@ def render_json(result: LintResult) -> str:
         "counts": result.counts(),
         "exit_code": result.exit_code(),
     }
+    if result.baseline_applied:
+        payload["baselined"] = [dataclasses.asdict(f) for f in result.baselined]
     return json.dumps(payload, indent=2)
 
 
@@ -309,11 +419,16 @@ def render_sarif(result: LintResult) -> str:
     """SARIF 2.1.0 — the interchange schema CI annotation surfaces (GitHub
     code scanning, VS Code SARIF viewers) render natively. Active findings
     are ``warning``-level results; suppressed findings are carried with an
-    ``inSource`` suppression record so dashboards can audit the budget; parse
-    errors surface as tool ``notifications``."""
+    ``inSource`` suppression record whose justification names the mechanism
+    (per-line ``disable`` vs file-level ``disable-file``) so dashboards can
+    audit each budget; baseline runs annotate every result's
+    ``baselineState`` (``new`` vs ``unchanged``); parse errors surface as
+    tool ``notifications``."""
     from unionml_tpu.analysis.rules import RULES
 
-    def _result(finding: Finding, suppressed: bool) -> "Dict[str, object]":
+    def _result(
+        finding: Finding, suppressed: bool, baseline_state: "Optional[str]" = None
+    ) -> "Dict[str, object]":
         record: "Dict[str, object]" = {
             "ruleId": finding.rule,
             "level": "warning",
@@ -332,8 +447,19 @@ def render_sarif(result: LintResult) -> str:
             ],
         }
         if suppressed:
-            record["suppressions"] = [{"kind": "inSource"}]
+            key = (finding.rule, finding.path, finding.line, finding.col)
+            mechanism = (
+                "# tpu-lint: disable-file"
+                if key in result.file_suppressed_keys
+                else "# tpu-lint: disable"
+            )
+            record["suppressions"] = [{"kind": "inSource", "justification": mechanism}]
+        if baseline_state is not None:
+            record["baselineState"] = baseline_state
         return record
+
+    state_new = "new" if result.baseline_applied else None
+    state_old = "unchanged" if result.baseline_applied else None
 
     payload = {
         "$schema": "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
@@ -350,7 +476,14 @@ def render_sarif(result: LintResult) -> str:
                         ],
                     }
                 },
-                "results": [_result(f, suppressed=False) for f in result.findings]
+                "results": [
+                    _result(f, suppressed=False, baseline_state=state_new)
+                    for f in result.findings
+                ]
+                + [
+                    _result(f, suppressed=False, baseline_state=state_old)
+                    for f in result.baselined
+                ]
                 + [_result(f, suppressed=True) for f in result.suppressed],
                 "invocations": [
                     {
@@ -397,7 +530,7 @@ def main(argv: "Optional[Sequence[str]]" = None) -> int:
     ``unionml-tpu lint`` CLI command)."""
     parser = argparse.ArgumentParser(
         prog="tpu-lint",
-        description="TPU/concurrency-aware static analyzer (rules TPU001-TPU012)",
+        description="TPU/concurrency-aware static analyzer (rules TPU001-TPU019)",
     )
     parser.add_argument(
         "paths",
@@ -419,7 +552,23 @@ def main(argv: "Optional[Sequence[str]]" = None) -> int:
         help="report findings only for files in `git diff --name-only REF` (default HEAD) "
         "plus untracked files; the project index is still built over all PATHS",
     )
+    parser.add_argument(
+        "--baseline",
+        default=None,
+        metavar="FILE",
+        help="JSON baseline of known findings: matched findings are reported as "
+        "baselined (and do not fail the gate), only new ones count; "
+        "composes with --changed-only and --format sarif (baselineState)",
+    )
+    parser.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="record the run's findings to --baseline FILE (then report zero new)",
+    )
     args = parser.parse_args(argv)
+    if args.update_baseline and not args.baseline:
+        print("tpu-lint: --update-baseline requires --baseline FILE", file=sys.stderr)
+        return 2
     # no paths: lint the package itself, wherever it is installed — so
     # `python -m unionml_tpu.analysis` works from any working directory
     paths = args.paths or [Path(__file__).resolve().parents[1]]
@@ -427,6 +576,15 @@ def main(argv: "Optional[Sequence[str]]" = None) -> int:
     try:
         only = _changed_files(args.changed_only) if args.changed_only else None
         result = run_lint(paths, select=split(args.select), ignore=split(args.ignore), only=only)
+        if args.baseline:
+            if args.update_baseline:
+                write_baseline(result, args.baseline)
+            elif not Path(args.baseline).exists():
+                raise ValueError(
+                    f"baseline {args.baseline} does not exist "
+                    "(record one with --update-baseline)"
+                )
+            apply_baseline(result, load_baseline(args.baseline))
     except (FileNotFoundError, ValueError, OSError) as exc:
         print(f"tpu-lint: {exc}", file=sys.stderr)
         return 2

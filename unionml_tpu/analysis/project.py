@@ -53,6 +53,7 @@ __all__ = [
     "ProjectIndex",
     "build_index",
     "clear_index_cache",
+    "function_cfg",
 ]
 
 #: raw lock tokens: ``self.<attr>`` for instance locks, ``mod:<name>`` for
@@ -162,11 +163,23 @@ class ModuleSummary:
     #: module-level donor callables -> literal donated positions
     donors: Dict[str, Tuple[int, ...]] = dataclasses.field(default_factory=dict)
     suppressions: Dict[int, Set[str]] = dataclasses.field(default_factory=dict)
+    #: rule ids (or {"ALL"}) disabled for the whole file via a
+    #: ``# tpu-lint: disable-file=...`` comment in the first five lines
+    file_suppressions: Set[str] = dataclasses.field(default_factory=set)
     #: per-file rule findings memo, keyed by rule id — per-file rules are pure
     #: functions of (tree, path), so their output is valid as long as the
     #: content hash matches; the engine consults this to skip re-checks on
     #: warm runs (cleared with the summary on any edit)
     rule_findings: Dict[str, list] = dataclasses.field(default_factory=dict)
+    #: per-function CFG memo keyed by (qualname, line) — CFGs are pure
+    #: functions of the AST, so like ``rule_findings`` they live exactly as
+    #: long as the content-hashed summary (see :func:`function_cfg`)
+    cfgs: Dict[Tuple[str, int], object] = dataclasses.field(default_factory=dict)
+    #: per-function prescan memo for the flow rules (TPU016-TPU019): which
+    #: protocols/locks/yields a function mentions at all, so warm project
+    #: passes skip CFG construction and dataflow for the ~95% of functions
+    #: that touch none of them
+    flow_hints: Dict[Tuple[str, int], object] = dataclasses.field(default_factory=dict)
 
 
 # --------------------------------------------------------------------- naming
@@ -375,7 +388,10 @@ def build_summary(path: Path, source: str, tree: ast.Module) -> ModuleSummary:
     the build rides the tier-1 gate's clock, so nothing walks the tree
     twice except the per-class attribute pre-scan (lock attributes must be
     known before the class's methods are walked, wherever ``__init__`` sits)."""
-    from unionml_tpu.analysis.engine import _suppressions  # shared comment grammar
+    from unionml_tpu.analysis.engine import (  # shared comment grammar
+        _file_suppressions,
+        _suppressions,
+    )
 
     module = module_name_for(path)
     summary = ModuleSummary(
@@ -384,6 +400,7 @@ def build_summary(path: Path, source: str, tree: ast.Module) -> ModuleSummary:
         tree=tree,
         source=source,
         suppressions=_suppressions(source),
+        file_suppressions=_file_suppressions(source),
     )
     _SummaryBuilder(summary, is_pkg=path.name == "__init__.py").run()
     return summary
@@ -869,6 +886,25 @@ class ProjectIndex:
                 if callee is not None and callee.fq not in seen:
                     queue.append(callee)
         return list(seen.values())
+
+
+def function_cfg(summary: ModuleSummary, facts: FunctionFacts):
+    """The control-flow graph for ``facts``, memoized on its module summary.
+
+    Summaries are content-hash cached (:data:`_CACHE`), so this inherits the
+    same invalidation: a warm ``run_lint`` reuses every CFG of every unchanged
+    file, and an edited file drops its summary — and with it its CFGs —
+    atomically.  Keyed by ``(qualname, line)`` so nested/shadowed defs cannot
+    collide.
+    """
+    from unionml_tpu.analysis.cfg import build_cfg
+
+    key = (facts.qualname, facts.line)
+    cfg = summary.cfgs.get(key)
+    if cfg is None:
+        cfg = build_cfg(facts.node)
+        summary.cfgs[key] = cfg
+    return cfg
 
 
 # --------------------------------------------------------------------- cache

@@ -24,6 +24,10 @@ from unionml_tpu.analysis.rules.tpu012_contextvar import ContextvarExecutorHole
 from unionml_tpu.analysis.rules.tpu013_locked_collectives import BlockingCollectiveUnderLock
 from unionml_tpu.analysis.rules.tpu014_unseeded_random import UnseededRandomness
 from unionml_tpu.analysis.rules.tpu015_unbounded_retry import UnboundedNetworkRetry
+from unionml_tpu.analysis.rules.tpu016_resource_leak import ResourceLeakOnException
+from unionml_tpu.analysis.rules.tpu017_charge_refund import ChargeWithoutRefund
+from unionml_tpu.analysis.rules.tpu018_lock_yield import LockHeldAcrossYield
+from unionml_tpu.analysis.rules.tpu019_early_return import UnreleasedOnEarlyReturn
 
 __all__ = ["RULES"]
 
@@ -45,5 +49,9 @@ RULES = {
         BlockingCollectiveUnderLock,
         UnseededRandomness,
         UnboundedNetworkRetry,
+        ResourceLeakOnException,
+        ChargeWithoutRefund,
+        LockHeldAcrossYield,
+        UnreleasedOnEarlyReturn,
     )
 }

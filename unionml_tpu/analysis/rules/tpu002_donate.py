@@ -18,8 +18,13 @@ makes the importing module's call sites subject to the same later-load
 analysis. Donating callables are collected from local
 ``f = jax.jit(g, donate_argnums=...)`` bindings, class-wide
 ``self._f = jax.jit(...)`` attributes, ``@partial(jax.jit, donate_argnums=...)``
-decorators, and immediate ``jax.jit(g, ...)(args)`` invocations; every call
-site is then checked for later loads of the donated argument names.
+decorators, and immediate ``jax.jit(g, ...)(args)`` invocations.
+
+Path sensitivity: donations are solved as a reaching-definitions problem over
+the function's CFG (:mod:`unionml_tpu.analysis.cfg`) rather than by source
+line order.  A load in the *other* branch of the donating ``if`` is clean; a
+load lexically above the donation but reachable again through a loop back
+edge is flagged; a rebind on one path does not launder the other path.
 """
 
 from __future__ import annotations
@@ -44,6 +49,36 @@ def _donated_positions(call: ast.Call) -> "Optional[Tuple[int, ...]]":
         if keyword.arg == "donate_argnums":
             return literal_argnums(keyword.value)
     return None
+
+
+def _make_donation_flow():
+    """Reaching-donations dataflow: fact ``(name, donate_line)``, generated at
+    the donating call's statement, killed by any Store/Del of the name (the
+    rebind idiom).  Built lazily so the per-file fast path doesn't import the
+    dataflow machinery until a donation is actually seen."""
+    from unionml_tpu.analysis.dataflow import Problem
+
+    class _DonationFlow(Problem):
+        def __init__(self, gens):
+            self._gens = gens
+
+        def gen_kill(self, node):
+            gen = self._gens.get(node.nid, set())
+            kill = set()
+            for expr in node.exprs:
+                if expr is None:
+                    continue
+                for sub in ast.walk(expr):
+                    if isinstance(sub, ast.Name) and isinstance(
+                        sub.ctx, (ast.Store, ast.Del)
+                    ):
+                        kill.add(sub.id)
+            return gen, kill
+
+        def apply_kill(self, facts, kill):
+            return {f for f in facts if f[0] not in kill}
+
+    return _DonationFlow
 
 
 class UseAfterDonate(Rule):
@@ -140,8 +175,9 @@ class UseAfterDonate(Rule):
                     if positions:
                         donors[target] = positions
 
-        # pass 2: call sites -> (donated name, call line, rebound?)
-        donations: "List[Tuple[str, int]]" = []
+        # pass 2: call sites -> donated argument names, keyed by the Call node
+        # so the CFG pass below can attach each donation to its statement
+        donated_by_call: "Dict[int, List[str]]" = {}
         for call in statements:
             if not isinstance(call, ast.Call):
                 continue
@@ -156,31 +192,56 @@ class UseAfterDonate(Rule):
                     continue
                 arg = call.args[pos]
                 if isinstance(arg, ast.Name) and arg.id not in rebound:
-                    donations.append((arg.id, call.lineno))
+                    donated_by_call.setdefault(id(call), []).append(arg.id)
+        if not donated_by_call:
+            return []
 
-        # pass 3: later loads of donated names (until the name is re-bound)
+        # pass 3 (path-sensitive): solve reaching-donations over the CFG and
+        # flag only loads a donation actually reaches with no intervening
+        # rebind.  A load in the *other* branch of the donating `if` is clean;
+        # a load lexically before the donation but reached again through a
+        # loop back edge is not.
+        from unionml_tpu.analysis.cfg import build_cfg
+        from unionml_tpu.analysis.dataflow import solve_forward
+
+        cfg = build_cfg(scope)
+        gens: "Dict[int, Set[Tuple[str, int]]]" = {}
+        for node in cfg.statement_nodes():
+            for expr in node.exprs:
+                if expr is None:
+                    continue
+                for sub in ast.walk(expr):
+                    for name in donated_by_call.get(id(sub), ()):
+                        gens.setdefault(node.nid, set()).add((name, sub.lineno))
+        sol = solve_forward(cfg, _make_donation_flow()(gens))
+
         findings: "List[Finding]" = []
         flagged: "Set[Tuple[str, int]]" = set()
-        for name, donated_at in donations:
-            rebind_line = self._first_store_after(scope, name, donated_at)
-            for node in iter_scope(scope):
-                if (
-                    isinstance(node, ast.Name)
-                    and node.id == name
-                    and isinstance(node.ctx, ast.Load)
-                    and node.lineno > donated_at
-                    and (rebind_line is None or node.lineno < rebind_line)
-                    and (name, node.lineno) not in flagged
-                ):
-                    flagged.add((name, node.lineno))
-                    findings.append(
-                        self.finding(
-                            path, node,
-                            f"'{name}' was donated to a jit-compiled call on line {donated_at} "
-                            "(donate_argnums) — its buffer is deleted after the call; rebind the "
-                            "name from the call's result instead",
+        for node in cfg.statement_nodes():
+            live = sol.in_facts(node.nid)
+            if not live:
+                continue
+            live_names = {name: donated_at for name, donated_at in sorted(live)}
+            for expr in node.exprs:
+                if expr is None:
+                    continue
+                for sub in ast.walk(expr):
+                    if (
+                        isinstance(sub, ast.Name)
+                        and isinstance(sub.ctx, ast.Load)
+                        and sub.id in live_names
+                        and (sub.id, sub.lineno) not in flagged
+                    ):
+                        flagged.add((sub.id, sub.lineno))
+                        donated_at = live_names[sub.id]
+                        findings.append(
+                            self.finding(
+                                path, sub,
+                                f"'{sub.id}' was donated to a jit-compiled call on line {donated_at} "
+                                "(donate_argnums) — its buffer is deleted after the call; rebind the "
+                                "name from the call's result instead",
+                            )
                         )
-                    )
         return findings
 
     @staticmethod
@@ -211,15 +272,3 @@ class UseAfterDonate(Rule):
                 name = dotted(node.target)
                 return {name} if name else set()
         return set()
-
-    @staticmethod
-    def _first_store_after(scope: ast.AST, name: str, line: int) -> "Optional[int]":
-        stores = [
-            node.lineno
-            for node in iter_scope(scope)
-            if isinstance(node, ast.Name)
-            and node.id == name
-            and isinstance(node.ctx, (ast.Store, ast.Del))
-            and node.lineno > line
-        ]
-        return min(stores) if stores else None

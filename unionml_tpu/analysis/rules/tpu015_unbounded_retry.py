@@ -16,9 +16,13 @@ Detection (deliberately structural, not name-guessing):
 - a ``while`` loop whose body (its own scope — nested function bodies run
   elsewhere) contains a flagged network call is a finding **unless** the
   loop is *bounded* — its test contains a comparison (``attempt < n``,
-  ``time.monotonic() < deadline``) — or *paced* — an ``Event.wait``-style
-  ``.wait(...)`` call in the test, or a ``time.sleep`` / ``asyncio.sleep``
-  / ``.wait(...)`` / ``*backoff*``-named call in the body;
+  ``time.monotonic() < deadline``), or the body carries a guarded exit
+  (``if attempt >= n: break``/``raise``/``return``) whose test **dominates**
+  the loop back edge (checked on the CFG: the bound must run on *every*
+  iteration — one buried under a rare-path ``if`` bounds nothing) — or
+  *paced* — an ``Event.wait``-style ``.wait(...)`` call in the test, or a
+  ``time.sleep`` / ``asyncio.sleep`` / ``.wait(...)`` / ``*backoff*``-named
+  call in the body;
 - a ``for`` loop is inherently bounded by its iterable, EXCEPT over
   ``itertools.count()`` / ``cycle()`` (spelled dotted or bare), which get
   the same test.
@@ -128,6 +132,8 @@ class UnboundedNetworkRetry(Rule):
             return
         if any(_is_pacing_call(n) for n in nodes):
             return
+        if self._dominating_bound(loop):
+            return
         label = call_target(network) or (
             network.func.attr if isinstance(network.func, ast.Attribute) else "network call"
         )
@@ -140,3 +146,40 @@ class UnboundedNetworkRetry(Rule):
                 "(decorrelated-jitter sleep, like RemoteHost._call_retry)",
             )
         )
+
+    @staticmethod
+    def _dominating_bound(loop: ast.AST) -> bool:
+        """True when the loop body carries a guarded exit — an ``if`` whose
+        test compares (``attempt >= max_attempts``) and whose taken branch
+        leaves the loop (``break``/``raise``/``return``) — that **dominates**
+        every back edge of the loop, i.e. the bound test actually runs on
+        every iteration.  A bound check buried under a rare-path ``if`` (only
+        tested when some flag flips) bounds nothing and does not count."""
+        from unionml_tpu.analysis.cfg import build_cfg
+        from unionml_tpu.analysis.dataflow import dominators
+
+        holder = ast.Module(body=[loop], type_ignores=[])
+        cfg = build_cfg(holder)
+        header = next((n for n in cfg.statement_nodes() if n.stmt is loop), None)
+        if header is None:
+            return False
+        backs = [src for src, dst in cfg.back_edges if dst == header.nid]
+        if not backs:
+            return False
+
+        def _is_bound_node(n) -> bool:
+            if n.stmt is None or n.stmt is loop or not isinstance(n.stmt, ast.If):
+                return False
+            if not any(isinstance(x, ast.Compare) for x in ast.walk(n.stmt.test)):
+                return False
+            return any(
+                isinstance(x, (ast.Break, ast.Raise, ast.Return))
+                for b in n.stmt.body
+                for x in ast.walk(b)
+            )
+
+        bound_nids = {n.nid for n in cfg.statement_nodes() if _is_bound_node(n)}
+        if not bound_nids:
+            return False
+        dom = dominators(cfg)
+        return all(bound_nids & dom[src] for src in backs)

@@ -102,6 +102,20 @@ def templates() -> None:
     help="report findings only for files changed vs REF (default HEAD) plus untracked "
     "files — the fast pre-push path; the whole-program index still covers all PATHS",
 )
+@click.option(
+    "--baseline",
+    default=None,
+    metavar="FILE",
+    help="JSON baseline of known findings: matched findings are reported as baselined "
+    "(and do not fail the gate), only new ones count — composes with --changed-only "
+    "and --format sarif (baselineState)",
+)
+@click.option(
+    "--update-baseline",
+    is_flag=True,
+    default=False,
+    help="record the run's findings to --baseline FILE (then report zero new)",
+)
 def lint(
     paths: "tuple[str, ...]",
     format_: str,
@@ -109,8 +123,10 @@ def lint(
     ignore: Optional[str],
     show_suppressed: bool,
     changed_only: Optional[str],
+    baseline: Optional[str],
+    update_baseline: bool,
 ) -> None:
-    """Run tpu-lint, the TPU/concurrency-aware static analyzer (TPU001-TPU012).
+    """Run tpu-lint, the TPU/concurrency-aware static analyzer (TPU001-TPU019).
 
     Per-file rules check for host syncs inside jit-compiled functions,
     use-after-donate, unlocked mutation of lock-guarded state, blocking calls
@@ -121,7 +137,12 @@ def lint(
     cross-module project index detect lock-order cycles (TPU010), recompile
     hazards at jit static positions (TPU011), and contextvar reads behind
     executor/thread hops without ctx.run (TPU012); TPU001/TPU002 follow jit
-    reachability and donation across modules through the same index. PATHS
+    reachability and donation across modules through the same index. A
+    per-function CFG + dataflow layer adds the exception-path rules:
+    resource leaks when a call raises between acquire and release (TPU016),
+    tenant charges with no refund on the error path (TPU017), locks held
+    across generator yields (TPU018), and early returns that skip a release
+    (TPU019). PATHS
     defaults to ``unionml_tpu``; exits 0 when clean, 1 on findings, 2 on
     usage/parse errors. Also runnable as ``python -m unionml_tpu.analysis``.
     """
@@ -136,6 +157,10 @@ def lint(
         argv.append("--show-suppressed")
     if changed_only:
         argv += ["--changed-only", changed_only]
+    if baseline:
+        argv += ["--baseline", baseline]
+    if update_baseline:
+        argv.append("--update-baseline")
     sys.exit(lint_main(argv))
 
 

@@ -1094,25 +1094,33 @@ class RemoteHost:
             cut_after = None
         conn = self._connect(CONTROL_TIMEOUT_S)
         try:
-            # connect under the control timeout, then RELAX the socket for the
-            # stream's lifetime BEFORE the request: a cold first token can sit
-            # behind a multi-minute XLA compile, and for close-delimited
-            # responses http.client drops conn.sock at getresponse() — there
-            # is no socket left to retune afterwards (a 30 s-stalled stream
-            # used to mis-classify the worker as dead here)
-            conn.connect()
-            if conn.sock is not None:
-                conn.sock.settimeout(STREAM_READ_TIMEOUT_S)
-            conn.request("POST", path, body=body, headers={"Content-Type": content_type})
-            response = conn.getresponse()
-        except _DEAD_ERRORS as exc:
-            self.mark_suspect(exc)
+            try:
+                # connect under the control timeout, then RELAX the socket for
+                # the stream's lifetime BEFORE the request: a cold first token
+                # can sit behind a multi-minute XLA compile, and for
+                # close-delimited responses http.client drops conn.sock at
+                # getresponse() — there is no socket left to retune afterwards
+                # (a 30 s-stalled stream used to mis-classify the worker as
+                # dead here)
+                conn.connect()
+                if conn.sock is not None:
+                    conn.sock.settimeout(STREAM_READ_TIMEOUT_S)
+                conn.request("POST", path, body=body, headers={"Content-Type": content_type})
+                response = conn.getresponse()
+            except _DEAD_ERRORS as exc:
+                self.mark_suspect(exc)
+                raise
+            if response.status >= 400:
+                # a garbage error body (truncated read, non-JSON payload)
+                # raises out of here too — the outer close still runs
+                payload = json.loads(response.read() or b"{}")
+                _raise_shed(response.status, payload)
+        except BaseException:
+            # every failure path releases the socket: errors not in
+            # _DEAD_ERRORS (interrupts, JSON decode failures on the shed
+            # payload) used to leak the connection
             conn.close()
             raise
-        if response.status >= 400:
-            payload = json.loads(response.read() or b"{}")
-            conn.close()
-            _raise_shed(response.status, payload)
         return _RemoteStream(conn, response, self, cut_after=cut_after)
 
     def ping(self, timeout: float = CONTROL_TIMEOUT_S) -> Dict[str, Any]:

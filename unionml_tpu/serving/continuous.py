@@ -115,6 +115,15 @@ def _tev(session: "_Session", name: str, **attrs: Any) -> None:
         trace.event(name, **attrs)
 
 
+def _refund_admission(registry: "Optional[Any]", tenant: "Optional[str]") -> None:
+    """Credit back a :meth:`TenantRegistry.try_admit` charge on a submit path
+    that failed after admission — the request was paid for but never served.
+    No-op when tenancy is off (tpu-lint TPU017 recognizes refund helpers by
+    name, so the None guard can live here without hiding the refund)."""
+    if registry is not None:
+        registry.refund(tenant)
+
+
 @dataclasses.dataclass
 class _Session:
     """Host-side state of one resident request."""
@@ -1431,19 +1440,30 @@ class ContinuousBatcher:
                         f"tenant {tenant!r} is over its rate limit",
                         retry_after_s=round(retry_after, 3), tenant=tenant,
                     )
-            if self.gen._cs is not None:
-                self._grammar_counts[grammar] = self._grammar_counts.get(grammar, 0) + 1
-            self._pending.append((list(prompt), session))
-            if self._thread is None:
-                self._thread = threading.Thread(target=self._engine_loop, daemon=True)
-                self._thread.start()
-            self._lock.notify_all()
-        if req_trace is not None:
-            req_trace.event(
-                "engine.submit", prompt_tokens=len(prompt), queued_behind=waiting,
-                **({"tenant": tenant, "priority": priority_name(priority)} if tenant is not None or priority != PRIORITY_NORMAL else {}),
-            )
-        return _TokenStream(self, session)
+            try:
+                if self.gen._cs is not None:
+                    self._grammar_counts[grammar] = self._grammar_counts.get(grammar, 0) + 1
+                self._pending.append((list(prompt), session))
+                if self._thread is None:
+                    self._thread = threading.Thread(target=self._engine_loop, daemon=True)
+                    self._thread.start()
+                self._lock.notify_all()
+            except BaseException:
+                # the tenant paid for a request that will never be served:
+                # undo the charge before propagating, or submit-time failures
+                # silently erode the tenant's rate below its configured floor
+                _refund_admission(registry, tenant)
+                raise
+        try:
+            if req_trace is not None:
+                req_trace.event(
+                    "engine.submit", prompt_tokens=len(prompt), queued_behind=waiting,
+                    **({"tenant": tenant, "priority": priority_name(priority)} if tenant is not None or priority != PRIORITY_NORMAL else {}),
+                )
+            return _TokenStream(self, session)
+        except BaseException:
+            _refund_admission(registry, tenant)
+            raise
 
     def import_handoff(self, payload: Dict[str, Any]) -> Iterator[np.ndarray]:
         """Adopt a sibling replica's exported prefill (disaggregated serving,
@@ -2066,17 +2086,30 @@ class ContinuousBatcher:
                             # the gather reads it) until this stream releases
                             pins = list(mblocks)
                             self._radix.pin(pins)
-                    needed = self._blocks_initial(head_prompt, head_budget, shared=len(seeded))
-                    if needed > len(self._free_blocks):
-                        # pool pressure: cached-but-idle prefixes are exactly
-                        # the memory the next admission may take back
-                        self._reclaim_blocks_locked(needed - len(self._free_blocks))
-                    if needed > len(self._free_blocks):
+                    try:
+                        needed = self._blocks_initial(head_prompt, head_budget, shared=len(seeded))
+                        if needed > len(self._free_blocks):
+                            # pool pressure: cached-but-idle prefixes are exactly
+                            # the memory the next admission may take back
+                            self._reclaim_blocks_locked(needed - len(self._free_blocks))
+                        if needed > len(self._free_blocks):
+                            if pins:
+                                self._radix.release(pins)
+                            return
+                        prompt, session = self._pending.pop(0)
+                        slot = self._free.pop(0)
+                    except BaseException:
+                        # admission died between pin and handoff: unpin, or the
+                        # matched prefix blocks stay unevictable forever
                         if pins:
                             self._radix.release(pins)
-                        return
-                prompt, session = self._pending.pop(0)
-                slot = self._free.pop(0)
+                        raise
+                else:
+                    prompt, session = self._pending.pop(0)
+                    slot = self._free.pop(0)
+                # the session owns the pins from here: its release path
+                # (_release_slot_locked) unpins them with every other exit
+                session.pins = pins
                 session.slot = slot
                 session.admit_seq = self._admit_counter
                 self._admit_counter += 1
@@ -2086,7 +2119,6 @@ class ContinuousBatcher:
                     self._slot_blocks[slot] = alloc
                     session.shared_blocks = len(seeded)
                     session.table_len = len(seeded) + len(alloc)
-                    session.pins = pins
                     session.table = list(seeded) + list(alloc)
                     blocks_row = np.full((self.max_blocks,), self._scratch_block, np.int32)
                     blocks_row[: len(seeded)] = seeded

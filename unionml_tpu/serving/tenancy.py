@@ -245,7 +245,7 @@ class _TenantState:
 
     __slots__ = (
         "spec", "req_tokens", "gen_tokens", "last_refill", "last_seen",
-        "admitted", "shed", "generated_tokens",
+        "admitted", "shed", "generated_tokens", "refunded",
     )
 
     def __init__(self, spec: TenantSpec, now: float):
@@ -257,6 +257,7 @@ class _TenantState:
         self.admitted = 0
         self.shed = 0
         self.generated_tokens = 0
+        self.refunded = 0
 
     def refill(self, now: float) -> None:
         elapsed = max(now - self.last_refill, 0.0)
@@ -441,6 +442,31 @@ class TenantRegistry:
             state.admitted += 1
             return None
 
+    def refund(self, tenant: Optional[str], now: Optional[float] = None) -> None:
+        """Undo one :meth:`try_admit` charge: credit the request token back.
+
+        For requests that were charged but never served — an exception between
+        the successful admission and the stream actually entering the batch.
+        Without the refund such failures silently erode the tenant's effective
+        rate below its configured floor ("never double-charge, never charge on
+        shed" — and never charge for work that was not done).  The credit is
+        capped at the bucket's burst capacity, so a stray double refund cannot
+        mint extra burst; a tenant evicted between charge and refund is a
+        no-op (its bucket state is gone, and a fresh state starts full)."""
+        if tenant is None:
+            return
+        if now is None:
+            now = self._clock()
+        with self._lock:
+            state = self._states.get(tenant)
+            if state is None:
+                return
+            state.last_seen = now
+            if state.spec.req_per_s > 0:
+                cap = max(state.spec.req_per_s * state.spec.burst_s, 1.0)
+                state.req_tokens = min(cap, state.req_tokens + 1.0)
+            state.refunded += 1
+
     def charge_tokens(self, tenant: Optional[str], n: int, now: Optional[float] = None) -> None:
         """Debit ``n`` generated tokens (called at engine emission sites).
         The bucket may go negative — debt that :meth:`try_admit` makes new
@@ -469,6 +495,7 @@ class TenantRegistry:
                     "admitted": state.admitted,
                     "shed": state.shed,
                     "generated_tokens": state.generated_tokens,
+                    "refunded": state.refunded,
                     "weight": state.spec.weight,
                 }
                 for tenant, state in self._states.items()

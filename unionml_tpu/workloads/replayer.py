@@ -240,7 +240,6 @@ def _drive_target_sync(
     import urllib.parse
 
     parsed = urllib.parse.urlsplit(target)
-    conn = http.client.HTTPConnection(parsed.hostname, parsed.port or 80, timeout=120.0)
     headers = {"Content-Type": "application/json"}
     if request.tenant:
         headers["X-Tenant-Id"] = request.tenant
@@ -259,6 +258,9 @@ def _drive_target_sync(
         body = json.dumps(payload).encode()
     completion: "List[int]" = []
     start = time.monotonic()
+    # connect only once the request is fully built: everything from here to
+    # the `finally` that closes it is exception-safe
+    conn = http.client.HTTPConnection(parsed.hostname, parsed.port or 80, timeout=120.0)
     try:
         conn.request("POST", request.route, body, headers)
         resp = conn.getresponse()
